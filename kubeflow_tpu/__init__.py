@@ -1,0 +1,19 @@
+"""kubeflow_tpu — a TPU-native ML platform with the Kubeflow capability set.
+
+A ground-up rebuild of the Kubeflow platform's capabilities (training
+orchestration, hyperparameter optimization, serving, pipelines) as a
+self-contained TPU-native framework built on JAX/XLA/pjit/Pallas.
+
+Capability mapping (reference: Sai-Adarsh/kubeflow, see SURVEY.md):
+  - training-operator (TFJob/PyTorchJob/MPIJob CRDs)  -> ``kubeflow_tpu.api.JAXJob``
+    + ``kubeflow_tpu.runtime`` reconcilers + ``kubeflow_tpu.training`` trainer
+  - NCCL/MPI rendezvous env injection                 -> coordinator-based
+    ``jax.distributed`` bootstrap + mesh/shard_map collectives over ICI/DCN
+  - Katib (Experiment/Suggestion/Trial)               -> ``kubeflow_tpu.hpo``
+  - KServe (InferenceService, Open Inference Protocol)-> ``kubeflow_tpu.serving``
+  - Pipelines (kfp DSL, Argo engine, MLMD)            -> ``kubeflow_tpu.pipelines``
+"""
+
+from kubeflow_tpu.version import __version__
+
+__all__ = ["__version__"]

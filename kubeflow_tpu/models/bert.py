@@ -1,0 +1,157 @@
+"""BERT-style bidirectional encoder for fine-tune workloads.
+
+BASELINE.json config #2 ("BERT-base fine-tune, 4-worker DDP -> 4-host JAXJob").
+Same TPU-first structure as llama.py: functional params, scanned layers,
+logical-axis sharding tree. Classification head for fine-tuning; masked-LM
+head available via `apply(..., mlm=True)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import mha
+from kubeflow_tpu.ops.norms import layer_norm
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    n_classes: int = 2
+    type_vocab: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=8,
+                          d_ff=128, max_seq_len=64)
+
+
+def init(rng: jax.Array, cfg: BertConfig) -> Params:
+    k = jax.random.split(rng, 10)
+    pd = cfg.param_dtype
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(pd)
+
+    return {
+        "embed": dense(k[0], (cfg.vocab_size, d), d),
+        "pos_embed": dense(k[1], (cfg.max_seq_len, d), d),
+        "type_embed": dense(k[2], (cfg.type_vocab, d), d),
+        "embed_norm": {"w": jnp.ones((d,), pd), "b": jnp.zeros((d,), pd)},
+        "layers": {
+            "wqkv": dense(k[3], (L, d, 3 * d), d),
+            "bqkv": jnp.zeros((L, 3 * d), pd),
+            "wo": dense(k[4], (L, d, d), d),
+            "bo": jnp.zeros((L, d), pd),
+            "w1": dense(k[5], (L, d, f), d),
+            "b1": jnp.zeros((L, f), pd),
+            "w2": dense(k[6], (L, f, d), f),
+            "b2": jnp.zeros((L, d), pd),
+            "norm1": {"w": jnp.ones((L, d), pd), "b": jnp.zeros((L, d), pd)},
+            "norm2": {"w": jnp.ones((L, d), pd), "b": jnp.zeros((L, d), pd)},
+        },
+        "pooler": {"w": dense(k[7], (d, d), d), "b": jnp.zeros((d,), pd)},
+        "classifier": {"w": dense(k[8], (d, cfg.n_classes), d),
+                       "b": jnp.zeros((cfg.n_classes,), pd)},
+    }
+
+
+def logical_axes(cfg: BertConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "type_embed": (None, "embed"),
+        "embed_norm": {"w": ("embed_no_fsdp",), "b": ("embed_no_fsdp",)},
+        "layers": {
+            "wqkv": ("layers", "embed", "qkv"),
+            "bqkv": ("layers", "qkv"),
+            "wo": ("layers", "qkv", "embed"),
+            "bo": ("layers", "embed_no_fsdp"),
+            "w1": ("layers", "embed", "mlp"),
+            "b1": ("layers", "mlp"),
+            "w2": ("layers", "mlp", "embed"),
+            "b2": ("layers", "embed_no_fsdp"),
+            "norm1": {"w": ("layers", "embed_no_fsdp"), "b": ("layers", "embed_no_fsdp")},
+            "norm2": {"w": ("layers", "embed_no_fsdp"), "b": ("layers", "embed_no_fsdp")},
+        },
+        "pooler": {"w": ("embed", "mlp"), "b": (None,)},
+        "classifier": {"w": ("embed", None), "b": (None,)},
+    }
+
+
+def _layer_body(cfg: BertConfig, x, layer, attn_mask):
+    b, s, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    qkv = x @ layer["wqkv"].astype(cfg.dtype) + layer["bqkv"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nh, hd)
+    v = v.reshape(b, s, nh, hd)
+    out = mha(q, k, v, causal=False, segment_ids=attn_mask)
+    out = out.reshape(b, s, d) @ layer["wo"].astype(cfg.dtype) + layer["bo"].astype(cfg.dtype)
+    x = layer_norm(x + out, layer["norm1"]["w"], layer["norm1"]["b"], cfg.norm_eps)
+    h = jax.nn.gelu(x @ layer["w1"].astype(cfg.dtype) + layer["b1"].astype(cfg.dtype))
+    h = h @ layer["w2"].astype(cfg.dtype) + layer["b2"].astype(cfg.dtype)
+    x = layer_norm(x + h, layer["norm2"]["w"], layer["norm2"]["b"], cfg.norm_eps)
+    return x, None
+
+
+def apply(params: Params, tokens: jax.Array, cfg: BertConfig, *,
+          attention_mask: jax.Array | None = None,
+          token_type_ids: jax.Array | None = None) -> jax.Array:
+    """tokens [B,S] -> pooled classification logits [B, n_classes]."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"].astype(cfg.dtype)[None, :s]
+    tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(tokens)
+    x = x + params["type_embed"].astype(cfg.dtype)[tt]
+    x = layer_norm(x, params["embed_norm"]["w"], params["embed_norm"]["b"], cfg.norm_eps)
+
+    # attention_mask [B,S] of 1/0 -> segment ids (0 = padding segment)
+    seg = attention_mask if attention_mask is not None else jnp.ones((b, s), jnp.int32)
+    body = partial(_layer_body, cfg, attn_mask=seg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    cls = x[:, 0]  # [CLS] token
+    pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(cfg.dtype)
+                      + params["pooler"]["b"].astype(cfg.dtype))
+    logits = pooled @ params["classifier"]["w"].astype(cfg.dtype) \
+        + params["classifier"]["b"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: BertConfig):
+    logits = apply(params, batch["tokens"], cfg,
+                   attention_mask=batch.get("attention_mask"))
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}

@@ -1,0 +1,218 @@
+"""Llama-family transformer, TPU-first.
+
+The flagship model for the BASELINE.json contract (Llama-3-8B JAXJob on
+v5e-16 at >=40% MFU). The reference platform never implements a model — it
+launches Megatron/DeepSpeed containers (SURVEY.md §2.2, L7); here the model is
+part of the framework, designed around XLA/Pallas:
+
+  - pure-functional param pytrees (no framework Module state) + logical-axis
+    trees so any (data, fsdp, tensor, sequence) mesh layout is a rule change;
+  - all L layers stacked on a leading axis and executed with ``lax.scan``
+    (one compiled layer body — O(1) compile time in depth);
+  - bf16 activations/weights with fp32 softmax/norm statistics;
+  - GQA (n_kv_heads < n_heads), RoPE with explicit position offsets so
+    sequence-parallel shards and KV-cache decode share one code path;
+  - attention is pluggable: "xla" reference einsum, "flash" Pallas kernel,
+    "ring" sequence-parallel ring attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import mha
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"  # xla | flash | ring
+    remat: bool = True
+    # remat policy: "none" | "minimal" (checkpoint_dots) | "full"
+    remat_policy: str = "minimal"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("xla", "flash", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336,
+                           rope_theta=500000.0)
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """Test-size config: real structure, toy dims (multiple-of-8 friendly)."""
+        return LlamaConfig(vocab_size=vocab_size, d_model=64, n_layers=2,
+                           n_heads=8, n_kv_heads=4, d_ff=128, max_seq_len=128,
+                           rope_theta=10000.0)
+
+
+def init(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize stacked-layer params: every per-layer tensor has leading
+    axis n_layers (the lax.scan carry axis)."""
+    keys = jax.random.split(rng, 8)
+    pd = cfg.param_dtype
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    nh, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / (fan_in**0.5)).astype(pd)
+
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, d), d),  # scaled like d for stability
+        "layers": {
+            "wq": dense(keys[1], (L, d, nh * hd), d),
+            "wk": dense(keys[2], (L, d, nkv * hd), d),
+            "wv": dense(keys[3], (L, d, nkv * hd), d),
+            "wo": dense(keys[4], (L, nh * hd, d), nh * hd),
+            "w_gate": dense(keys[5], (L, d, f), d),
+            "w_up": dense(keys[6], (L, d, f), d),
+            "w_down": dense(keys[7], (L, f, d), f),
+            "attn_norm": jnp.ones((L, d), pd),
+            "mlp_norm": jnp.ones((L, d), pd),
+        },
+        "final_norm": jnp.ones((d,), pd),
+        # LM head is tied to embed by default (llama3 unties; keep explicit)
+        "lm_head": dense(jax.random.fold_in(keys[0], 1), (d, cfg.vocab_size), d),
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> Params:
+    """Logical sharding tree matching init()'s structure (see parallel.sharding)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wq": ("layers", "embed", "qkv"),
+            "wk": ("layers", "embed", "qkv"),
+            "wv": ("layers", "embed", "qkv"),
+            "wo": ("layers", "qkv", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "attn_norm": ("layers", "embed_no_fsdp"),
+            "mlp_norm": ("layers", "embed_no_fsdp"),
+        },
+        "final_norm": ("embed_no_fsdp",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
+    b, s, d = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, nh, hd)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    q = apply_rope(q, positions, theta=cfg.rope_theta)
+    k = apply_rope(k, positions, theta=cfg.rope_theta)
+
+    if cfg.attention_impl == "flash":
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+    elif cfg.attention_impl == "ring":
+        from kubeflow_tpu.ops.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, axis_name="sequence")
+    else:
+        out = mha(q, k, v, causal=True, segment_ids=segment_ids)
+    out = out.reshape(b, s, nh * hd)
+    return x + out @ layer["wo"].astype(cfg.dtype)
+
+
+def _mlp(cfg: LlamaConfig, x, layer):
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = h @ layer["w_gate"].astype(cfg.dtype)
+    up = h @ layer["w_up"].astype(cfg.dtype)
+    return x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
+
+
+def _layer_body(cfg: LlamaConfig, carry, layer, positions, segment_ids):
+    x = carry
+    x = _attention(cfg, x, layer, positions, segment_ids)
+    x = _mlp(cfg, x, layer)
+    return x, None
+
+
+def apply(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Forward pass: [B, S] int tokens -> [B, S, vocab] fp32 logits."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B,S,D] gather
+
+    body = partial(_layer_body, cfg, positions=positions, segment_ids=segment_ids)
+    if cfg.remat:
+        policy = {
+            "minimal": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "none": jax.checkpoint_policies.everything_saveable,
+        }[cfg.remat_policy]
+        body = jax.checkpoint(body, policy=policy)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
+    """Next-token cross-entropy with optional loss mask. batch: tokens [B,S],
+    optionally loss_mask [B,S] (1.0 where the target counts)."""
+    tokens = batch["tokens"]
+    logits = apply(params, tokens[:, :-1], cfg,
+                   positions=jnp.arange(tokens.shape[1] - 1),
+                   segment_ids=batch.get("segment_ids"))
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    mask = jnp.ones_like(token_loss) if mask is None else mask[:, 1:]
+    total = jnp.sum(token_loss * mask)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom, {"loss": total / denom, "tokens": jnp.sum(mask)}
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token (fwd+bwd ~ 6*N params + attention quadratic term)
+    for MFU accounting. Matches the standard 6N + 12*L*H*S approximation."""
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    nh, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    matmul_params = L * (d * nh * hd + 2 * d * nkv * hd + nh * hd * d + 3 * d * f)
+    embed_params = cfg.vocab_size * d  # lm_head matmul counts; embed gather ~free
+    attn_flops = 12 * L * nh * hd * seq_len  # 2 matmuls * 2 (fwd) * 3 (bwd) * S
+    return 6.0 * (matmul_params + embed_params) + attn_flops

@@ -1,0 +1,79 @@
+"""MNIST CNN — BASELINE.json config #1 ("minimum slice").
+
+The reference's analog is kubeflow/examples mnist TFJob user code (L7);
+here it is a built-in model so the end-to-end JAXJob path has a seconds-scale
+workload for tests and the smoke bench.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    n_classes: int = 10
+    c1: int = 32
+    c2: int = 64
+    hidden: int = 128
+    dtype: Any = jnp.float32
+
+
+def init(rng: jax.Array, cfg: MnistConfig) -> Params:
+    k = jax.random.split(rng, 4)
+
+    def he(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+    return {
+        "conv1": {"w": he(k[0], (3, 3, 1, cfg.c1), 9), "b": jnp.zeros((cfg.c1,))},
+        "conv2": {"w": he(k[1], (3, 3, cfg.c1, cfg.c2), 9 * cfg.c1),
+                  "b": jnp.zeros((cfg.c2,))},
+        "fc1": {"w": he(k[2], (7 * 7 * cfg.c2, cfg.hidden), 7 * 7 * cfg.c2),
+                "b": jnp.zeros((cfg.hidden,))},
+        "fc2": {"w": he(k[3], (cfg.hidden, cfg.n_classes), cfg.hidden),
+                "b": jnp.zeros((cfg.n_classes,))},
+    }
+
+
+def logical_axes(cfg: MnistConfig) -> Params:
+    return {
+        "conv1": {"w": (None, None, "conv_in", "conv_out"), "b": (None,)},
+        "conv2": {"w": (None, None, "conv_in", "conv_out"), "b": (None,)},
+        "fc1": {"w": ("embed", "mlp"), "b": (None,)},
+        "fc2": {"w": ("mlp", None), "b": (None,)},
+    }
+
+
+def _conv_block(x, p):
+    x = jax.lax.conv_general_dilated(
+        x, p["w"], window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x = jax.nn.relu(x + p["b"])
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+
+def apply(params: Params, images: jax.Array, cfg: MnistConfig) -> jax.Array:
+    """images: [B, 28, 28, 1] -> logits [B, n_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv_block(x, params["conv1"])
+    x = _conv_block(x, params["conv2"])
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    return x @ params["fc2"]["w"] + params["fc2"]["b"]
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: MnistConfig):
+    logits = apply(params, batch["image"], cfg)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}

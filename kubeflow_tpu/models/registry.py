@@ -1,0 +1,66 @@
+"""Model registry: name -> (Config, init, apply, loss_fn, logical_axes).
+
+The analog of the reference's per-framework job kinds (TFJob/PyTorchJob pick a
+user image); here a JAXJob spec names a registered model + config overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+
+class ModelDef(NamedTuple):
+    config_cls: type
+    init: Callable
+    apply: Callable
+    loss_fn: Callable
+    logical_axes: Callable
+
+
+_REGISTRY: dict[str, ModelDef] = {}
+_populated = False
+
+
+def register(name: str, model: ModelDef) -> None:
+    _REGISTRY[name] = model
+
+
+def get(name: str) -> ModelDef:
+    if name not in _REGISTRY:
+        _populate()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def names() -> list[str]:
+    _populate()
+    return sorted(_REGISTRY)
+
+
+def make_config(name: str, overrides: dict[str, Any] | None = None):
+    model = get(name)
+    return model.config_cls(**(overrides or {}))
+
+
+def config_with(cfg, **overrides):
+    return dataclasses.replace(cfg, **overrides)
+
+
+def _populate() -> None:
+    global _populated
+    if _populated:
+        return
+    _populated = True
+    from kubeflow_tpu.models import bert, llama, mnist_cnn, resnet
+
+    register("llama", ModelDef(llama.LlamaConfig, llama.init, llama.apply,
+                               llama.loss_fn, llama.logical_axes))
+    register("mnist_cnn", ModelDef(mnist_cnn.MnistConfig, mnist_cnn.init,
+                                   mnist_cnn.apply, mnist_cnn.loss_fn,
+                                   mnist_cnn.logical_axes))
+    register("bert", ModelDef(bert.BertConfig, bert.init, bert.apply,
+                              bert.loss_fn, bert.logical_axes))
+    register("resnet", ModelDef(resnet.ResNetConfig, resnet.init, resnet.apply,
+                                resnet.loss_fn, resnet.logical_axes))

@@ -1,0 +1,164 @@
+"""ResNet (v1.5 bottleneck) for the Katib HPO sweep workload.
+
+BASELINE.json config #4: "Katib Bayesian HPO, 32 trials over ResNet-50/
+ImageNet JAXJob". Design choice: GroupNorm instead of BatchNorm — identical
+accuracy regime for this workload class, but stateless, which keeps the
+framework's uniform functional model interface (params -> logits) and avoids
+cross-device batch-stat sync entirely (BN running stats are the one piece of
+torch-style mutable state that maps poorly onto pjit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # resnet-50
+    width: int = 64
+    n_classes: int = 1000
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @staticmethod
+    def resnet50(n_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(3, 4, 6, 3), n_classes=n_classes)
+
+    @staticmethod
+    def tiny(n_classes: int = 10) -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=(1, 1), width=16, n_classes=n_classes,
+                            groups=4)
+
+
+def _conv_init(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _group_norm(x, w, b, groups, eps=1e-5):
+    n, h, wd, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(n, h, wd, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(n, h, wd, c) * w + b).astype(x.dtype)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def init(rng: jax.Array, cfg: ResNetConfig) -> Params:
+    keys = iter(jax.random.split(rng, 256))
+    pd = cfg.param_dtype
+
+    def norm(c):
+        return {"w": jnp.ones((c,), pd), "b": jnp.zeros((c,), pd)}
+
+    params: Params = {
+        "stem": {"w": _conv_init(next(keys), (7, 7, 3, cfg.width)).astype(pd),
+                 "norm": norm(cfg.width)},
+        "stages": [],
+    }
+    c_in = cfg.width
+    for i, n_blocks in enumerate(cfg.stage_sizes):
+        c_mid = cfg.width * (2**i)
+        c_out = c_mid * 4
+        stage = []
+        for j in range(n_blocks):
+            block = {
+                "conv1": {"w": _conv_init(next(keys), (1, 1, c_in, c_mid)).astype(pd),
+                          "norm": norm(c_mid)},
+                "conv2": {"w": _conv_init(next(keys), (3, 3, c_mid, c_mid)).astype(pd),
+                          "norm": norm(c_mid)},
+                "conv3": {"w": _conv_init(next(keys), (1, 1, c_mid, c_out)).astype(pd),
+                          "norm": norm(c_out)},
+            }
+            if j == 0:
+                block["proj"] = {
+                    "w": _conv_init(next(keys), (1, 1, c_in, c_out)).astype(pd),
+                    "norm": norm(c_out)}
+            stage.append(block)
+            c_in = c_out
+        params["stages"].append(stage)
+    params["fc"] = {
+        "w": (jax.random.normal(next(keys), (c_in, cfg.n_classes), jnp.float32)
+              * 0.01).astype(pd),
+        "b": jnp.zeros((cfg.n_classes,), pd),
+    }
+    return params
+
+
+def logical_axes(cfg: ResNetConfig) -> Params:
+    def conv_ax():
+        return {"w": (None, None, "conv_in", "conv_out"),
+                "norm": {"w": (None,), "b": (None,)}}
+
+    axes: Params = {"stem": conv_ax(), "stages": []}
+    for n_blocks in cfg.stage_sizes:
+        stage = []
+        for j in range(n_blocks):
+            block = {"conv1": conv_ax(), "conv2": conv_ax(), "conv3": conv_ax()}
+            if j == 0:
+                block["proj"] = conv_ax()
+            stage.append(block)
+        axes["stages"].append(stage)
+    axes["fc"] = {"w": ("embed", None), "b": (None,)}
+    return axes
+
+
+def _bottleneck(x, block, cfg, stride):
+    g = cfg.groups
+    residual = x
+    h = _conv(x, block["conv1"]["w"])
+    h = jax.nn.relu(_group_norm(h, block["conv1"]["norm"]["w"],
+                                block["conv1"]["norm"]["b"], g))
+    h = _conv(h, block["conv2"]["w"], stride)
+    h = jax.nn.relu(_group_norm(h, block["conv2"]["norm"]["w"],
+                                block["conv2"]["norm"]["b"], g))
+    h = _conv(h, block["conv3"]["w"])
+    h = _group_norm(h, block["conv3"]["norm"]["w"], block["conv3"]["norm"]["b"], g)
+    if "proj" in block:
+        residual = _conv(x, block["proj"]["w"], stride)
+        residual = _group_norm(residual, block["proj"]["norm"]["w"],
+                               block["proj"]["norm"]["b"], g)
+    return jax.nn.relu(h + residual)
+
+
+def apply(params: Params, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [B,H,W,3] -> logits [B, n_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"]["w"], stride=2)
+    x = jax.nn.relu(_group_norm(x, params["stem"]["norm"]["w"],
+                                params["stem"]["norm"]["b"], cfg.groups))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                              (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for i, stage in enumerate(params["stages"]):
+        for j, block in enumerate(stage):
+            stride = 2 if (i > 0 and j == 0) else 1
+            x = _bottleneck(x, block, cfg, stride)
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x @ params["fc"]["w"].astype(cfg.dtype) + params["fc"]["b"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: ResNetConfig):
+    logits = apply(params, batch["image"], cfg)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}

@@ -1,0 +1,6 @@
+from kubeflow_tpu.ops.attention import mha, repeat_kv
+from kubeflow_tpu.ops.norms import layer_norm, rms_norm
+from kubeflow_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["mha", "repeat_kv", "layer_norm", "rms_norm", "apply_rope",
+           "rope_frequencies"]

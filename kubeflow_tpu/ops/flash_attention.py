@@ -1,0 +1,112 @@
+"""Memory-efficient attention: flash-attention algorithm (online softmax over
+KV blocks) so the S×S score matrix never materializes.
+
+Two implementations behind one API:
+  - ``impl="xla"``: blockwise ``lax.scan`` — pure XLA, differentiable,
+    O(S·block) memory, runs anywhere (CPU tests included).
+  - ``impl="pallas"``: Mosaic kernel (ops/flash_pallas.py) for the TPU hot
+    path; falls back to xla when Pallas/TPU is unavailable.
+
+The reference platform has no attention code at all (compute is delegated to
+user containers, SURVEY.md L7); this is one of the framework's native-compute
+components replacing what CUDA users get from flash-attn kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import repeat_kv
+
+NEG_INF = -1e30
+
+
+def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
+                    block_kv: int):
+    """Online-softmax attention for one query block against all KV blocks.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]. Scans KV in blocks of `block_kv`,
+    carrying (acc, row_max, row_sum) — the flash-attention recurrence.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n_blocks = max(1, (sk + block_kv - 1) // block_kv)
+    pad = n_blocks * block_kv - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [B,H,Sq,D]
+    kb = k.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+        b, h, n_blocks, block_kv, d)
+    vb = v.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+        b, h, n_blocks, block_kv, d)
+
+    q_pos = jnp.arange(sq) + q_offset  # [Sq]
+
+    def body(carry, inputs):
+        acc, m, s = carry  # [B,H,Sq,D], [B,H,Sq], [B,H,Sq]
+        k_blk, v_blk, blk_idx = inputs
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk)  # [B,H,Sq,block]
+        k_pos = blk_idx * block_kv + jnp.arange(block_kv)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+            logits = jnp.where(valid[None, None], logits, NEG_INF)
+        else:
+            logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+        blk_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])
+        new_s = s * correction + jnp.sum(p, axis=-1)
+        new_acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk)
+        return (new_acc, new_m, new_s), None
+
+    init = (
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, sq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    (acc, m, s), _ = jax.lax.scan(
+        body, init,
+        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
+         jnp.arange(n_blocks)))
+    out = acc / jnp.maximum(s[..., None], 1e-37)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,Sq,H,D]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    q_offset: int | jax.Array = 0,
+    block_kv: int = 512,
+    impl: str = "auto",  # auto | pallas | xla
+) -> jax.Array:
+    """Flash attention, BSHD layout, GQA-aware. Numerically matches ops.mha."""
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    if impl in ("auto", "pallas"):
+        try:
+            from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
+                                          q_offset=q_offset)
+        except Exception:
+            if impl == "pallas":
+                raise
+    block = min(block_kv, k.shape[1])
+    return _blockwise_attn(q, k, v, causal=causal, scale=scale,
+                           q_offset=q_offset, block_kv=block)

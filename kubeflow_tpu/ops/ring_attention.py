@@ -1,0 +1,118 @@
+"""Ring attention: sequence-parallel attention over an ICI ring (SURVEY.md
+§5.7 — absent in the reference; first-class here).
+
+Each device holds one sequence shard of Q/K/V. KV shards rotate around the
+ring via ``jax.lax.ppermute`` while every device accumulates its queries'
+attention over each arriving KV block with the online-softmax recurrence —
+compute overlaps the neighbor exchange, and no device ever holds more than
+one extra KV shard. Causal masking across ring steps: block (i attends j)
+is fully unmasked when src_shard < my_shard, diagonal-causal when equal,
+fully masked when src_shard > my_shard (those steps still run for SPMD
+uniformity; their contribution is exp(-inf)=0).
+
+``ring_attention`` is written to execute *inside* ``jax.shard_map`` with the
+sequence axis named; ``ring_attention_sharded`` wraps it for standalone use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import repeat_kv
+
+NEG_INF = -1e30
+
+
+def _block_attn_stats(q, k, v, mask):
+    """One block's (numerator, row_max, row_sum) in fp32.
+    q: [B,Sq,H,D] (pre-scaled), k/v: [B,Sk,H,D], mask [Sq,Sk] bool or None."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(logits - m[..., None])
+    s = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, m, s
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Per-device body (call inside shard_map). q/k/v: local [B, S_loc, H, D]."""
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_loc, heads, d = q.shape
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shard i -> i+1
+
+    def step(carry, r):
+        acc, m, s, k_cur, v_cur = carry
+        src = (my_idx - r) % n  # whose KV shard we hold at ring step r
+        if causal:
+            q_pos = my_idx * s_loc + jnp.arange(s_loc)
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        blk_acc, blk_m, blk_s = _block_attn_stats(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32), mask)
+        new_m = jnp.maximum(m, blk_m)
+        c_old = jnp.exp(m - new_m)
+        c_blk = jnp.exp(blk_m - new_m)
+        new_s = s * c_old + blk_s * c_blk
+        new_acc = (acc * c_old.transpose(0, 2, 1)[..., None]
+                   + blk_acc * c_blk.transpose(0, 2, 1)[..., None])
+        # rotate KV to the next device; overlaps with next step's compute
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (new_acc, new_m, new_s, k_nxt, v_nxt), None
+
+    # Accumulators derived from q so they carry q's varying-manual-axes type
+    # (fresh jnp.zeros would be axis-invariant and fail scan's carry check).
+    bhs = qf[..., 0].transpose(0, 2, 1)  # [B,H,S_loc]
+    init = (
+        jnp.zeros_like(qf),
+        jnp.full_like(bhs, NEG_INF),
+        jnp.zeros_like(bhs),
+        k, v,
+    )
+    (acc, m, s, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    denom = jnp.maximum(s, 1e-37).transpose(0, 2, 1)[..., None]  # [B,Sq,H,1]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    axis_name: str = "sequence",
+) -> jax.Array:
+    """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq->ring)."""
+    spec = P(("data", "fsdp"), axis_name, None, None)
+
+    def body(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis_name=axis_name, causal=causal,
+                              scale=scale)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

@@ -1,0 +1,36 @@
+"""Rotary position embeddings (RoPE), offset-aware for SP/decoding.
+
+Offsets matter twice in this framework: (a) decode-time KV-cache positions,
+(b) sequence-parallel shards where each device holds positions
+[shard*chunk, (shard+1)*chunk) — SURVEY.md §5.7 calls out per-shard RoPE
+offsets as a correctness hazard of ring attention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jax.Array:
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)  # [head_dim//2]
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    *,
+    theta: float = 10000.0,
+) -> jax.Array:
+    """Apply RoPE to [B, S, H, D] given integer positions [B, S] or [S]."""
+    d = x.shape[-1]
+    freqs = rope_frequencies(d, theta)  # [D/2]
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,33 @@
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    batch_sharding,
+    make_mesh,
+    mesh_shape,
+    num_data_shards,
+    replicated,
+    single_device_mesh,
+    validate_divisibility,
+)
+from kubeflow_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_to_spec,
+    shard_tree,
+    tree_logical_to_sharding,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshConfig",
+    "make_mesh",
+    "mesh_shape",
+    "single_device_mesh",
+    "batch_sharding",
+    "replicated",
+    "num_data_shards",
+    "validate_divisibility",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "tree_logical_to_sharding",
+    "shard_tree",
+]

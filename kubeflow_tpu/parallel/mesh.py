@@ -1,0 +1,158 @@
+"""Device-mesh construction for TPU slices.
+
+This is the L1 comm foundation (SURVEY.md §7.1): where the reference platform
+injects NCCL/MPI rendezvous environment variables into pods (training-operator
+``SetClusterSpec`` for PyTorchJob/TFJob; MPIJob hostfile ConfigMaps), the
+TPU-native design expresses all parallelism as a named ``jax.sharding.Mesh``
+over the slice, and lets XLA insert collectives over ICI/DCN.
+
+Axis convention (outermost/slowest-varying first):
+
+  ``data``     pure data parallelism — gradients all-reduced (rides DCN between
+               slices when hybrid meshes are used)
+  ``fsdp``     data parallelism with parameter/optimizer sharding (ZeRO-3 style;
+               params all-gathered per layer, grads reduce-scattered) — ICI
+  ``stage``    pipeline-parallel stage axis (used by kubeflow_tpu.parallel.pipeline)
+  ``tensor``   tensor (megatron-style) model parallelism — ICI, innermost so the
+               per-matmul collectives ride the fastest links
+  ``sequence`` sequence/context parallelism for long-context (ring attention /
+               Ulysses all-to-all) — ICI ring
+  ``expert``   expert parallelism for MoE layers
+
+A mesh never needs all axes; sizes of 1 are dropped-by-default semantics in
+PartitionSpecs so the same sharding rules work for any mesh shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order. `data` outermost (may span DCN), `tensor`/`sequence`
+# innermost (highest-bandwidth ICI neighbours under the default device order).
+AXIS_ORDER = ("data", "fsdp", "stage", "expert", "sequence", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. -1 on at most one axis = infer from device count.
+
+    The analog of the reference's replica-spec geometry (nProcPerNode x replicas)
+    but expressed as a logical parallelism layout instead of a pod count.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    stage: int = 1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    # Number of devices per "slice" for hybrid DCN+ICI meshes. 0 = single slice.
+    devices_per_slice: int = 0
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "stage": self.stage,
+            "expert": self.expert,
+            "sequence": self.sequence,
+            "tensor": self.tensor,
+        }
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        """Resolve a single -1 axis against the available device count."""
+        sizes = self.axis_sizes()
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one axis may be -1, got {unknown}")
+        if unknown:
+            known = math.prod(v for v in sizes.values() if v != -1)
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        total = math.prod(sizes.values())
+        if total > n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {total} devices but only {n_devices} available"
+            )
+        return dataclasses.replace(self, **sizes)
+
+
+def make_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a named Mesh from a MeshConfig (or axis sizes as kwargs).
+
+    Single-axis-of-size-N configs degrade gracefully to one device. Hybrid
+    (multi-slice) meshes put `data` across slice boundaries so only gradient
+    all-reduce crosses DCN, matching the reference's topology split where
+    NCCL rings stay intra-node and gradient sync crosses nodes.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes)
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+    if devices is None:
+        devices = jax.devices()
+    config = config.resolved(len(devices))
+    sizes = config.axis_sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    total = math.prod(shape)
+    # A mesh smaller than the pool claims the first `total` devices — the
+    # analog of a job requesting fewer replicas than the cluster holds; the
+    # gang scheduler (runtime.gang) does proper placement for concurrent jobs.
+    dev_array = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A trivial mesh with all axes of size 1 — lets every sharded program run
+    unmodified on one chip (the local-dev path; reference analog: 1-worker job)."""
+    dev = device if device is not None else jax.devices()[0]
+    return make_mesh(MeshConfig(), devices=[dev])
+
+
+def mesh_shape(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [batch, ...] array over all data-like axes."""
+    return NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    s = mesh_shape(mesh)
+    return s.get("data", 1) * s.get("fsdp", 1)
+
+
+def validate_divisibility(mesh: Mesh, *, batch: int | None = None,
+                          seq: int | None = None, heads: int | None = None,
+                          embed: int | None = None) -> None:
+    """Early, readable errors instead of XLA sharding failures (the analog of
+    the reference's admission-webhook spec validation)."""
+    s = mesh_shape(mesh)
+    checks = [
+        ("batch", batch, s.get("data", 1) * s.get("fsdp", 1)),
+        ("seq", seq, s.get("sequence", 1)),
+        ("heads", heads, s.get("tensor", 1)),
+        ("embed", embed, s.get("tensor", 1)),
+    ]
+    for name, value, div in checks:
+        if value is not None and div > 1 and value % div:
+            raise ValueError(f"{name}={value} not divisible by mesh factor {div}")

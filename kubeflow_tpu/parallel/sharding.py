@@ -1,0 +1,89 @@
+"""Logical-axis sharding rules: map model-level axis names to mesh axes.
+
+The reference platform has no notion of tensor layouts (sharding lives in user
+code, e.g. Megatron; SURVEY.md §2.2 parallelism table) — here it is first-class.
+A model annotates every parameter/activation with *logical* axis names
+("embed", "heads", "mlp", ...); a RuleSet maps those to mesh axes. Changing the
+parallelism layout is a rule change, not a model change — the TPU-native
+replacement for rewriting a job's replica spec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Logical axes used across kubeflow_tpu.models:
+#   batch    — examples
+#   seq      — sequence/token positions (activations)
+#   embed    — model/hidden dimension
+#   mlp      — FFN intermediate dimension
+#   heads    — attention heads
+#   kv       — head_dim (never sharded)
+#   qkv      — fused QKV output dim
+#   vocab    — vocabulary dim
+#   layers   — scanned-layer leading axis
+#   expert   — MoE experts
+#   conv_in / conv_out — conv channels
+
+LogicalSpec = tuple[str | None, ...]
+Rules = Mapping[str, str | tuple[str, ...] | None]
+
+# Default layout: FSDP over params' embed-ish axes, tensor parallelism over
+# heads/mlp/vocab, sequence parallelism over activation `seq`.
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "embed": "fsdp",
+    "embed_no_fsdp": None,
+    "mlp": "tensor",
+    "heads": "tensor",
+    "qkv": "tensor",
+    "kv": None,
+    "vocab": "tensor",
+    "layers": None,
+    "expert": "expert",
+    "conv_in": None,
+    "conv_out": "fsdp",
+}
+
+
+def logical_to_spec(logical: Sequence[str | None],
+                    rules: Rules | None = None) -> PartitionSpec:
+    rules = dict(DEFAULT_RULES) | dict(rules or {})
+    parts: list[Any] = []
+    used: set[str] = set()
+    for name in logical:
+        axis = rules.get(name) if name is not None else None
+        # one mesh axis may appear at most once per spec; later dims replicate
+        if axis is None:
+            parts.append(None)
+        elif isinstance(axis, tuple):
+            fresh = tuple(a for a in axis if a not in used)
+            used.update(fresh)
+            parts.append(fresh if fresh else None)
+        elif axis in used:
+            parts.append(None)
+        else:
+            used.add(axis)
+            parts.append(axis)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def tree_logical_to_sharding(logical_tree: Any, mesh: Mesh,
+                             rules: Rules | None = None) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, logical_to_spec(spec, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_tree(tree: Any, shardings: Any) -> Any:
+    """Device-put a pytree with the given shardings (host→HBM staging)."""
+    return jax.tree.map(jax.device_put, tree, shardings)

@@ -1,0 +1,6 @@
+from kubeflow_tpu.training.trainer import OptimizerConfig, Trainer, TrainerConfig
+from kubeflow_tpu.training.metrics_writer import MetricsWriter, read_metrics
+from kubeflow_tpu.training.checkpoint import CheckpointManager, restore_or_init
+
+__all__ = ["Trainer", "TrainerConfig", "OptimizerConfig", "MetricsWriter",
+           "read_metrics", "CheckpointManager", "restore_or_init"]

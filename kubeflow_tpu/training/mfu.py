@@ -1,0 +1,50 @@
+"""MFU accounting (SURVEY.md §4 "beyond reference": the rebuild adds MFU
+tracking the reference never had).
+
+Two FLOP sources: (a) XLA's own cost analysis on the compiled step — exact
+for what was actually compiled; (b) analytic per-model formulas
+(models.llama.flops_per_token) — stable across compiler versions. Peak chip
+FLOPs tables cover the TPU generations this framework targets.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# bf16 peak FLOP/s per chip. (v5e's oft-quoted 394 TOPS is int8; bf16 is 197.)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # trillium
+    "cpu": 1e11,  # nominal, so CPU tests produce finite MFU
+}
+
+
+def device_peak_flops(device: jax.Device | None = None) -> float:
+    dev = device if device is not None else jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    for name, peak in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return peak
+    return PEAK_FLOPS["cpu"]
+
+
+def compiled_flops(compiled) -> float | None:
+    """Total FLOPs of a jax compiled/lowered step via XLA cost analysis."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        return float(analysis.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: float, step_time_s: float, n_devices: int,
+        peak_per_device: float | None = None) -> float:
+    peak = peak_per_device if peak_per_device else device_peak_flops()
+    if step_time_s <= 0:
+        return 0.0
+    return flops_per_step / (step_time_s * peak * n_devices)

@@ -1,0 +1,222 @@
+"""The JAXJob trainer — the in-framework replacement for the reference's L7
+user containers (torch DDP loops launched by PyTorchJob, SURVEY.md §3.1).
+
+Where the reference injects MASTER_ADDR/WORLD_SIZE env vars and lets torch
+build NCCL rings, this trainer receives a Mesh and expresses all parallelism
+as shardings on one jitted train step; XLA inserts the collectives. One code
+path covers 1 chip -> v5e-16 -> multi-slice: only the MeshConfig changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubeflow_tpu.models import registry
+from kubeflow_tpu.parallel import (
+    MeshConfig,
+    make_mesh,
+    logical_to_spec,
+    tree_logical_to_sharding,
+)
+from kubeflow_tpu.training.metrics_writer import MetricsWriter
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    name: str = "adamw"
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    weight_decay: float = 0.01
+    grad_clip: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+    schedule: str = "cosine"  # cosine | linear | constant
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    model: str = "mnist_cnn"
+    model_overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: int = 8
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    sharding_rules: dict[str, Any] = dataclasses.field(default_factory=dict)
+    seed: int = 0
+    log_every: int = 10
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 200
+    keep_checkpoints: int = 3
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    if cfg.schedule == "cosine":
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, cfg.warmup_steps,
+            max(cfg.total_steps, cfg.warmup_steps + 1))
+    elif cfg.schedule == "linear":
+        sched = optax.linear_schedule(cfg.learning_rate, 0.0, cfg.total_steps)
+    else:
+        sched = cfg.learning_rate
+    opt = {
+        "adamw": lambda: optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
+                                     weight_decay=cfg.weight_decay),
+        "adam": lambda: optax.adam(sched, b1=cfg.b1, b2=cfg.b2),
+        "sgd": lambda: optax.sgd(sched, momentum=0.9),
+    }[cfg.name]()
+    if cfg.grad_clip:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
+    return opt
+
+
+class Trainer:
+    """Builds the sharded train step for a registered model on a mesh."""
+
+    def __init__(self, config: TrainerConfig, *, mesh: Mesh | None = None,
+                 devices=None, metrics: MetricsWriter | None = None):
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh(config.mesh,
+                                                            devices=devices)
+        self.model = registry.get(config.model)
+        self.model_cfg = self.model.config_cls(**config.model_overrides)
+        self.optimizer = make_optimizer(config.optimizer)
+        self.metrics = metrics or MetricsWriter()
+        self.rules = config.sharding_rules
+
+        logical = self.model.logical_axes(self.model_cfg)
+        self.param_sharding = tree_logical_to_sharding(logical, self.mesh,
+                                                       self.rules)
+        self.batch_spec = logical_to_spec(("batch",), self.rules)
+        self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+        self.repl = NamedSharding(self.mesh, PartitionSpec())
+
+        self._jit_init = None
+        self._jit_step = None
+        self._step_stats: dict[str, float] = {}
+
+    # -- state ---------------------------------------------------------------
+
+    def init_state(self) -> dict[str, Any]:
+        """Initialize params+opt_state directly sharded on the mesh (no full
+        replica ever materializes on one host — essential at 8B scale)."""
+        if self._jit_init is None:
+            def _init(rng):
+                params = self.model.init(rng, self.model_cfg)
+                opt_state = self.optimizer.init(params)
+                return {"params": params, "opt_state": opt_state,
+                        "step": jnp.zeros((), jnp.int32)}
+
+            abstract = jax.eval_shape(_init, jax.random.key(self.config.seed))
+            out_sh = self._state_sharding(abstract)
+            self._jit_init = jax.jit(_init, out_shardings=out_sh)
+        return self._jit_init(jax.random.key(self.config.seed))
+
+    def _state_sharding(self, abstract_state):
+        """Param shardings for params; optimizer momenta follow their params
+        *structurally* (optax.tree_map_params — shape matching would confuse
+        transposed same-shape weights like wq/wo); non-param leaves replicate."""
+        opt_sh = optax.tree_map_params(
+            self.optimizer,
+            lambda _, sh: sh,
+            abstract_state["opt_state"],
+            self.param_sharding,
+            transform_non_params=lambda _: self.repl,
+        )
+        return {"params": self.param_sharding, "opt_state": opt_sh,
+                "step": self.repl}
+
+    def abstract_state(self) -> dict[str, Any]:
+        """Sharding-annotated ShapeDtypeStructs of the train state — the
+        checkpoint-restore target (no device memory touched)."""
+        def _init(rng):
+            params = self.model.init(rng, self.model_cfg)
+            opt_state = self.optimizer.init(params)
+            return {"params": params, "opt_state": opt_state,
+                    "step": jnp.zeros((), jnp.int32)}
+
+        abstract = jax.eval_shape(_init, jax.random.key(self.config.seed))
+        shardings = self._state_sharding(abstract)
+        return jax.tree.map(
+            lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+            abstract, shardings)
+
+    # -- step ----------------------------------------------------------------
+
+    def _build_step(self, example_batch):
+        loss_fn = self.model.loss_fn
+        model_cfg = self.model_cfg
+        optimizer = self.optimizer
+
+        def train_step(state, batch):
+            def compute(params):
+                return loss_fn(params, batch, model_cfg)
+
+            (loss, metrics), grads = jax.value_and_grad(compute, has_aux=True)(
+                state["params"])
+            updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                                state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            new_state = {"params": new_params, "opt_state": new_opt,
+                         "step": state["step"] + 1}
+            return new_state, metrics
+
+        # state keeps the sharding it was initialized with (in_shardings=None
+        # = "as given"); batch is forced onto the data axes.
+        batch_sh = jax.tree.map(lambda _: self.batch_sharding, example_batch)
+        return jax.jit(
+            train_step,
+            in_shardings=(None, batch_sh),
+            donate_argnums=(0,),
+        )
+
+    def compiled_step(self, state, example_batch):
+        if self._jit_step is None:
+            self._jit_step = self._build_step(example_batch)
+        return self._jit_step
+
+    def shard_batch(self, batch: dict[str, Any]) -> dict[str, Any]:
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self.batch_sharding), batch)
+
+    # -- loop ----------------------------------------------------------------
+
+    def train(self, data: Iterator[dict[str, Any]], num_steps: int,
+              state: dict[str, Any] | None = None,
+              step_callback: Callable[[int, dict], None] | None = None):
+        state = state if state is not None else self.init_state()
+        step_fn = None
+        t_last = time.perf_counter()
+        steps_since_log = 0
+        first_interval = True  # includes jit compile; flagged, not averaged in
+        start_step = int(state["step"])
+        for i in range(num_steps):
+            batch = self.shard_batch(next(data))
+            if step_fn is None:
+                step_fn = self.compiled_step(state, batch)
+            state, metrics = step_fn(state, batch)
+            steps_since_log += 1
+            step = start_step + i + 1
+            if step % self.config.log_every == 0 or i == num_steps - 1:
+                metrics = jax.device_get(metrics)
+                now = time.perf_counter()
+                dt = (now - t_last) / steps_since_log
+                t_last = now
+                steps_since_log = 0
+                scalars = {k: float(v) for k, v in metrics.items()}
+                scalars["step_time_s"] = dt
+                if first_interval:
+                    scalars["includes_compile"] = 1.0
+                    first_interval = False
+                self.metrics.write(step, scalars)
+                if step_callback:
+                    step_callback(step, scalars)
+        return state

@@ -1,0 +1,45 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+This is the reference's "distributed-without-a-cluster" trick (SURVEY.md §4.4)
+adapted to JAX: instead of asserting on pods an operator *would* create, we run
+the real sharded programs on 8 virtual CPU devices so multi-chip semantics
+(collectives, shardings, gang sizes) are exercised for real — just not fast.
+
+Env vars must be set before jax initializes its backends, hence the top of
+conftest. Tests marked `tpu` are skipped here and run on real hardware via
+bench.py / examples.
+"""
+
+import os
+
+# The environment's sitecustomize pre-imports jax and pins JAX_PLATFORMS=axon
+# (the real TPU). Backend init is lazy, so overriding config before the first
+# device query still works — a plain setdefault does not.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: requires real TPU hardware")
+    config.addinivalue_line("markers", "slow: long-running e2e test")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+

@@ -1,0 +1,78 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from kubeflow_tpu.parallel import (
+    MeshConfig,
+    logical_to_spec,
+    make_mesh,
+    mesh_shape,
+    num_data_shards,
+    single_device_mesh,
+    tree_logical_to_sharding,
+    validate_divisibility,
+)
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert set(mesh.axis_names) == {"data", "fsdp", "stage", "expert", "sequence", "tensor"}
+    assert mesh.devices.size == 1
+
+
+def test_mesh_infer_axis(devices8):
+    mesh = make_mesh(MeshConfig(data=-1, tensor=2), devices=devices8)
+    assert mesh_shape(mesh)["data"] == 4
+    assert mesh_shape(mesh)["tensor"] == 2
+    assert num_data_shards(mesh) == 4
+
+
+def test_mesh_bad_shape(devices8):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=16), devices=devices8)
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=-1, fsdp=-1), devices=devices8)
+
+
+def test_mesh_claims_prefix_of_pool(devices8):
+    mesh = make_mesh(MeshConfig(data=2), devices=devices8)
+    assert mesh.devices.size == 2
+
+
+def test_logical_to_spec_dedup():
+    # fsdp used by batch must not be reused by embed in same spec
+    spec = logical_to_spec(("batch", "embed"))
+    assert spec == PartitionSpec(("data", "fsdp"),)
+
+
+def test_logical_rules_override():
+    spec = logical_to_spec(("embed", "mlp"), rules={"embed": None})
+    assert spec == PartitionSpec(None, "tensor")
+
+
+def test_sharded_matmul_allreduce(devices8):
+    # tensor-parallel matmul: contracting dim sharded -> XLA inserts psum
+    mesh = make_mesh(MeshConfig(tensor=8), devices=devices8)
+    x = jnp.ones((16, 64), jnp.float32)
+    w = jnp.ones((64, 32), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec(None, "tensor")))
+    ws = jax.device_put(w, NamedSharding(mesh, PartitionSpec("tensor", None)))
+    out = jax.jit(lambda a, b: a @ b)(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), np.full((16, 32), 64.0))
+
+
+def test_tree_logical_to_sharding(devices8):
+    mesh = make_mesh(MeshConfig(fsdp=2, tensor=4), devices=devices8)
+    tree = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sh = tree_logical_to_sharding(tree, mesh)
+    assert sh["w"].spec == PartitionSpec("fsdp", "tensor")
+    assert sh["b"].spec == PartitionSpec("tensor")
+
+
+def test_validate_divisibility(devices8):
+    mesh = make_mesh(MeshConfig(data=2, tensor=4), devices=devices8)
+    validate_divisibility(mesh, batch=8, heads=8)
+    with pytest.raises(ValueError):
+        validate_divisibility(mesh, heads=6)

@@ -1,0 +1,71 @@
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshConfig
+from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig, restore_or_init
+from kubeflow_tpu.training import data as data_lib
+from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+
+def make_trainer(tmp_path=None, model="mnist_cnn", mesh=MeshConfig(), devices=None, **over):
+    cfg = TrainerConfig(
+        model=model,
+        model_overrides=over.pop("model_overrides", {}),
+        batch_size=8,
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=2, total_steps=50),
+        mesh=mesh,
+        log_every=5,
+    )
+    return Trainer(cfg, devices=devices)
+
+
+def test_mnist_loss_decreases():
+    tr = make_trainer()
+    data = data_lib.for_model("mnist_cnn", tr.model_cfg, 8)
+    losses = []
+    tr.metrics.echo = False
+    state = tr.train(data, 30, step_callback=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state["step"]) == 30
+
+
+def test_llama_tiny_train_dp_tp(devices8):
+    tr = make_trainer(
+        model="llama", mesh=MeshConfig(data=2, fsdp=2, tensor=2),
+        devices=devices8,
+        model_overrides={"vocab_size": 256, "d_model": 32, "n_layers": 2,
+                         "n_heads": 4, "n_kv_heads": 2, "d_ff": 64,
+                         "max_seq_len": 64},
+    )
+    tr.metrics.echo = False
+    data = data_lib.for_model("llama", tr.model_cfg, 8, seq_len=32)
+    losses = []
+    tr.train(data, 20, step_callback=lambda s, m: losses.append(m["loss"]))
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_resume(tmp_path):
+    tr = make_trainer()
+    tr.metrics.echo = False
+    data = data_lib.for_model("mnist_cnn", tr.model_cfg, 8)
+    state = tr.train(data, 5)
+    mngr = CheckpointManager(str(tmp_path / "ckpt"))
+    mngr.save(5, jax.device_get(state) and state)
+    mngr.close()
+
+    tr2 = make_trainer()
+    state2, resumed = restore_or_init(tr2, str(tmp_path / "ckpt"))
+    assert resumed
+    assert int(state2["step"]) == 5
+    w1 = np.asarray(jax.device_get(state["params"]["fc2"]["w"]))
+    w2 = np.asarray(jax.device_get(state2["params"]["fc2"]["w"]))
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_restore_or_init_fresh(tmp_path):
+    tr = make_trainer()
+    state, resumed = restore_or_init(tr, str(tmp_path / "none"))
+    assert not resumed
+    assert int(state["step"]) == 0
