@@ -40,7 +40,8 @@ def main() -> None:
         d_ff=128, max_seq_len=256,
     )
     seq = SEQ_LEN if on_tpu else 128
-    batch = BATCH if on_tpu else 2
+    # per-device batch: keeps the data-parallel sharding divisible on any host
+    batch = (BATCH if on_tpu else 2) * n_dev
 
     trainer = Trainer(TrainerConfig(
         model="llama",

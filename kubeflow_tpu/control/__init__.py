@@ -1,0 +1,36 @@
+"""L5 control plane: CRD-shaped resource store + reconcilers.
+
+The reference platform is an orchestrator of Kubernetes custom resources
+(SURVEY.md §1): an apiserver stores typed objects, controllers watch them and
+reconcile desired vs actual state. This package reimplements those semantics
+natively — no kubectl, no etcd — around TPU training processes:
+
+- store.py       : the apiserver analog (versioned objects, watches)
+- conditions.py  : JobCondition status machinery (kubeflow/common analog)
+- expectations.py: in-flight create/delete tracking (informer-race defense)
+- controller.py  : reconciler base (workqueue, resync, rate limiting)
+- scheduler.py   : gang scheduler + device inventory (Volcano PodGroup analog)
+- executor.py    : pod runtime (thread/subprocess backends — the kubelet analog)
+- jobs.py        : JAXJob controller (training-operator analog)
+"""
+
+from kubeflow_tpu.control.store import (  # noqa: F401
+    ResourceStore,
+    ConflictError,
+    NotFoundError,
+    AlreadyExistsError,
+    new_resource,
+)
+from kubeflow_tpu.control.conditions import (  # noqa: F401
+    JobConditionType,
+    set_condition,
+    has_condition,
+    is_finished,
+)
+from kubeflow_tpu.control.controller import Controller, Cluster  # noqa: F401
+from kubeflow_tpu.control.scheduler import (  # noqa: F401
+    DeviceInventory,
+    GangScheduler,
+)
+from kubeflow_tpu.control.executor import PodExecutor, worker_target  # noqa: F401
+from kubeflow_tpu.control.jobs import JAXJobController  # noqa: F401

@@ -1,0 +1,73 @@
+"""Job status conditions — the kubeflow/common `JobCondition` machinery
+(SURVEY.md §2.2, `common/job.go` / `util/status.go` analogs).
+
+A job's `status.conditions` is an ordered list; exactly one condition is the
+*latest* truth but history is preserved (the reference keeps prior conditions
+with status flipped to False). Lifecycle: Created → Running → (Restarting ⇄
+Running) → Succeeded | Failed. Succeeded/Failed are terminal.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+
+class JobConditionType:
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+
+
+_TERMINAL = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+# Conditions mutually exclusive with a newly set one (flipped to False).
+_EXCLUSIVE = {
+    JobConditionType.RUNNING: {JobConditionType.RESTARTING,
+                               JobConditionType.SUSPENDED},
+    JobConditionType.RESTARTING: {JobConditionType.RUNNING},
+    JobConditionType.SUSPENDED: {JobConditionType.RUNNING},
+    JobConditionType.SUCCEEDED: {JobConditionType.RUNNING,
+                                 JobConditionType.RESTARTING},
+    JobConditionType.FAILED: {JobConditionType.RUNNING,
+                              JobConditionType.RESTARTING},
+}
+
+
+def set_condition(status: dict[str, Any], ctype: str, reason: str = "",
+                  message: str = "") -> None:
+    conds = status.setdefault("conditions", [])
+    now = time.time()
+    for c in conds:
+        if c["type"] == ctype:
+            if c["status"] == "True" and c["reason"] == reason:
+                return  # no-op; avoid resourceVersion churn
+            c.update(status="True", reason=reason, message=message,
+                     lastTransitionTime=now)
+            break
+    else:
+        conds.append({"type": ctype, "status": "True", "reason": reason,
+                      "message": message, "lastTransitionTime": now})
+    for c in conds:
+        if c["type"] in _EXCLUSIVE.get(ctype, ()) and c["type"] != ctype:
+            if c["status"] == "True":
+                c["status"] = "False"
+                c["lastTransitionTime"] = now
+
+
+def has_condition(status: dict[str, Any], ctype: str) -> bool:
+    return any(c["type"] == ctype and c["status"] == "True"
+               for c in status.get("conditions", ()))
+
+
+def latest_condition(status: dict[str, Any]) -> str | None:
+    conds = [c for c in status.get("conditions", ()) if c["status"] == "True"]
+    if not conds:
+        return None
+    return max(conds, key=lambda c: c["lastTransitionTime"])["type"]
+
+
+def is_finished(status: dict[str, Any]) -> bool:
+    return any(has_condition(status, t) for t in _TERMINAL)

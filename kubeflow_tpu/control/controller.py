@@ -1,0 +1,246 @@
+"""Reconciler base + Cluster wiring — controller-runtime's manager/workqueue
+semantics (SURVEY.md §3.1) without Kubernetes.
+
+Each Controller owns one primary kind. Watch events on the primary (and on
+owned kinds, mapped back through ownerReferences) enqueue a namespaced key
+into a deduplicating, rate-limited workqueue; a worker thread pops keys and
+calls `reconcile(obj)`. Reconcile is level-triggered: it reads current state
+from the store and drives it toward spec, returning an optional requeue
+delay. Errors requeue with per-key exponential backoff.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+import traceback
+from typing import Any
+
+from kubeflow_tpu.control.expectations import Expectations
+from kubeflow_tpu.control.store import ConflictError, ResourceStore
+
+log = logging.getLogger("kubeflow_tpu.control")
+
+
+class _RateLimitedQueue:
+    """Deduplicating delay queue with per-key exponential failure backoff
+    (workqueue.DefaultControllerRateLimiter analog: 5ms base, 30s cap here —
+    our control loops run on second timescales, not minutes)."""
+
+    BASE_DELAY = 0.005
+    MAX_DELAY = 30.0
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._heap: list[tuple[float, str]] = []
+        self._pending: set[str] = set()
+        self._failures: dict[str, int] = {}
+        self._shutdown = False
+
+    def add(self, key: str, delay: float = 0.0) -> None:
+        with self._cv:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+            heapq.heappush(self._heap, (time.monotonic() + delay, key))
+            self._cv.notify()
+
+    def add_rate_limited(self, key: str) -> None:
+        n = self._failures.get(key, 0)
+        self._failures[key] = n + 1
+        delay = min(self.BASE_DELAY * (2 ** n), self.MAX_DELAY)
+        self.add(key, delay)
+
+    def forget(self, key: str) -> None:
+        self._failures.pop(key, None)
+
+    def get(self, timeout: float | None = None) -> str | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._shutdown:
+                    return None
+                now = time.monotonic()
+                if self._heap and self._heap[0][0] <= now:
+                    _, key = heapq.heappop(self._heap)
+                    self._pending.discard(key)
+                    return key
+                wait = self._heap[0][0] - now if self._heap else timeout
+                if deadline is not None:
+                    wait = min(wait if wait is not None else 1e9,
+                               deadline - now)
+                    if wait <= 0:
+                        return None
+                self._cv.wait(wait)
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+
+class Controller:
+    """Subclass and implement `reconcile(obj) -> requeue_after|None`."""
+
+    kind: str = ""              # primary kind
+    owned_kinds: tuple[str, ...] = ()  # secondary kinds mapped via ownerRefs
+    resync_period: float = 2.0
+
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+        self.store: ResourceStore = cluster.store
+        self.expectations = Expectations()
+        self.queue = _RateLimitedQueue()
+        self._threads: list[threading.Thread] = []
+        self._watches = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for kind in (self.kind, *self.owned_kinds):
+            w = self.store.watch(kind=kind)
+            self._watches.append(w)
+            t = threading.Thread(target=self._watch_loop, args=(w, kind),
+                                 daemon=True, name=f"{self.kind}-watch-{kind}")
+            t.start()
+            self._threads.append(t)
+        for name, target in [("worker", self._worker_loop),
+                             ("resync", self._resync_loop)]:
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"{self.kind}-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for w in self._watches:
+            w.stop()
+
+    # -- event plumbing ------------------------------------------------------
+
+    @staticmethod
+    def key_of(obj: dict[str, Any]) -> str:
+        return f"{obj['metadata'].get('namespace', 'default')}/{obj['metadata']['name']}"
+
+    def _owner_key(self, obj: dict[str, Any]) -> str | None:
+        for ref in obj["metadata"].get("ownerReferences", ()):
+            if ref["kind"] == self.kind:
+                ns = obj["metadata"].get("namespace", "default")
+                return f"{ns}/{ref['name']}"
+        return None
+
+    def _watch_loop(self, w, kind: str) -> None:
+        for event, obj in w:
+            if self._stop.is_set():
+                return
+            if kind == self.kind:
+                self.queue.add(self.key_of(obj))
+            else:
+                key = self._owner_key(obj)
+                if key is None:
+                    continue
+                if event == "ADDED":
+                    self.expectations.creation_observed(key)
+                elif event == "DELETED":
+                    self.expectations.deletion_observed(key)
+                self.queue.add(key)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            for obj in self.store.list(self.kind, namespace=None):
+                self.queue.add(self.key_of(obj))
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=1.0)
+            if key is None:
+                continue
+            try:
+                ns, name = key.split("/", 1)
+                obj = self.store.try_get(self.kind, name, ns)
+                requeue = self.reconcile(obj) if obj is not None else None
+                self.queue.forget(key)
+                if requeue is not None:
+                    self.queue.add(key, requeue)
+            except ConflictError:
+                self.queue.add_rate_limited(key)  # stale read; retry fast
+            except Exception:
+                log.error("reconcile %s %s failed:\n%s", self.kind, key,
+                          traceback.format_exc())
+                self.queue.add_rate_limited(key)
+
+    # -- to implement --------------------------------------------------------
+
+    def reconcile(self, obj: dict[str, Any]) -> float | None:
+        raise NotImplementedError
+
+
+class Cluster:
+    """The single-process "cluster": store + scheduler + executor + the
+    controller set, started/stopped together (the manager analog).
+
+    Usage:
+        cluster = Cluster()
+        cluster.add(JAXJobController)
+        cluster.start()
+        cluster.store.create(job)
+        ...
+        cluster.stop()
+    """
+
+    def __init__(self, n_devices: int | None = None):
+        # local imports: scheduler/executor import back into this package
+        from kubeflow_tpu.control.executor import PodExecutor
+        from kubeflow_tpu.control.scheduler import (DeviceInventory,
+                                                    GangScheduler)
+
+        self.store = ResourceStore()
+        self.inventory = DeviceInventory(n_devices=n_devices)
+        self.scheduler = GangScheduler(self.store, self.inventory)
+        self.executor = PodExecutor(self.store)
+        self.controllers: list[Controller] = []
+
+    def add(self, controller_cls: type[Controller], **kwargs) -> Controller:
+        c = controller_cls(self, **kwargs)
+        self.controllers.append(c)
+        return c
+
+    def start(self) -> None:
+        self.scheduler.start()
+        self.executor.start()
+        for c in self.controllers:
+            c.start()
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+        self.executor.stop()
+        self.scheduler.stop()
+        self.store.stop_watches()
+
+    def __enter__(self) -> "Cluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def wait_for(self, kind: str, name: str, predicate,
+                 namespace: str = "default", timeout: float = 60.0,
+                 poll: float = 0.05) -> dict[str, Any]:
+        """Poll until predicate(obj) — the SDK's wait_for_job_conditions
+        analog; raises TimeoutError with the last status for debuggability."""
+        deadline = time.monotonic() + timeout
+        obj = None
+        while time.monotonic() < deadline:
+            obj = self.store.try_get(kind, name, namespace)
+            if obj is not None and predicate(obj):
+                return obj
+            time.sleep(poll)
+        raise TimeoutError(
+            f"{kind}/{name}: predicate not met in {timeout}s; "
+            f"last status={None if obj is None else obj.get('status')}")

@@ -1,0 +1,205 @@
+"""Pod executor — the kubelet + container-runtime analog.
+
+A Pod here is a unit of execution with two backends:
+
+- `thread`: runs a registered Python callable in-process. This is the test
+  and single-host path (the reference's fake-client trick taken one step
+  further: the orchestration drives *real* work, SURVEY.md §7.0).
+- `subprocess`: runs an argv with injected env — the real multi-process path
+  (each JAX worker process gets its rendezvous env and calls
+  jax.distributed.initialize, exactly how the reference's operators hand
+  MASTER_ADDR to torch, §3.1).
+
+Lifecycle written to status.phase: Pending → Scheduled (by the gang
+scheduler) → Running → Succeeded | Failed{exitCode}. Deleting a Pod kills a
+subprocess (SIGTERM→SIGKILL) and sets a cancel event for threads.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import traceback
+from typing import Any, Callable
+
+from kubeflow_tpu.control.store import NotFoundError, ResourceStore
+
+_TARGETS: dict[str, Callable[..., Any]] = {}
+
+
+def worker_target(name: str | None = None):
+    """Register a callable as a thread-backend pod target.
+
+    The callable receives (env: dict[str,str], cancel: threading.Event).
+    Return value is ignored; raising marks the pod Failed (SystemExit(code)
+    sets that exit code — how tests exercise retryable-exit-code policy).
+    """
+    def deco(fn):
+        _TARGETS[name or fn.__name__] = fn
+        return fn
+    return deco
+
+
+def get_target(name: str) -> Callable[..., Any]:
+    return _TARGETS[name]
+
+
+class _RunningPod:
+    def __init__(self):
+        self.cancel = threading.Event()
+        self.proc: subprocess.Popen | None = None
+        self.log_path: str | None = None
+        self.log_buffer: list[str] = []
+
+
+class PodExecutor:
+    def __init__(self, store: ResourceStore, log_dir: str | None = None):
+        self.store = store
+        self.log_dir = log_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._running: dict[str, _RunningPod] = {}
+        self._lock = threading.Lock()
+        self._watch = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self._watch = self.store.watch(kind="Pod")
+        threading.Thread(target=self._watch_loop, daemon=True,
+                         name="executor-watch").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch:
+            self._watch.stop()
+        with self._lock:
+            running = list(self._running.values())
+        for rp in running:
+            self._kill(rp)
+
+    # -- event handling ------------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        for event, pod in self._watch:
+            if self._stop.is_set():
+                return
+            uid = pod["metadata"]["uid"]
+            if event == "DELETED":
+                with self._lock:
+                    rp = self._running.pop(uid, None)
+                if rp:
+                    self._kill(rp)
+                continue
+            if pod["status"].get("phase") != "Scheduled":
+                continue
+            with self._lock:
+                if uid in self._running:
+                    continue
+                rp = _RunningPod()
+                self._running[uid] = rp
+            threading.Thread(target=self._run_pod, args=(pod, rp),
+                             daemon=True,
+                             name=f"pod-{pod['metadata']['name']}").start()
+
+    # -- execution -----------------------------------------------------------
+
+    def _set_phase(self, pod: dict[str, Any], phase: str, **extra) -> None:
+        try:
+            self.store.mutate(
+                "Pod", pod["metadata"]["name"],
+                lambda o: o["status"].update(phase=phase, **extra),
+                pod["metadata"].get("namespace", "default"))
+        except NotFoundError:
+            pass  # pod deleted underneath us
+
+    def _run_pod(self, pod: dict[str, Any], rp: _RunningPod) -> None:
+        spec = pod["spec"]
+        env = dict(spec.get("env", {}))
+        env["KTPU_POD_NAME"] = pod["metadata"]["name"]
+        env["KTPU_DEVICE_IDS"] = ",".join(
+            str(d) for d in pod["status"].get("deviceIds", []))
+        self._set_phase(pod, "Running")
+        backend = spec.get("backend", "thread")
+        try:
+            if backend == "thread":
+                exit_code = self._run_thread(spec, env, rp)
+            elif backend == "subprocess":
+                exit_code = self._run_subprocess(pod, spec, env, rp)
+            else:
+                raise ValueError(f"unknown pod backend {backend!r}")
+        except Exception:
+            rp.log_buffer.append(traceback.format_exc())
+            exit_code = 1
+        finally:
+            with self._lock:
+                self._running.pop(pod["metadata"]["uid"], None)
+        if rp.cancel.is_set() and exit_code != 0:
+            # killed by deletion — phase written by deleter path; nothing to do
+            return
+        if exit_code == 0:
+            self._set_phase(pod, "Succeeded", exitCode=0)
+        else:
+            self._set_phase(pod, "Failed", exitCode=exit_code)
+
+    def _run_thread(self, spec, env, rp: _RunningPod) -> int:
+        fn = get_target(spec["target"])
+        try:
+            fn(env, rp.cancel)
+            return 0
+        except SystemExit as e:
+            return int(e.code or 0)
+        except Exception:
+            rp.log_buffer.append(traceback.format_exc())
+            return 1
+
+    def _run_subprocess(self, pod, spec, env, rp: _RunningPod) -> int:
+        argv = spec.get("argv") or [sys.executable, "-c", spec["command"]]
+        full_env = dict(os.environ)
+        full_env.update(env)
+        rp.log_path = os.path.join(
+            self.log_dir,
+            f"{pod['metadata'].get('namespace', 'default')}."
+            f"{pod['metadata']['name']}.{pod['metadata']['uid'][:8]}.log")
+        with open(rp.log_path, "wb") as logf:
+            rp.proc = subprocess.Popen(
+                argv, env=full_env, stdout=logf, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            return rp.proc.wait()
+
+    def _kill(self, rp: _RunningPod) -> None:
+        rp.cancel.set()
+        if rp.proc is not None and rp.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(rp.proc.pid), signal.SIGTERM)
+                try:
+                    rp.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    os.killpg(os.getpgid(rp.proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    # -- logs ----------------------------------------------------------------
+
+    def logs(self, name: str, namespace: str = "default") -> str:
+        """Best-effort pod logs (kubectl logs analog)."""
+        pod = self.store.try_get("Pod", name, namespace)
+        parts: list[str] = []
+        if pod is not None:
+            with self._lock:
+                rp = self._running.get(pod["metadata"]["uid"])
+            if rp is not None:
+                parts.extend(rp.log_buffer)
+                if rp.log_path and os.path.exists(rp.log_path):
+                    with open(rp.log_path, "rb") as f:
+                        parts.append(f.read().decode(errors="replace"))
+                return "\n".join(parts)
+        # finished/deleted: scan log dir by name prefix
+        prefix = f"{namespace}.{name}."
+        for fn in sorted(os.listdir(self.log_dir)):
+            if fn.startswith(prefix):
+                with open(os.path.join(self.log_dir, fn), "rb") as f:
+                    parts.append(f.read().decode(errors="replace"))
+        return "\n".join(parts)

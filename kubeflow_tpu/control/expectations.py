@@ -1,0 +1,63 @@
+"""Controller expectations cache — the informer-race defense the reference's
+shared job framework is built on (SURVEY.md §5.2, `common/expectation.go`).
+
+The race it closes: a reconciler creates 4 pods, but its watch cache hasn't
+seen them yet; the next reconcile would count 0 observed pods and create 4
+more. Before acting, the reconciler records "I expect +4 creations"; watch
+events decrement the counter; until it reaches zero (or times out) the
+reconciler treats its view as stale and only updates status, never creates
+or deletes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+_TIMEOUT_S = 5 * 60.0  # expectations expire — controller self-heals if events
+                       # were lost (same 5min as the reference)
+
+
+class Expectations:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> [adds_pending, dels_pending, set_time]
+        self._exp: dict[str, list[float]] = {}
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._lock:
+            e = self._exp.setdefault(key, [0, 0, time.monotonic()])
+            e[0] += n
+            e[2] = time.monotonic()
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        with self._lock:
+            e = self._exp.setdefault(key, [0, 0, time.monotonic()])
+            e[1] += n
+            e[2] = time.monotonic()
+
+    def creation_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._exp.get(key)
+            if e and e[0] > 0:
+                e[0] -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        with self._lock:
+            e = self._exp.get(key)
+            if e and e[1] > 0:
+                e[1] -= 1
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            e = self._exp.get(key)
+            if e is None:
+                return True
+            if e[0] <= 0 and e[1] <= 0:
+                return True
+            return time.monotonic() - e[2] > _TIMEOUT_S
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._exp.pop(key, None)

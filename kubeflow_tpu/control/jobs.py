@@ -1,0 +1,320 @@
+"""JAXJob controller — the training-operator + kubeflow/common reconcile
+engine (SURVEY.md §2.2, §3.1) rebuilt around JAX processes.
+
+Spec shape (PyTorchJob-compatible skeleton):
+
+    kind: JAXJob
+    spec:
+      runPolicy:
+        backoffLimit: 3              # total restarts before Failed
+        activeDeadlineSeconds: 600
+        ttlSecondsAfterFinished: 5
+        cleanPodPolicy: Running      # Running | All | None
+        schedulingPolicy: {minAvailable: N}   # gang size, default Σreplicas
+      successPolicy: Worker0         # Worker0 | AllWorkers
+      replicaSpecs:
+        worker:
+          replicas: 4
+          restartPolicy: OnFailure   # Never | OnFailure | Always | ExitCode
+          template:
+            backend: thread | subprocess
+            target: <registered fn> | argv: [...] | command: "python -c ..."
+            env: {...}
+            resources: {tpu: 1, cpu: 1}
+
+Where the reference injects MASTER_ADDR/WORLD_SIZE/RANK for torch's TCPStore
+rendezvous, this controller injects KTPU_COORDINATOR_ADDRESS /
+KTPU_NUM_PROCESSES / KTPU_PROCESS_ID for `jax.distributed.initialize`
+(SURVEY.md §5.8) — consumed via kubeflow_tpu.runtime.bootstrap.
+
+ExitCode restart policy follows the reference's convention: exit codes >=128
+(SIGKILL'd, preempted) are retryable; 1–127 are permanent failures.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeflow_tpu.control.conditions import (JobConditionType, is_finished,
+                                             set_condition)
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.scheduler import GROUP_LABEL
+from kubeflow_tpu.control.store import (AlreadyExistsError, NotFoundError,
+                                        new_resource)
+
+JOB_KIND = "JAXJob"
+JOB_NAME_LABEL = "kubeflow-tpu/job-name"
+REPLICA_TYPE_LABEL = "kubeflow-tpu/replica-type"
+REPLICA_INDEX_LABEL = "kubeflow-tpu/replica-index"
+
+_BASE_PORT = 47000
+
+
+def validate_job(job: dict[str, Any]) -> list[str]:
+    """Table-driven spec validation (admission-webhook analog)."""
+    errs = []
+    spec = job.get("spec", {})
+    replicas = spec.get("replicaSpecs", {})
+    if not replicas:
+        errs.append("spec.replicaSpecs must define at least one replica type")
+    for rtype, rspec in replicas.items():
+        n = rspec.get("replicas", 1)
+        if not isinstance(n, int) or n < 1:
+            errs.append(f"replicaSpecs.{rtype}.replicas must be >= 1")
+        rp = rspec.get("restartPolicy", "Never")
+        if rp not in ("Never", "OnFailure", "Always", "ExitCode"):
+            errs.append(f"replicaSpecs.{rtype}.restartPolicy invalid: {rp}")
+        if "template" not in rspec:
+            errs.append(f"replicaSpecs.{rtype}.template is required")
+    run = spec.get("runPolicy", {})
+    if run.get("backoffLimit", 0) < 0:
+        errs.append("runPolicy.backoffLimit must be >= 0")
+    sp = spec.get("successPolicy", "Worker0")
+    if sp not in ("Worker0", "AllWorkers"):
+        errs.append(f"successPolicy invalid: {sp}")
+    return errs
+
+
+def _replica_order(spec: dict[str, Any]) -> list[tuple[str, int]]:
+    """Deterministic global process ranking: replica types sorted (master
+    first if present), then index — the genClusterSpec ordering analog."""
+    order: list[tuple[str, int]] = []
+    rtypes = sorted(spec.get("replicaSpecs", {}),
+                    key=lambda t: (t != "master", t))
+    for rtype in rtypes:
+        for i in range(spec["replicaSpecs"][rtype].get("replicas", 1)):
+            order.append((rtype, i))
+    return order
+
+
+class JAXJobController(Controller):
+    kind = JOB_KIND
+    owned_kinds = ("Pod",)
+
+    def reconcile(self, job: dict[str, Any]) -> float | None:
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        key = self.key_of(job)
+        status = job["status"]
+
+        if is_finished(status):
+            return self._reconcile_finished(job)
+
+        errs = validate_job(job)
+        if errs:
+            self._fail(job, "InvalidSpec", "; ".join(errs))
+            return None
+
+        if not status.get("conditions"):
+            self.store.mutate(JOB_KIND, name, lambda o: (
+                o["status"].update(startTime=time.time()),
+                set_condition(o["status"], JobConditionType.CREATED,
+                              "JobCreated", f"JAXJob {name} is created.")),
+                ns)
+            return 0.0
+
+        run_policy = job["spec"].get("runPolicy", {})
+        deadline = run_policy.get("activeDeadlineSeconds")
+        if deadline and time.time() - status.get("startTime", 0) > deadline:
+            self._fail(job, "DeadlineExceeded",
+                       f"job ran longer than activeDeadlineSeconds={deadline}")
+            return None
+
+        if not self.expectations.satisfied(key):
+            return 0.1  # stale view: only observe, don't create/delete
+
+        self._ensure_pod_group(job)
+        pods = self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name})
+        by_slot = {(p["metadata"]["labels"][REPLICA_TYPE_LABEL],
+                    int(p["metadata"]["labels"][REPLICA_INDEX_LABEL])): p
+                   for p in pods}
+
+        order = _replica_order(job["spec"])
+        total_restarts = status.get("restartCount", 0)
+        backoff_limit = run_policy.get("backoffLimit", 0)
+        restarted = False
+
+        # -- pod lifecycle: create missing, restart/flag failed ---------------
+        for rank, (rtype, idx) in enumerate(order):
+            pod = by_slot.get((rtype, idx))
+            if pod is None:
+                self._create_pod(job, rtype, idx, rank, len(order))
+                continue
+            phase = pod["status"].get("phase")
+            if phase == "Failed":
+                policy = job["spec"]["replicaSpecs"][rtype].get(
+                    "restartPolicy", "Never")
+                exit_code = pod["status"].get("exitCode", 1)
+                retryable = (policy in ("OnFailure", "Always")
+                             or (policy == "ExitCode" and exit_code >= 128))
+                if not retryable:
+                    self._fail(job, "PodFailed",
+                               f"pod {pod['metadata']['name']} failed with "
+                               f"exit code {exit_code} "
+                               f"(restartPolicy={policy})")
+                    return None
+                if total_restarts >= backoff_limit:
+                    self._fail(job, "BackoffLimitExceeded",
+                               f"restartCount {total_restarts} reached "
+                               f"backoffLimit {backoff_limit}")
+                    return None
+                total_restarts += 1
+                restarted = True
+                self.expectations.expect_deletions(key, 1)
+                self.store.try_delete("Pod", pod["metadata"]["name"], ns)
+            elif phase == "Succeeded" and job["spec"]["replicaSpecs"][rtype].get(
+                    "restartPolicy") == "Always":
+                self.expectations.expect_deletions(key, 1)
+                self.store.try_delete("Pod", pod["metadata"]["name"], ns)
+
+        # -- status aggregation -----------------------------------------------
+        pods = self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name})
+        replica_statuses: dict[str, dict[str, int]] = {}
+        for rtype in job["spec"]["replicaSpecs"]:
+            rs = {"active": 0, "succeeded": 0, "failed": 0}
+            for p in pods:
+                if p["metadata"]["labels"][REPLICA_TYPE_LABEL] != rtype:
+                    continue
+                phase = p["status"].get("phase", "Pending")
+                if phase == "Succeeded":
+                    rs["succeeded"] += 1
+                elif phase == "Failed":
+                    rs["failed"] += 1
+                else:
+                    rs["active"] += 1
+            replica_statuses[rtype] = rs
+
+        def write(o):
+            o["status"]["replicaStatuses"] = replica_statuses
+            o["status"]["restartCount"] = total_restarts
+            if restarted:
+                set_condition(o["status"], JobConditionType.RESTARTING,
+                              "PodRestarting", "failed replica restarting")
+            elif any(rs["active"] for rs in replica_statuses.values()):
+                running = sum(
+                    1 for p in pods if p["status"].get("phase") == "Running")
+                if running == len(order):
+                    set_condition(o["status"], JobConditionType.RUNNING,
+                                  "JobRunning", "all replicas running")
+        self.store.mutate(JOB_KIND, name, write, ns)
+
+        # -- success ----------------------------------------------------------
+        if self._check_success(job, replica_statuses, order):
+            self.store.mutate(JOB_KIND, name, lambda o: (
+                o["status"].update(completionTime=time.time()),
+                set_condition(o["status"], JobConditionType.SUCCEEDED,
+                              "JobSucceeded", "success policy satisfied")),
+                ns)
+            self._clean_pods(job)
+            return 0.0
+        return 0.5 if restarted else None
+
+    # -- helpers --------------------------------------------------------------
+
+    def _check_success(self, job, replica_statuses, order) -> bool:
+        policy = job["spec"].get("successPolicy", "Worker0")
+        if policy == "AllWorkers":
+            return all(
+                rs["succeeded"] >= job["spec"]["replicaSpecs"][rt]["replicas"]
+                for rt, rs in replica_statuses.items())
+        rtype0, idx0 = order[0]
+        pod = self.store.try_get(
+            "Pod", self._pod_name(job, rtype0, idx0),
+            job["metadata"].get("namespace", "default"))
+        return pod is not None and pod["status"].get("phase") == "Succeeded"
+
+    @staticmethod
+    def _pod_name(job, rtype: str, idx: int) -> str:
+        return f"{job['metadata']['name']}-{rtype}-{idx}"
+
+    def _coordinator_port(self, job) -> int:
+        return _BASE_PORT + int(job["metadata"]["uid"][:4], 16) % 8000
+
+    def _create_pod(self, job, rtype: str, idx: int, rank: int,
+                    world: int) -> None:
+        ns = job["metadata"].get("namespace", "default")
+        name = job["metadata"]["name"]
+        rspec = job["spec"]["replicaSpecs"][rtype]
+        template = rspec["template"]
+        env = dict(template.get("env", {}))
+        env.update({
+            "KTPU_JOB_NAME": name,
+            "KTPU_NAMESPACE": ns,
+            "KTPU_REPLICA_TYPE": rtype,
+            "KTPU_REPLICA_INDEX": str(idx),
+            "KTPU_NUM_PROCESSES": str(world),
+            "KTPU_PROCESS_ID": str(rank),
+            "KTPU_COORDINATOR_ADDRESS":
+                f"127.0.0.1:{self._coordinator_port(job)}",
+        })
+        pod = new_resource(
+            "Pod", self._pod_name(job, rtype, idx),
+            spec={**{k: v for k, v in template.items() if k != "env"},
+                  "env": env},
+            namespace=ns,
+            labels={JOB_NAME_LABEL: name, REPLICA_TYPE_LABEL: rtype,
+                    REPLICA_INDEX_LABEL: str(idx), GROUP_LABEL: name},
+            owner=job)
+        self.expectations.expect_creations(self.key_of(job), 1)
+        try:
+            self.store.create(pod)
+        except AlreadyExistsError:
+            self.expectations.creation_observed(self.key_of(job))
+
+    def _ensure_pod_group(self, job) -> None:
+        ns = job["metadata"].get("namespace", "default")
+        name = job["metadata"]["name"]
+        if self.store.try_get("PodGroup", name, ns) is not None:
+            return
+        total = sum(r.get("replicas", 1)
+                    for r in job["spec"]["replicaSpecs"].values())
+        min_avail = (job["spec"].get("runPolicy", {})
+                     .get("schedulingPolicy", {}).get("minAvailable", total))
+        pg = new_resource("PodGroup", name,
+                          spec={"minAvailable": min_avail},
+                          namespace=ns, owner=job)
+        try:
+            self.store.create(pg)
+        except AlreadyExistsError:
+            pass
+
+    def _fail(self, job, reason: str, message: str) -> None:
+        ns = job["metadata"].get("namespace", "default")
+        try:
+            self.store.mutate(JOB_KIND, job["metadata"]["name"], lambda o: (
+                o["status"].update(completionTime=time.time()),
+                set_condition(o["status"], JobConditionType.FAILED,
+                              reason, message)), ns)
+        except NotFoundError:
+            return
+        self._clean_pods(job, failed=True)
+
+    def _clean_pods(self, job, failed: bool = False) -> None:
+        """cleanPodPolicy at completion: Running (default) deletes only
+        still-active pods; All deletes everything; None keeps pods for
+        debugging."""
+        policy = job["spec"].get("runPolicy", {}).get("cleanPodPolicy",
+                                                      "Running")
+        if policy == "None" and not failed:
+            return
+        ns = job["metadata"].get("namespace", "default")
+        for p in self.store.list(
+                "Pod", ns, labels={JOB_NAME_LABEL: job["metadata"]["name"]}):
+            phase = p["status"].get("phase", "Pending")
+            if policy == "All" or failed or phase not in ("Succeeded",
+                                                          "Failed"):
+                self.store.try_delete("Pod", p["metadata"]["name"], ns)
+
+    def _reconcile_finished(self, job) -> float | None:
+        ttl = job["spec"].get("runPolicy", {}).get("ttlSecondsAfterFinished")
+        if ttl is None:
+            return None
+        ns = job["metadata"].get("namespace", "default")
+        done_at = job["status"].get("completionTime", time.time())
+        remaining = done_at + ttl - time.time()
+        if remaining > 0:
+            return remaining
+        self.store.delete_owned_by(job)
+        self.store.try_delete(JOB_KIND, job["metadata"]["name"], ns)
+        return None

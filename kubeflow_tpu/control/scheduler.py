@@ -1,0 +1,183 @@
+"""Gang scheduler + device inventory — the Volcano PodGroup / kube-scheduler
+analog (SURVEY.md §2.2 "Gang scheduling", §5.3).
+
+The reference creates a PodGroup sized minAvailable=Σreplicas so a distributed
+job is placed all-or-nothing — partial placement deadlocks NCCL rendezvous.
+The same hazard exists here (jax.distributed.initialize blocks until all
+processes arrive), so the semantics carry over: pods carrying a `pod-group`
+label are only bound when the whole group fits the device inventory.
+
+The inventory models one TPU slice: `tpu` chips are countable, exclusive
+resources (the `google.com/tpu` extended-resource analog); `cpu` is a soft
+resource. Binding records concrete chip ids in `status.deviceIds` so a worker
+can pin itself (JAX visible-devices) — the device-plugin mount analog.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from kubeflow_tpu.control.store import ResourceStore
+
+GROUP_LABEL = "kubeflow-tpu/pod-group"
+
+
+class DeviceInventory:
+    """Countable chip inventory with exclusive allocation."""
+
+    def __init__(self, n_devices: int | None = None, cpu_capacity: int = 256):
+        if n_devices is None:
+            n_devices = 8
+        self.n_devices = n_devices
+        self.cpu_capacity = cpu_capacity
+        self._lock = threading.Lock()
+        self._free = set(range(n_devices))
+        self._cpu_used = 0
+        self._held: dict[str, tuple[list[int], int]] = {}  # uid -> (chips, cpu)
+
+    def fits(self, requests: list[dict[str, int]]) -> bool:
+        with self._lock:
+            tpu = sum(r.get("tpu", 0) for r in requests)
+            cpu = sum(r.get("cpu", 1) for r in requests)
+            return (tpu <= len(self._free)
+                    and self._cpu_used + cpu <= self.cpu_capacity)
+
+    def allocate(self, uid: str, request: dict[str, int]) -> list[int] | None:
+        with self._lock:
+            tpu = request.get("tpu", 0)
+            cpu = request.get("cpu", 1)
+            if tpu > len(self._free) or self._cpu_used + cpu > self.cpu_capacity:
+                return None
+            chips = sorted(self._free)[:tpu]
+            self._free -= set(chips)
+            self._cpu_used += cpu
+            self._held[uid] = (chips, cpu)
+            return chips
+
+    def release(self, uid: str) -> None:
+        with self._lock:
+            held = self._held.pop(uid, None)
+            if held:
+                self._free |= set(held[0])
+                self._cpu_used -= held[1]
+
+    def usage(self) -> dict[str, int]:
+        with self._lock:
+            return {"tpu_used": self.n_devices - len(self._free),
+                    "tpu_capacity": self.n_devices,
+                    "cpu_used": self._cpu_used}
+
+
+class GangScheduler:
+    """Binds Pending pods: grouped pods all-or-nothing, others immediately."""
+
+    def __init__(self, store: ResourceStore, inventory: DeviceInventory):
+        self.store = store
+        self.inventory = inventory
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._watch = None
+
+    def start(self) -> None:
+        self._watch = self.store.watch(kind="Pod")
+        threading.Thread(target=self._watch_loop, daemon=True,
+                         name="sched-watch").start()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="scheduler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._watch:
+            self._watch.stop()
+
+    def _watch_loop(self) -> None:
+        for event, obj in self._watch:
+            if self._stop.is_set():
+                return
+            if event == "DELETED" or obj["status"].get("phase") in (
+                    "Succeeded", "Failed"):
+                self.inventory.release(obj["metadata"]["uid"])
+            self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._schedule_round()
+            except Exception:  # scheduler must never die
+                import traceback
+                traceback.print_exc()
+
+    def _schedule_round(self) -> None:
+        pending = [p for p in self.store.list("Pod", namespace=None)
+                   if p["status"].get("phase", "Pending") == "Pending"]
+        groups: dict[str, list[dict[str, Any]]] = {}
+        singles: list[dict[str, Any]] = []
+        for p in pending:
+            g = p["metadata"]["labels"].get(GROUP_LABEL)
+            (groups.setdefault(g, []) if g else singles).append(p)
+
+        for pod in singles:
+            self._bind_if_fits([pod])
+
+        for gname, pods in groups.items():
+            ns = pods[0]["metadata"].get("namespace", "default")
+            pg = self.store.try_get("PodGroup", gname, ns)
+            min_avail = (pg["spec"].get("minAvailable", len(pods))
+                         if pg else len(pods))
+            # Count already-bound members toward the gang.
+            bound = [p for p in self.store.list("Pod", ns,
+                                                labels={GROUP_LABEL: gname})
+                     if p["status"].get("phase") not in ("Pending", "Failed",
+                                                         "Succeeded", None)]
+            if len(pods) + len(bound) < min_avail:
+                self._mark_unschedulable(pods, "WaitingForGang")
+                continue
+            if not self.inventory.fits(
+                    [p["spec"].get("resources", {}) for p in pods]):
+                self._mark_unschedulable(pods, "InsufficientDevices")
+                continue
+            self._bind_if_fits(pods)
+
+    def _bind_if_fits(self, pods: list[dict[str, Any]]) -> None:
+        allocated: list[dict[str, Any]] = []
+        for pod in pods:
+            chips = self.inventory.allocate(
+                pod["metadata"]["uid"], pod["spec"].get("resources", {}))
+            if chips is None:
+                for done in allocated:  # partial gang — roll back
+                    self.inventory.release(done["metadata"]["uid"])
+                self._mark_unschedulable(pods, "InsufficientDevices")
+                return
+            allocated.append(pod)
+            pod["_chips"] = chips
+        for pod in pods:
+            chips = pod.pop("_chips")
+            try:
+                self.store.mutate(
+                    "Pod", pod["metadata"]["name"],
+                    lambda o, c=chips: o["status"].update(
+                        phase="Scheduled", deviceIds=c),
+                    pod["metadata"].get("namespace", "default"))
+            except Exception:
+                self.inventory.release(pod["metadata"]["uid"])
+
+    def _mark_unschedulable(self, pods: list[dict[str, Any]],
+                            reason: str) -> None:
+        for pod in pods:
+            if pod["status"].get("reason") == reason:
+                continue
+            try:
+                self.store.mutate(
+                    "Pod", pod["metadata"]["name"],
+                    lambda o: o["status"].update(reason=reason),
+                    pod["metadata"].get("namespace", "default"))
+            except Exception:
+                pass

@@ -1,0 +1,243 @@
+"""CRD-shaped resource store: the kube-apiserver + etcd analog.
+
+Objects keep the familiar shape (`apiVersion`/`kind`/`metadata`/`spec`/
+`status`) so YAML specs written for the reference's CRDs translate 1:1, and a
+future bridge onto a real cluster stays possible (SURVEY.md §5.6). Semantics
+mirrored from the k8s API machinery the reference's controllers rely on:
+
+- monotonically increasing `resourceVersion`, optimistic-concurrency updates
+  (stale writes raise ConflictError — the reconciler then re-reads + retries);
+- label selectors on list;
+- watch streams (ADDED/MODIFIED/DELETED events) feeding controller workqueues;
+- delete is immediate (no finalizers — nothing holds external resources here
+  that the owning controller doesn't clean up itself via ownerReferences).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import uuid
+import time
+from typing import Any, Callable, Iterator
+
+
+class StoreError(Exception):
+    pass
+
+
+class ConflictError(StoreError):
+    """resourceVersion mismatch on update."""
+
+
+class NotFoundError(StoreError):
+    pass
+
+
+class AlreadyExistsError(StoreError):
+    pass
+
+
+def new_resource(kind: str, name: str, spec: dict[str, Any] | None = None, *,
+                 namespace: str = "default",
+                 labels: dict[str, str] | None = None,
+                 owner: dict[str, Any] | None = None,
+                 api_version: str = "kubeflow-tpu/v1") -> dict[str, Any]:
+    """Build an object in CRD shape. `owner` is an owning object whose
+    metadata we link via ownerReferences (garbage-collection analog)."""
+    meta: dict[str, Any] = {
+        "name": name,
+        "namespace": namespace,
+        "labels": dict(labels or {}),
+    }
+    if owner is not None:
+        meta["ownerReferences"] = [{
+            "kind": owner["kind"],
+            "name": owner["metadata"]["name"],
+            "uid": owner["metadata"]["uid"],
+        }]
+    return {
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": meta,
+        "spec": copy.deepcopy(spec or {}),
+        "status": {},
+    }
+
+
+def obj_key(obj: dict[str, Any]) -> tuple[str, str, str]:
+    return (obj["kind"], obj["metadata"].get("namespace", "default"),
+            obj["metadata"]["name"])
+
+
+class _Watch:
+    """One watch stream; events are queued so slow consumers can't block
+    writers (the informer-cache property controllers depend on)."""
+
+    def __init__(self, kind: str | None, namespace: str | None):
+        self.kind = kind
+        self.namespace = namespace
+        self.events: queue.Queue = queue.Queue()
+        self.closed = False
+
+    def matches(self, obj: dict[str, Any]) -> bool:
+        if self.kind is not None and obj["kind"] != self.kind:
+            return False
+        if (self.namespace is not None
+                and obj["metadata"].get("namespace") != self.namespace):
+            return False
+        return True
+
+    def stop(self) -> None:
+        self.closed = True
+        self.events.put(None)
+
+    def __iter__(self) -> Iterator[tuple[str, dict[str, Any]]]:
+        while True:
+            ev = self.events.get()
+            if ev is None or self.closed:
+                return
+            yield ev
+
+
+class ResourceStore:
+    """Thread-safe versioned object store with watches."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._rv = itertools.count(1)
+        self._watches: list[_Watch] = []
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, obj: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            key = obj_key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            obj = copy.deepcopy(obj)
+            meta = obj["metadata"]
+            meta.setdefault("namespace", "default")
+            meta["uid"] = uuid.uuid4().hex
+            meta["resourceVersion"] = next(self._rv)
+            meta["creationTimestamp"] = time.time()
+            obj.setdefault("status", {})
+            self._objects[key] = obj
+            self._notify("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default"
+            ) -> dict[str, Any]:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind}/{namespace}/{name}")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"
+                ) -> dict[str, Any] | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = "default",
+             labels: dict[str, str] | None = None) -> list[dict[str, Any]]:
+        """namespace=None lists across all namespaces."""
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels and any(
+                        obj["metadata"]["labels"].get(lk) != lv
+                        for lk, lv in labels.items()):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: o["metadata"]["resourceVersion"])
+            return out
+
+    def update(self, obj: dict[str, Any]) -> dict[str, Any]:
+        """Full-object update with optimistic concurrency."""
+        with self._lock:
+            key = obj_key(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key}")
+            if (obj["metadata"].get("resourceVersion")
+                    != cur["metadata"]["resourceVersion"]):
+                raise ConflictError(
+                    f"{key}: stale resourceVersion "
+                    f"{obj['metadata'].get('resourceVersion')} != "
+                    f"{cur['metadata']['resourceVersion']}")
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["resourceVersion"] = next(self._rv)
+            self._objects[key] = obj
+            self._notify("MODIFIED", obj)
+            return copy.deepcopy(obj)
+
+    def mutate(self, kind: str, name: str,
+               fn: Callable[[dict[str, Any]], None],
+               namespace: str = "default") -> dict[str, Any]:
+        """Read-modify-write under the store lock — the retry-on-conflict
+        helper every reconciler status write goes through."""
+        with self._lock:
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            return self.update(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{key}")
+            self._notify("DELETED", obj)
+
+    def try_delete(self, kind: str, name: str,
+                   namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFoundError:
+            return False
+
+    def delete_owned_by(self, owner: dict[str, Any]) -> int:
+        """Garbage collection: remove everything ownerReference'd to `owner`
+        (the k8s GC-controller analog, run synchronously by the owner's
+        reconciler on delete/TTL)."""
+        uid = owner["metadata"]["uid"]
+        with self._lock:
+            doomed = [
+                obj_key(o) for o in self._objects.values()
+                if any(r.get("uid") == uid
+                       for r in o["metadata"].get("ownerReferences", ()))
+            ]
+            for kind, ns, name in doomed:
+                self.delete(kind, name, ns)
+            return len(doomed)
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, kind: str | None = None,
+              namespace: str | None = None) -> _Watch:
+        w = _Watch(kind, namespace)
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    def stop_watches(self) -> None:
+        with self._lock:
+            for w in self._watches:
+                w.stop()
+            self._watches.clear()
+
+    def _notify(self, event: str, obj: dict[str, Any]) -> None:
+        for w in self._watches:
+            if not w.closed and w.matches(obj):
+                w.events.put((event, copy.deepcopy(obj)))

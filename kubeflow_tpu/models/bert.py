@@ -55,28 +55,29 @@ def init(rng: jax.Array, cfg: BertConfig) -> Params:
     pd = cfg.param_dtype
     d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
 
-    def dense(key, shape, fan_in):
+    def dense(key, shape):
+        # BERT convention: fixed-stddev truncated-normal-style init (0.02).
         return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(pd)
 
     return {
-        "embed": dense(k[0], (cfg.vocab_size, d), d),
-        "pos_embed": dense(k[1], (cfg.max_seq_len, d), d),
-        "type_embed": dense(k[2], (cfg.type_vocab, d), d),
+        "embed": dense(k[0], (cfg.vocab_size, d)),
+        "pos_embed": dense(k[1], (cfg.max_seq_len, d)),
+        "type_embed": dense(k[2], (cfg.type_vocab, d)),
         "embed_norm": {"w": jnp.ones((d,), pd), "b": jnp.zeros((d,), pd)},
         "layers": {
-            "wqkv": dense(k[3], (L, d, 3 * d), d),
+            "wqkv": dense(k[3], (L, d, 3 * d)),
             "bqkv": jnp.zeros((L, 3 * d), pd),
-            "wo": dense(k[4], (L, d, d), d),
+            "wo": dense(k[4], (L, d, d)),
             "bo": jnp.zeros((L, d), pd),
-            "w1": dense(k[5], (L, d, f), d),
+            "w1": dense(k[5], (L, d, f)),
             "b1": jnp.zeros((L, f), pd),
-            "w2": dense(k[6], (L, f, d), f),
+            "w2": dense(k[6], (L, f, d)),
             "b2": jnp.zeros((L, d), pd),
             "norm1": {"w": jnp.ones((L, d), pd), "b": jnp.zeros((L, d), pd)},
             "norm2": {"w": jnp.ones((L, d), pd), "b": jnp.zeros((L, d), pd)},
         },
-        "pooler": {"w": dense(k[7], (d, d), d), "b": jnp.zeros((d,), pd)},
-        "classifier": {"w": dense(k[8], (d, cfg.n_classes), d),
+        "pooler": {"w": dense(k[7], (d, d)), "b": jnp.zeros((d,), pd)},
+        "classifier": {"w": dense(k[8], (d, cfg.n_classes)),
                        "b": jnp.zeros((cfg.n_classes,), pd)},
     }
 

@@ -135,8 +135,12 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
     if cfg.attention_impl == "flash":
         from kubeflow_tpu.ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
     elif cfg.attention_impl == "ring":
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "ring attention does not support packed-sequence segment_ids; "
+                "use attention_impl='xla' or 'flash' for packed batches")
         from kubeflow_tpu.ops.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, axis_name="sequence")

@@ -14,8 +14,6 @@ components replacing what CUDA users get from flash-attn kernels.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -25,11 +23,13 @@ NEG_INF = -1e30
 
 
 def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
-                    block_kv: int):
+                    block_kv: int, segment_ids=None):
     """Online-softmax attention for one query block against all KV blocks.
 
-    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]. Scans KV in blocks of `block_kv`,
-    carrying (acc, row_max, row_sum) — the flash-attention recurrence.
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; segment_ids: [B, Sk] or None —
+    tokens only attend within equal segment ids (packed-sequence masking).
+    Scans KV in blocks of `block_kv`, carrying (acc, row_max, row_sum) — the
+    flash-attention recurrence.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -46,18 +46,30 @@ def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
         b, h, n_blocks, block_kv, d)
 
     q_pos = jnp.arange(sq) + q_offset  # [Sq]
+    if segment_ids is not None:
+        # pad KV segments with -1 so padded keys never match a query segment;
+        # q segments: self-attention ⇒ q row i has the segment of token
+        # q_offset+i (decode path passes the full-length seg array).
+        seg_k = jnp.pad(segment_ids, ((0, 0), (0, pad)), constant_values=-1)
+        seg_kb = seg_k.reshape(b, n_blocks, block_kv).transpose(1, 0, 2)
+        seg_q = jax.lax.dynamic_slice_in_dim(
+            segment_ids, q_offset, sq, axis=1) if sq != sk else segment_ids
+    else:
+        seg_kb = jnp.zeros((n_blocks, b, block_kv), jnp.int32)
+        seg_q = None
 
     def body(carry, inputs):
         acc, m, s = carry  # [B,H,Sq,D], [B,H,Sq], [B,H,Sq]
-        k_blk, v_blk, blk_idx = inputs
+        k_blk, v_blk, seg_blk, blk_idx = inputs
         logits = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk)  # [B,H,Sq,block]
         k_pos = blk_idx * block_kv + jnp.arange(block_kv)
-        valid = k_pos < sk
+        valid = (k_pos < sk)[None, :]  # [1, block]
         if causal:
-            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
-            logits = jnp.where(valid[None, None], logits, NEG_INF)
-        else:
-            logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        valid = jnp.broadcast_to(valid[None], (b, sq, block_kv))
+        if seg_q is not None:
+            valid = valid & (seg_q[:, :, None] == seg_blk[:, None, :])
+        logits = jnp.where(valid[:, None], logits, NEG_INF)
         blk_max = jnp.max(logits, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         correction = jnp.exp(m - new_m)
@@ -75,7 +87,7 @@ def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
     (acc, m, s), _ = jax.lax.scan(
         body, init,
         (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
-         jnp.arange(n_blocks)))
+         seg_kb, jnp.arange(n_blocks)))
     out = acc / jnp.maximum(s[..., None], 1e-37)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,Sq,H,D]
 
@@ -89,6 +101,7 @@ def flash_attention(
     scale: float | None = None,
     q_offset: int | jax.Array = 0,
     block_kv: int = 512,
+    segment_ids: jax.Array | None = None,
     impl: str = "auto",  # auto | pallas | xla
 ) -> jax.Array:
     """Flash attention, BSHD layout, GQA-aware. Numerically matches ops.mha."""
@@ -98,15 +111,20 @@ def flash_attention(
         v = repeat_kv(v, h // hkv)
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
 
-    if impl in ("auto", "pallas"):
+    # The Pallas kernel doesn't take segment ids; packed batches use the
+    # blockwise-XLA path (still O(S·block) memory).
+    if impl in ("auto", "pallas") and segment_ids is None:
         try:
             from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
 
             return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
                                           q_offset=q_offset)
-        except Exception:
+        except (ImportError, NotImplementedError):
             if impl == "pallas":
                 raise
+    elif impl == "pallas":
+        raise NotImplementedError("pallas flash kernel has no segment_ids path")
     block = min(block_kv, k.shape[1])
     return _blockwise_attn(q, k, v, causal=causal, scale=scale,
-                           q_offset=q_offset, block_kv=block)
+                           q_offset=q_offset, block_kv=block,
+                           segment_ids=segment_ids)

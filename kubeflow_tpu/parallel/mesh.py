@@ -52,8 +52,6 @@ class MeshConfig:
     expert: int = 1
     sequence: int = 1
     tensor: int = 1
-    # Number of devices per "slice" for hybrid DCN+ICI meshes. 0 = single slice.
-    devices_per_slice: int = 0
 
     def axis_sizes(self) -> dict[str, int]:
         return {
@@ -94,10 +92,11 @@ def make_mesh(
 ) -> Mesh:
     """Build a named Mesh from a MeshConfig (or axis sizes as kwargs).
 
-    Single-axis-of-size-N configs degrade gracefully to one device. Hybrid
-    (multi-slice) meshes put `data` across slice boundaries so only gradient
-    all-reduce crosses DCN, matching the reference's topology split where
-    NCCL rings stay intra-node and gradient sync crosses nodes.
+    Single-axis-of-size-N configs degrade gracefully to one device. `data` is
+    the outermost axis, so under JAX's default device order it lands across
+    slice/host boundaries and only gradient all-reduce crosses DCN — the
+    analog of the reference's NCCL-rings-intra-node / grad-sync-across-nodes
+    topology split.
     """
     if config is None:
         config = MeshConfig(**axis_sizes)

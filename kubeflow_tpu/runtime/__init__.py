@@ -1,0 +1,7 @@
+"""L0 runtime: worker bootstrap + distributed rendezvous."""
+
+from kubeflow_tpu.runtime.bootstrap import (  # noqa: F401
+    WorkerContext,
+    worker_context,
+    initialize_distributed,
+)

@@ -1,0 +1,62 @@
+"""Worker-process bootstrap — the consumer side of the env the JAXJob
+controller injects (SURVEY.md §5.8).
+
+The reference's worker containers read MASTER_ADDR/WORLD_SIZE/RANK and call
+torch.distributed.init_process_group("nccl"); here workers read the KTPU_*
+env and call `jax.distributed.initialize`, after which every jax collective
+rides ICI/DCN via XLA — there is no user-visible comm library (that's the
+whole point of the TPU-native design, SURVEY.md §2.2 backend table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerContext:
+    job_name: str
+    namespace: str
+    replica_type: str
+    replica_index: int
+    process_id: int
+    num_processes: int
+    coordinator_address: str
+    device_ids: tuple[int, ...]
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_id == 0
+
+
+def worker_context(env: dict[str, str] | None = None) -> WorkerContext:
+    e = os.environ if env is None else env
+    raw_devices = e.get("KTPU_DEVICE_IDS", "")
+    return WorkerContext(
+        job_name=e.get("KTPU_JOB_NAME", "local"),
+        namespace=e.get("KTPU_NAMESPACE", "default"),
+        replica_type=e.get("KTPU_REPLICA_TYPE", "worker"),
+        replica_index=int(e.get("KTPU_REPLICA_INDEX", "0")),
+        process_id=int(e.get("KTPU_PROCESS_ID", "0")),
+        num_processes=int(e.get("KTPU_NUM_PROCESSES", "1")),
+        coordinator_address=e.get("KTPU_COORDINATOR_ADDRESS",
+                                  "127.0.0.1:47000"),
+        device_ids=tuple(int(d) for d in raw_devices.split(",") if d),
+    )
+
+
+def initialize_distributed(ctx: WorkerContext | None = None) -> WorkerContext:
+    """Multi-process JAX init. Single-process jobs skip the coordinator
+    entirely (the same short-circuit the reference's single-worker jobs take
+    by never calling init_process_group)."""
+    ctx = ctx or worker_context()
+    if ctx.num_processes > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    return ctx

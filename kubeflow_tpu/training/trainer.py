@@ -193,6 +193,14 @@ class Trainer:
               state: dict[str, Any] | None = None,
               step_callback: Callable[[int, dict], None] | None = None):
         state = state if state is not None else self.init_state()
+        ckpt = None
+        if self.config.checkpoint_dir:
+            from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(
+                self.config.checkpoint_dir,
+                max_to_keep=self.config.keep_checkpoints,
+                save_interval_steps=self.config.checkpoint_every)
         step_fn = None
         t_last = time.perf_counter()
         steps_since_log = 0
@@ -219,4 +227,12 @@ class Trainer:
                 self.metrics.write(step, scalars)
                 if step_callback:
                     step_callback(step, scalars)
+            if ckpt is not None:
+                # manager applies save_interval_steps; final step forced below
+                ckpt.save(step, state)
+        if ckpt is not None:
+            final = start_step + num_steps
+            if ckpt.latest_step() != final:  # interval may have saved it already
+                ckpt.save(final, state, force=True)
+            ckpt.close()
         return state

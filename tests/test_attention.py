@@ -55,6 +55,19 @@ def test_flash_grad_matches_mha():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_flash_segment_ids_match_mha(causal):
+    # packed batch: two documents per row; no cross-document attention
+    q, k, v = make_qkv(s=64)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 24), jnp.int32), jnp.ones((2, 40), jnp.int32)], axis=1)
+    ref = mha(q, k, v, causal=causal, segment_ids=seg)
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          block_kv=16, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_mha(devices8, causal):
     mesh = make_mesh(MeshConfig(sequence=8), devices=devices8)
     q, k, v = make_qkv(b=2, s=64, h=4, hkv=4, d=16)

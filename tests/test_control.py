@@ -1,0 +1,301 @@
+"""Control-plane tests, mirroring the reference's controller test strategy
+(SURVEY.md §4.1/§4.2): assert on the pods + env the controller creates, on
+condition transitions, and on RunPolicy semantics — with the twist that our
+"pods" actually execute (thread backend), so success/failure paths are real.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.control import (
+    Cluster,
+    JAXJobController,
+    new_resource,
+    worker_target,
+)
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.control.jobs import validate_job
+from kubeflow_tpu.control.store import (AlreadyExistsError, ConflictError,
+                                        NotFoundError, ResourceStore)
+from kubeflow_tpu.runtime import worker_context
+
+_ran: dict[str, list] = {}
+_lock = threading.Lock()
+
+
+@worker_target("ok")
+def _ok(env, cancel):
+    with _lock:
+        _ran.setdefault(env["KTPU_JOB_NAME"], []).append(
+            (env["KTPU_REPLICA_TYPE"], int(env["KTPU_REPLICA_INDEX"]),
+             int(env["KTPU_PROCESS_ID"]), env))
+
+
+_fail_counts: dict[str, int] = {}
+
+
+@worker_target("flaky")
+def _flaky(env, cancel):
+    """Fails with a retryable exit code until the 3rd attempt."""
+    key = env["KTPU_POD_NAME"]
+    with _lock:
+        n = _fail_counts.get(key, 0) + 1
+        _fail_counts[key] = n
+    if n < 3:
+        raise SystemExit(137)  # SIGKILL-style: retryable under ExitCode
+
+
+@worker_target("always_fail")
+def _always_fail(env, cancel):
+    raise SystemExit(1)
+
+
+@worker_target("slow")
+def _slow(env, cancel):
+    cancel.wait(30)
+
+
+def make_job(name, *, replicas=1, target="ok", restart="Never",
+             run_policy=None, resources=None, success="Worker0"):
+    return new_resource("JAXJob", name, spec={
+        "runPolicy": run_policy or {},
+        "successPolicy": success,
+        "replicaSpecs": {
+            "worker": {
+                "replicas": replicas,
+                "restartPolicy": restart,
+                "template": {"backend": "thread", "target": target,
+                             "resources": resources or {"cpu": 1}},
+            },
+        },
+    })
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    with c:
+        yield c
+
+
+def wait_done(cluster, name, timeout=30):
+    return cluster.wait_for("JAXJob", name, lambda o: is_finished(o["status"]),
+                            timeout=timeout)
+
+
+# -- store -------------------------------------------------------------------
+
+class TestStore:
+    def test_crud_and_versions(self):
+        s = ResourceStore()
+        obj = s.create(new_resource("JAXJob", "a", {"x": 1}))
+        assert obj["metadata"]["uid"] and obj["metadata"]["resourceVersion"]
+        with pytest.raises(AlreadyExistsError):
+            s.create(new_resource("JAXJob", "a"))
+        got = s.get("JAXJob", "a")
+        got["spec"]["x"] = 2
+        updated = s.update(got)
+        assert updated["metadata"]["resourceVersion"] > obj["metadata"]["resourceVersion"]
+        with pytest.raises(ConflictError):
+            s.update(got)  # stale resourceVersion
+        s.delete("JAXJob", "a")
+        with pytest.raises(NotFoundError):
+            s.get("JAXJob", "a")
+
+    def test_watch_and_labels(self):
+        s = ResourceStore()
+        w = s.watch(kind="Pod")
+        s.create(new_resource("Pod", "p1", labels={"app": "x"}))
+        s.create(new_resource("JAXJob", "j1"))  # filtered out
+        ev, obj = next(iter(w))
+        assert ev == "ADDED" and obj["metadata"]["name"] == "p1"
+        assert s.list("Pod", labels={"app": "x"})
+        assert not s.list("Pod", labels={"app": "y"})
+        w.stop()
+
+    def test_gc_owned(self):
+        s = ResourceStore()
+        job = s.create(new_resource("JAXJob", "j"))
+        s.create(new_resource("Pod", "p1", owner=job))
+        s.create(new_resource("Pod", "p2", owner=job))
+        s.create(new_resource("Pod", "orphan"))
+        assert s.delete_owned_by(job) == 2
+        assert [p["metadata"]["name"] for p in s.list("Pod")] == ["orphan"]
+
+
+# -- validation ---------------------------------------------------------------
+
+@pytest.mark.parametrize("mutate,fragment", [
+    (lambda s: s.pop("replicaSpecs"), "at least one replica"),
+    (lambda s: s["replicaSpecs"]["worker"].update(replicas=0), ">= 1"),
+    (lambda s: s["replicaSpecs"]["worker"].update(restartPolicy="Maybe"),
+     "restartPolicy"),
+    (lambda s: s["replicaSpecs"]["worker"].pop("template"), "template"),
+    (lambda s: s.update(successPolicy="Nope"), "successPolicy"),
+])
+def test_validation_table(mutate, fragment):
+    job = make_job("v")
+    mutate(job["spec"])
+    errs = validate_job(job)
+    assert errs and any(fragment in e for e in errs)
+
+
+# -- happy path ---------------------------------------------------------------
+
+class TestJobLifecycle:
+    def test_single_worker_succeeds(self, cluster):
+        cluster.store.create(make_job("mnist-1"))
+        job = wait_done(cluster, "mnist-1")
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+        assert job["status"]["replicaStatuses"]["worker"]["succeeded"] == 1
+
+    def test_multi_worker_env_injection(self, cluster):
+        cluster.store.create(make_job("ddp-4", replicas=4,
+                                      success="AllWorkers"))
+        job = wait_done(cluster, "ddp-4")
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+        runs = sorted(_ran["ddp-4"])[:4]
+        # Ranks 0..3 assigned deterministically; rendezvous env coherent.
+        assert [r[2] for r in runs] == [0, 1, 2, 3]
+        envs = [r[3] for r in runs]
+        assert len({e["KTPU_COORDINATOR_ADDRESS"] for e in envs}) == 1
+        assert all(e["KTPU_NUM_PROCESSES"] == "4" for e in envs)
+        ctx = worker_context(envs[1])
+        assert ctx.num_processes == 4 and not ctx.is_primary
+
+    def test_invalid_spec_fails_fast(self, cluster):
+        bad = make_job("bad")
+        bad["spec"]["replicaSpecs"]["worker"]["replicas"] = 0
+        cluster.store.create(bad)
+        job = wait_done(cluster, "bad")
+        cond = [c for c in job["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "InvalidSpec"
+
+
+# -- RunPolicy ----------------------------------------------------------------
+
+class TestRunPolicy:
+    def test_never_policy_fails_job(self, cluster):
+        cluster.store.create(make_job("f1", target="always_fail"))
+        job = wait_done(cluster, "f1")
+        cond = [c for c in job["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "PodFailed"
+
+    def test_exitcode_retryable_restarts_until_success(self, cluster):
+        cluster.store.create(make_job(
+            "f2", target="flaky", restart="ExitCode",
+            run_policy={"backoffLimit": 5}))
+        job = wait_done(cluster, "f2")
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+        assert job["status"]["restartCount"] == 2
+        assert has_condition(job["status"], JobConditionType.RESTARTING) is False
+
+    def test_backoff_limit_exceeded(self, cluster):
+        cluster.store.create(make_job(
+            "f3", target="always_fail", restart="OnFailure",
+            run_policy={"backoffLimit": 2}))
+        job = wait_done(cluster, "f3")
+        cond = [c for c in job["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "BackoffLimitExceeded"
+        assert job["status"]["restartCount"] == 2
+
+    def test_active_deadline(self, cluster):
+        cluster.store.create(make_job(
+            "f4", target="slow",
+            run_policy={"activeDeadlineSeconds": 1}))
+        job = wait_done(cluster, "f4", timeout=30)
+        cond = [c for c in job["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "DeadlineExceeded"
+
+    def test_ttl_deletes_job_and_pods(self, cluster):
+        cluster.store.create(make_job(
+            "f5", run_policy={"ttlSecondsAfterFinished": 0.5,
+                              "cleanPodPolicy": "None"}))
+        wait_done(cluster, "f5")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (cluster.store.try_get("JAXJob", "f5") is None
+                    and not cluster.store.list(
+                        "Pod", labels={"kubeflow-tpu/job-name": "f5"})):
+                return
+            time.sleep(0.05)
+        pytest.fail("TTL cleanup did not run")
+
+
+# -- gang scheduling ----------------------------------------------------------
+
+class TestGangScheduling:
+    def test_oversized_gang_never_partially_runs(self, cluster):
+        # 12 chips requested, 8 exist: nothing may start (all-or-nothing).
+        cluster.store.create(make_job(
+            "gang-big", replicas=12, target="slow",
+            resources={"tpu": 1}))
+        time.sleep(1.0)
+        pods = cluster.store.list(
+            "Pod", labels={"kubeflow-tpu/job-name": "gang-big"})
+        assert pods and all(
+            p["status"].get("phase", "Pending") == "Pending" for p in pods)
+        assert any(p["status"].get("reason") == "InsufficientDevices"
+                   for p in pods)
+
+    def test_gang_waits_then_runs_after_release(self, cluster):
+        # Job A holds 6 chips; job B needs 4 and must wait for A to finish.
+        cluster.store.create(make_job("gang-a", replicas=6, target="ok",
+                                      resources={"tpu": 1},
+                                      success="AllWorkers"))
+        cluster.store.create(make_job("gang-b", replicas=4, target="ok",
+                                      resources={"tpu": 1},
+                                      success="AllWorkers"))
+        ja = wait_done(cluster, "gang-a")
+        jb = wait_done(cluster, "gang-b")
+        assert has_condition(ja["status"], JobConditionType.SUCCEEDED)
+        assert has_condition(jb["status"], JobConditionType.SUCCEEDED)
+        # Device accounting returned to zero.
+        deadline = time.monotonic() + 10
+        while cluster.inventory.usage()["tpu_used"] != 0:
+            assert time.monotonic() < deadline, cluster.inventory.usage()
+            time.sleep(0.05)
+
+    def test_device_ids_are_exclusive(self, cluster):
+        cluster.store.create(make_job("excl", replicas=4, target="ok",
+                                      resources={"tpu": 2},
+                                      success="AllWorkers"))
+        wait_done(cluster, "excl")
+        seen: list[int] = []
+        for _, _, _, env in _ran["excl"]:
+            ids = [int(x) for x in env["KTPU_DEVICE_IDS"].split(",")]
+            assert len(ids) == 2
+            seen += ids
+        assert len(seen) == len(set(seen)) == 8
+
+
+# -- subprocess backend -------------------------------------------------------
+
+class TestSubprocessBackend:
+    def test_subprocess_pod_runs_and_logs(self, cluster):
+        job = new_resource("JAXJob", "sub-1", spec={
+            "successPolicy": "AllWorkers",
+            "replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {
+                    "backend": "subprocess",
+                    "command": "import os; print('rank', os.environ['KTPU_PROCESS_ID'])",
+                    "resources": {"cpu": 1},
+                }}},
+            "runPolicy": {"cleanPodPolicy": "None"},
+        })
+        cluster.store.create(job)
+        done = wait_done(cluster, "sub-1", timeout=60)
+        assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+        logs = cluster.executor.logs("sub-1-worker-0")
+        assert "rank 0" in logs
