@@ -132,7 +132,7 @@ class JAXJobController(Controller):
 
         order = _replica_order(job["spec"])
         total_restarts = status.get("restartCount", 0)
-        backoff_limit = run_policy.get("backoffLimit", 0)
+        backoff_limit = run_policy.get("backoffLimit")  # unset = unlimited
         restarted = False
 
         # -- pod lifecycle: create missing, restart/flag failed ---------------
@@ -146,15 +146,19 @@ class JAXJobController(Controller):
                 policy = job["spec"]["replicaSpecs"][rtype].get(
                     "restartPolicy", "Never")
                 exit_code = pod["status"].get("exitCode", 1)
+                # subprocess pods killed by a signal report -signum; treat
+                # them like the >=128 shell convention (SIGKILL'd/preempted
+                # = retryable under ExitCode)
                 retryable = (policy in ("OnFailure", "Always")
-                             or (policy == "ExitCode" and exit_code >= 128))
+                             or (policy == "ExitCode"
+                                 and (exit_code >= 128 or exit_code < 0)))
                 if not retryable:
                     self._fail(job, "PodFailed",
                                f"pod {pod['metadata']['name']} failed with "
                                f"exit code {exit_code} "
                                f"(restartPolicy={policy})")
                     return None
-                if total_restarts >= backoff_limit:
+                if backoff_limit is not None and total_restarts >= backoff_limit:
                     self._fail(job, "BackoffLimitExceeded",
                                f"restartCount {total_restarts} reached "
                                f"backoffLimit {backoff_limit}")
@@ -216,7 +220,8 @@ class JAXJobController(Controller):
         policy = job["spec"].get("successPolicy", "Worker0")
         if policy == "AllWorkers":
             return all(
-                rs["succeeded"] >= job["spec"]["replicaSpecs"][rt]["replicas"]
+                rs["succeeded"] >= job["spec"]["replicaSpecs"][rt].get(
+                    "replicas", 1)
                 for rt, rs in replica_statuses.items())
         rtype0, idx0 = order[0]
         pod = self.store.try_get(
@@ -296,14 +301,16 @@ class JAXJobController(Controller):
         debugging."""
         policy = job["spec"].get("runPolicy", {}).get("cleanPodPolicy",
                                                       "Running")
-        if policy == "None" and not failed:
-            return
         ns = job["metadata"].get("namespace", "default")
         for p in self.store.list(
                 "Pod", ns, labels={JOB_NAME_LABEL: job["metadata"]["name"]}):
-            phase = p["status"].get("phase", "Pending")
-            if policy == "All" or failed or phase not in ("Succeeded",
-                                                          "Failed"):
+            active = p["status"].get("phase", "Pending") not in ("Succeeded",
+                                                                 "Failed")
+            # All: delete everything. Running: delete still-active pods.
+            # None: keep pods for debugging — but a failed job must still
+            # release its active pods (and their devices).
+            if (policy == "All" or (policy == "Running" and active)
+                    or (policy == "None" and failed and active)):
                 self.store.try_delete("Pod", p["metadata"]["name"], ns)
 
     def _reconcile_finished(self, job) -> float | None:

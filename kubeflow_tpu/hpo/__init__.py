@@ -1,0 +1,53 @@
+"""Hyperparameter optimization — the Katib analog (SURVEY.md §2.3).
+
+Experiment/Suggestion/Trial CRD-shaped resources reconciled by controllers;
+suggestion algorithms (random, grid, sobol, TPE, GP-bayesian, CMA-ES,
+hyperband); metrics collection into an observation DB; median-stop early
+stopping.
+
+    from kubeflow_tpu.control import Cluster
+    from kubeflow_tpu import hpo
+
+    cluster = Cluster()
+    db = hpo.add_hpo_controllers(cluster)   # + JAXJobController separately
+"""
+
+from kubeflow_tpu.hpo.algorithms import (TrialResult, algorithm_names,
+                                         make_algorithm)
+from kubeflow_tpu.hpo.collector import FileTail, collect_text
+from kubeflow_tpu.hpo.earlystopping import MedianStop, make_early_stopping
+from kubeflow_tpu.hpo.experiment import (EXPERIMENT_KIND, SUGGESTION_KIND,
+                                         ExperimentController,
+                                         SuggestionController,
+                                         validate_experiment)
+from kubeflow_tpu.hpo.observations import (Observation, ObservationDB,
+                                           default_db, report_metric,
+                                           set_default_db)
+from kubeflow_tpu.hpo.space import Parameter, SearchSpace, SpaceError
+from kubeflow_tpu.hpo.trial import (EXPERIMENT_LABEL, TRIAL_KIND,
+                                    TrialController, substitute,
+                                    trial_finished)
+
+
+def add_hpo_controllers(cluster, db: ObservationDB | None = None,
+                        metrics_dir: str | None = None) -> ObservationDB:
+    """Wire the three HPO controllers onto a Cluster sharing one observation
+    DB; returns the DB (also installed as the in-process default so thread
+    workers can `report_metric`)."""
+    db = db or ObservationDB()
+    set_default_db(db)
+    cluster.add(ExperimentController)
+    cluster.add(SuggestionController, db=db)
+    cluster.add(TrialController, db=db, metrics_dir=metrics_dir)
+    return db
+
+
+__all__ = [
+    "EXPERIMENT_KIND", "EXPERIMENT_LABEL", "SUGGESTION_KIND", "TRIAL_KIND",
+    "ExperimentController", "FileTail", "MedianStop", "Observation",
+    "ObservationDB", "Parameter", "SearchSpace", "SpaceError",
+    "SuggestionController", "TrialController", "TrialResult",
+    "add_hpo_controllers", "algorithm_names", "collect_text", "default_db",
+    "make_algorithm", "make_early_stopping", "report_metric",
+    "set_default_db", "substitute", "trial_finished", "validate_experiment",
+]

@@ -1,0 +1,101 @@
+"""Suggestion-algorithm interface — Katib's suggestion services behind the
+`GetSuggestions` gRPC API (SURVEY.md §2.3, ⊘ katib
+pkg/suggestion/v1beta1/{hyperopt,skopt,...} + api/v1beta1/suggestion.proto).
+
+Here a suggestion "service" is an Algorithm instance held by the suggestion
+controller (one per Experiment, like Katib's per-experiment Deployment).
+Convention: algorithms MINIMIZE. The experiment controller negates values for
+maximize objectives before handing history over, so algorithm code never
+branches on objective direction.
+
+Stateful algorithms (CMA-ES, hyperband) keep internal generation state; all
+algorithms must also tolerate reconstruction from history alone (experiment
+resume after restart — Katib's `resumePolicy: FromVolume`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.hpo.space import SearchSpace
+
+
+@dataclass(frozen=True)
+class TrialResult:
+    """One completed/failed/pruned trial as seen by the algorithm."""
+    params: dict[str, Any]
+    value: float | None          # objective, lower is better; None if no metric
+    status: str = "Succeeded"    # Succeeded | Failed | EarlyStopped
+
+    @property
+    def ok(self) -> bool:
+        return self.value is not None and np.isfinite(self.value)
+
+
+class Algorithm:
+    """Subclass: implement `suggest`. Settings arrive as the Katib
+    `algorithmSettings` string map; subclasses read what they need."""
+
+    name = ""
+
+    def __init__(self, space: SearchSpace,
+                 settings: dict[str, Any] | None = None, seed: int = 0):
+        self.space = space
+        self.settings = dict(settings or {})
+        if "random_state" in self.settings:  # Katib's setting name
+            seed = int(self.settings["random_state"])
+        self.rng = np.random.default_rng(seed)
+
+    def suggest(self, count: int,
+                history: Sequence[TrialResult]) -> list[dict[str, Any]]:
+        raise NotImplementedError
+
+    # -- shared helpers -------------------------------------------------------
+
+    def _setting(self, key: str, default: float) -> float:
+        return float(self.settings.get(key, default))
+
+    def _finished(self, history: Sequence[TrialResult]) -> list[TrialResult]:
+        return [t for t in history if t.ok]
+
+    def _dedup(self, batch: list[dict[str, Any]],
+               history: Sequence[TrialResult]) -> list[dict[str, Any]]:
+        """Drop exact repeats of already-run points when the space is discrete
+        enough for collisions to waste budget."""
+        if self.space.cardinality() == float("inf"):
+            return batch
+        seen = {tuple(sorted(t.params.items())) for t in history}
+        out = []
+        for p in batch:
+            k = tuple(sorted(p.items()))
+            if k not in seen:
+                seen.add(k)
+                out.append(p)
+        return out
+
+
+_REGISTRY: dict[str, Callable[..., Algorithm]] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+def make_algorithm(name: str, space: SearchSpace,
+                   settings: dict[str, Any] | None = None,
+                   seed: int = 0) -> Algorithm:
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown algorithm {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](space, settings, seed)
+
+
+def algorithm_names() -> list[str]:
+    return sorted(_REGISTRY)

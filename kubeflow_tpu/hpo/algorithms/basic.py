@@ -1,0 +1,158 @@
+"""Model-free suggestion algorithms: random, grid, quasirandom.
+
+⊘ katib pkg/suggestion/v1beta1/hyperopt (random), pkg/suggestion/v1beta1/chocolate
+grid (older vintages), goptuna sobol. The quasirandom sampler here is a
+scrambled Halton sequence — same role as Katib's "sobol" (low-discrepancy
+space filling); registered under both names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import Algorithm, TrialResult, register
+
+
+@register("random")
+class RandomSearch(Algorithm):
+    def suggest(self, count, history):
+        return [self.space.sample(self.rng) for _ in range(count)]
+
+
+@register("grid")
+class GridSearch(Algorithm):
+    """Enumerates the full cartesian grid in order, continuing from wherever
+    history left off. Continuous axes are discretized to `grid_points_per_axis`
+    (default 4) unless they carry a step."""
+
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        per_axis = int(self._setting("grid_points_per_axis", 4))
+        self._axes = [p.grid(per_axis) for p in self.space.parameters]
+        self._sizes = [len(a) for a in self._axes]
+        self._total = int(np.prod(self._sizes))
+        self._cursor = 0
+
+    def _point(self, i: int) -> dict[str, Any]:
+        out = {}
+        for axis, size, param in zip(self._axes, self._sizes,
+                                     self.space.parameters):
+            out[param.name] = axis[i % size]
+            i //= size
+        return out
+
+    def suggest(self, count, history):
+        self._cursor = max(self._cursor, len(history))
+        out = []
+        while len(out) < count and self._cursor < self._total:
+            out.append(self._point(self._cursor))
+            self._cursor += 1
+        return out   # exhausted grid → shorter batch; experiment completes
+
+
+def _halton(index: int, base: int) -> float:
+    f, r = 1.0, 0.0
+    while index > 0:
+        f /= base
+        r += f * (index % base)
+        index //= base
+    return r
+
+
+_PRIMES = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53, 59, 61)
+
+
+@register("sobol")
+@register("quasirandom")
+class QuasiRandom(Algorithm):
+    """Scrambled-Halton low-discrepancy sequence over the unit cube; decoded
+    through the space embedding. Deterministic given the seed."""
+
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        if len(space) > len(_PRIMES):
+            raise ValueError(
+                f"quasirandom supports <= {len(_PRIMES)} dimensions")
+        self._shift = self.rng.uniform(size=len(space))  # Cranley-Patterson
+        self._cursor = 0
+
+    def suggest(self, count, history):
+        self._cursor = max(self._cursor, len(history))
+        out = []
+        for _ in range(count):
+            self._cursor += 1   # skip index 0 (all-zeros corner)
+            u = np.array([_halton(self._cursor, _PRIMES[d])
+                          for d in range(len(self.space))])
+            out.append(self.space.from_unit((u + self._shift) % 1.0))
+        return out
+
+
+@register("hyperband")
+class Hyperband(Algorithm):
+    """Successive-halving resource schedule (Li et al. 2018), ⊘ katib
+    pkg/suggestion/v1beta1/hyperband.
+
+    Settings: `resource_name` (a parameter in the space — typically epochs or
+    train steps), `eta` (halving factor, default 3). Brackets are derived from
+    the resource parameter's min/max. Each call tops up the current rung with
+    random configs at the rung's resource level; when a rung's trials finish,
+    the best 1/eta are promoted with eta× the resource.
+    """
+
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        self.resource = self.settings.get("resource_name")
+        if not self.resource or self.resource not in space.names():
+            raise ValueError("hyperband requires algorithmSettings."
+                             "resource_name naming a space parameter")
+        self.eta = self._setting("eta", 3.0)
+        rp = next(p for p in space.parameters if p.name == self.resource)
+        if rp.min is None or rp.max is None:
+            raise ValueError("hyperband resource parameter needs min/max")
+        self.r_min, self.r_max = float(rp.min), float(rp.max)
+        self._rp = rp
+        # s_max+1 rungs: resource r_max/eta^s ... r_max
+        self.s_max = int(np.floor(np.log(self.r_max / self.r_min)
+                                  / np.log(self.eta)))
+        self._rung = 0
+        self._rung_size = int(np.ceil((self.s_max + 1)
+                                      * self.eta ** self.s_max
+                                      / (self.s_max + 1)))
+        self._promoted: list[dict[str, Any]] = []
+
+    def _resource_at(self, rung: int) -> Any:
+        r = self.r_max / self.eta ** (self.s_max - rung)
+        return self._rp.from_unit(self._rp.to_unit(
+            min(max(r, self.r_min), self.r_max)))
+
+    def _rung_of(self, t: TrialResult) -> int:
+        r = float(t.params.get(self.resource, self.r_min))
+        return int(round(np.log(max(r / (self.r_max / self.eta ** self.s_max),
+                                    1.0)) / np.log(self.eta)))
+
+    def suggest(self, count, history: Sequence[TrialResult]):
+        done = self._finished(history)
+        by_rung: dict[int, list[TrialResult]] = {}
+        for t in done:
+            by_rung.setdefault(self._rung_of(t), []).append(t)
+        # promote: best 1/eta of the deepest completed rung not yet advanced
+        cur = by_rung.get(self._rung, [])
+        if len(cur) >= self._rung_size and self._rung < self.s_max:
+            keep = max(1, int(len(cur) / self.eta))
+            ranked = sorted(cur, key=lambda t: t.value)[:keep]
+            self._rung += 1
+            self._rung_size = keep
+            res = self._resource_at(self._rung)
+            self._promoted = [
+                {**t.params, self.resource: res} for t in ranked]
+        out = []
+        while self._promoted and len(out) < count:
+            out.append(self._promoted.pop(0))
+        res = self._resource_at(self._rung)
+        while len(out) < count:
+            p = self.space.sample(self.rng)
+            p[self.resource] = res
+            out.append(p)
+        return out

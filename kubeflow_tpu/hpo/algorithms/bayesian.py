@@ -1,0 +1,90 @@
+"""Gaussian-process Bayesian optimization — Katib's `bayesianoptimization`
+(⊘ katib pkg/suggestion/v1beta1/skopt; GP + Expected Improvement).
+
+Pure numpy: Matérn-5/2 kernel on the unit cube, Cholesky GP posterior,
+EI acquisition maximized over a quasirandom candidate sweep plus local
+perturbations of the incumbent. O(n³) in observed trials — fine for the
+hundreds-of-trials regime HPO sweeps live in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import Algorithm, register
+
+
+def _matern52(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
+    d = np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1) + 1e-12) / ls
+    s = np.sqrt(5.0) * d
+    return (1.0 + s + s * s / 3.0) * np.exp(-s)
+
+
+@register("bayesianoptimization")
+class BayesianOptimization(Algorithm):
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        self.n_startup = int(self._setting("n_initial_points", 8))
+        self.noise = self._setting("noise", 1e-6)
+        self.xi = self._setting("xi", 0.01)          # EI exploration margin
+        self.n_candidates = int(self._setting("n_candidates", 512))
+
+    def _fit_predict(self, X: np.ndarray, y: np.ndarray,
+                     Xq: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        mu, sd = y.mean(), y.std() + 1e-9
+        yn = (y - mu) / sd
+        # median-heuristic lengthscale
+        if len(X) > 1:
+            dists = np.sqrt(((X[:, None] - X[None, :]) ** 2).sum(-1))
+            ls = max(np.median(dists[dists > 0]), 0.05)
+        else:
+            ls = 0.5
+        K = _matern52(X, X, ls) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = _matern52(Xq, X, ls)
+        mean = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        return mean * sd + mu, np.sqrt(var) * sd
+
+    def _ei(self, mean: np.ndarray, std: np.ndarray, best: float) -> np.ndarray:
+        z = (best - self.xi - mean) / std
+        cdf = 0.5 * (1.0 + _erf(z / np.sqrt(2.0)))
+        pdf = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+        return (best - self.xi - mean) * cdf + std * pdf
+
+    def suggest(self, count, history):
+        done = self._finished(history)
+        out = []
+        for _ in range(count):
+            if len(done) < self.n_startup:
+                out.append(self.space.sample(self.rng))
+                continue
+            X = np.stack([self.space.to_unit(t.params) for t in done])
+            y = np.array([t.value for t in done])
+            best_idx = int(np.argmin(y))
+            cand = self.rng.uniform(size=(self.n_candidates, len(self.space)))
+            # local candidates around the incumbent (exploitation cloud)
+            local = np.clip(
+                X[best_idx] + self.rng.normal(0, 0.08,
+                                              (64, len(self.space))), 0, 1)
+            cand = np.vstack([cand, local])
+            mean, std = self._fit_predict(X, y, cand)
+            ei = self._ei(mean, std, float(y.min()))
+            pick = self.space.from_unit(cand[int(np.argmax(ei))])
+            out.append(pick)
+            # fantasy observation at posterior mean → diverse batches
+            done = done + [type(done[0])(
+                params=pick, value=float(mean[int(np.argmax(ei))]))]
+        return out
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    # Abramowitz-Stegun 7.1.26, max abs error 1.5e-7 — plenty for EI ranking
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    poly = t * (0.254829592 + t * (-0.284496736 + t * (
+        1.421413741 + t * (-1.453152027 + t * 1.061405429))))
+    return sign * (1.0 - poly * np.exp(-x * x))

@@ -1,0 +1,102 @@
+"""CMA-ES — covariance matrix adaptation evolution strategy (⊘ katib
+pkg/suggestion/v1beta1/goptuna `cmaes`; Hansen's (mu/mu_w, lambda) update).
+
+Operates on the unit cube with boundary clipping. Generation state (mean,
+covariance, evolution paths) lives in the instance; ask/tell is mapped onto
+the suggest/history interface by matching returned points against history.
+On reconstruction after restart it re-seeds the mean from the best observed
+point — the standard warm-start.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import Algorithm, register
+
+
+@register("cmaes")
+class CMAES(Algorithm):
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        n = len(space)
+        self.n = n
+        self.lam = int(self._setting("population_size",
+                                     4 + int(3 * np.log(n))))
+        self.mu = self.lam // 2
+        w = np.log(self.mu + 0.5) - np.log(np.arange(1, self.mu + 1))
+        self.weights = w / w.sum()
+        self.mu_eff = 1.0 / (self.weights ** 2).sum()
+        self.sigma = self._setting("sigma", 0.3)
+        self.cc = (4 + self.mu_eff / n) / (n + 4 + 2 * self.mu_eff / n)
+        self.cs = (self.mu_eff + 2) / (n + self.mu_eff + 5)
+        self.c1 = 2 / ((n + 1.3) ** 2 + self.mu_eff)
+        self.cmu = min(1 - self.c1,
+                       2 * (self.mu_eff - 2 + 1 / self.mu_eff)
+                       / ((n + 2) ** 2 + self.mu_eff))
+        self.damps = (1 + 2 * max(0.0, np.sqrt((self.mu_eff - 1)
+                                               / (n + 1)) - 1) + self.cs)
+        self.chi_n = np.sqrt(n) * (1 - 1 / (4 * n) + 1 / (21 * n * n))
+        self.mean = np.full(n, 0.5)
+        self.C = np.eye(n)
+        self.pc = np.zeros(n)
+        self.ps = np.zeros(n)
+        self.gen = 0
+        self._warmed = False
+        self._pending: list[tuple[tuple, np.ndarray]] = []  # (key, z-vector)
+
+    @staticmethod
+    def _key(params: dict) -> tuple:
+        return tuple(sorted((k, round(float(v), 10)
+                             if isinstance(v, (int, float)) else v)
+                            for k, v in params.items()))
+
+    def _tell(self, history) -> None:
+        """Fold any completed generation members back into the update."""
+        done = {self._key(t.params): t.value for t in self._finished(history)}
+        ready = [(k, x) for k, x in self._pending if k in done]
+        if len(ready) < max(2, self.lam // 2):
+            return
+        ranked = sorted(ready, key=lambda kx: done[kx[0]])[:self.mu]
+        X = np.stack([x for _, x in ranked])           # unit-cube points
+        old_mean = self.mean.copy()
+        self.mean = self.weights @ X
+        y = (self.mean - old_mean) / self.sigma
+        C_inv_sqrt = np.linalg.inv(np.linalg.cholesky(
+            self.C + 1e-10 * np.eye(self.n))).T
+        self.ps = ((1 - self.cs) * self.ps
+                   + np.sqrt(self.cs * (2 - self.cs) * self.mu_eff)
+                   * C_inv_sqrt @ y)
+        hsig = (np.linalg.norm(self.ps)
+                / np.sqrt(1 - (1 - self.cs) ** (2 * (self.gen + 1)))
+                < (1.4 + 2 / (self.n + 1)) * self.chi_n)
+        self.pc = ((1 - self.cc) * self.pc
+                   + hsig * np.sqrt(self.cc * (2 - self.cc) * self.mu_eff) * y)
+        artmp = (X - old_mean) / self.sigma
+        self.C = ((1 - self.c1 - self.cmu) * self.C
+                  + self.c1 * (np.outer(self.pc, self.pc)
+                               + (not hsig) * self.cc * (2 - self.cc) * self.C)
+                  + self.cmu * (artmp.T * self.weights) @ artmp)
+        self.sigma *= np.exp((self.cs / self.damps)
+                             * (np.linalg.norm(self.ps) / self.chi_n - 1))
+        self.sigma = float(np.clip(self.sigma, 1e-4, 1.0))
+        self.gen += 1
+        self._pending = [(k, x) for k, x in self._pending if k not in done]
+
+    def suggest(self, count, history):
+        done = self._finished(history)
+        if not self._warmed and done and not self._pending:
+            # restart / warm start: center on the incumbent
+            best = min(done, key=lambda t: t.value)
+            self.mean = np.clip(self.space.to_unit(best.params), 0.05, 0.95)
+            self._warmed = True
+        self._tell(history)
+        A = np.linalg.cholesky(self.C + 1e-10 * np.eye(self.n))
+        out = []
+        for _ in range(count):
+            x = np.clip(self.mean + self.sigma
+                        * A @ self.rng.standard_normal(self.n), 0.0, 1.0)
+            params = self.space.from_unit(x)
+            self._pending.append((self._key(params), x))
+            out.append(params)
+        return out

@@ -1,0 +1,90 @@
+"""Tree-structured Parzen Estimator — Katib's default model-based algorithm
+(⊘ katib pkg/suggestion/v1beta1/hyperopt `tpe`; Bergstra et al. 2011).
+
+Per-dimension TPE over the unit-cube embedding: split observed points into
+good (best gamma-quantile) and bad sets, fit Parzen windows l(x) and g(x),
+sample candidates from l, keep the candidate maximizing l(x)/g(x).
+Categorical axes use re-weighted categorical distributions instead of
+Gaussians, as in hyperopt.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import Algorithm, register
+
+
+def _parzen_logpdf(x: np.ndarray, centers: np.ndarray, bw: float) -> np.ndarray:
+    """log of a uniform-weight Gaussian mixture on [0,1], one kernel per
+    center, with a flat prior kernel for unexplored mass."""
+    # prior: uniform on [0,1] == N(0.5, 1) truncated-ish; use wide gaussian
+    centers = np.concatenate([centers, [0.5]])
+    bws = np.full(len(centers), bw)
+    bws[-1] = 1.0
+    d = (x[:, None] - centers[None, :]) / bws[None, :]
+    log_k = -0.5 * d * d - np.log(bws[None, :] * np.sqrt(2 * np.pi))
+    m = log_k.max(axis=1, keepdims=True)
+    return (m + np.log(np.exp(log_k - m).sum(axis=1, keepdims=True))
+            ).ravel() - np.log(len(centers))
+
+
+@register("tpe")
+class TPE(Algorithm):
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        self.gamma = self._setting("gamma", 0.25)
+        self.n_startup = int(self._setting("n_initial_points", 10))
+        self.n_candidates = int(self._setting("n_ei_candidates", 24))
+
+    def suggest(self, count, history):
+        done = self._finished(history)
+        out = []
+        for _ in range(count):
+            if len(done) < self.n_startup:
+                out.append(self.space.sample(self.rng))
+                continue
+            X = np.stack([self.space.to_unit(t.params) for t in done])
+            y = np.array([t.value for t in done])
+            n_good = max(1, int(np.ceil(self.gamma * len(done))))
+            order = np.argsort(y)
+            good, bad = X[order[:n_good]], X[order[n_good:]]
+            point = np.empty(len(self.space))
+            for d, param in enumerate(self.space.parameters):
+                k = param.n_choices
+                if param.type == "categorical" and k:
+                    point[d] = self._categorical_dim(good[:, d], bad[:, d], k)
+                else:
+                    point[d] = self._continuous_dim(good[:, d], bad[:, d])
+            out.append(self.space.from_unit(point))
+            # virtual result at the good-set median keeps a batch diverse
+            done = done + [type(done[0])(params=out[-1],
+                                         value=float(np.median(y)))]
+        return out
+
+    def _continuous_dim(self, good: np.ndarray, bad: np.ndarray) -> float:
+        bw_g = max(1.0 / (1 + len(good)), good.std() + 1e-3)
+        bw_b = max(1.0 / (1 + len(bad)), bad.std() + 1e-3 if len(bad) else 1.0)
+        idx = self.rng.integers(0, len(good) + 1, size=self.n_candidates)
+        cand = np.where(
+            idx < len(good),
+            np.clip(good[np.minimum(idx, len(good) - 1)]
+                    + self.rng.normal(0, bw_g, self.n_candidates), 0, 1),
+            self.rng.uniform(size=self.n_candidates))
+        score = _parzen_logpdf(cand, good, bw_g) - _parzen_logpdf(
+            cand, bad if len(bad) else np.array([0.5]), bw_b)
+        return float(cand[np.argmax(score)])
+
+    def _categorical_dim(self, good: np.ndarray, bad: np.ndarray,
+                         k: int) -> float:
+        def weights(col: np.ndarray) -> np.ndarray:
+            idx = np.minimum((col * k).astype(int), k - 1)
+            return np.bincount(idx, minlength=k) + 1.0  # +1 prior
+        wg = weights(good)
+        wb = weights(bad) if len(bad) else np.ones(k)
+        ratio = (wg / wg.sum()) / (wb / wb.sum())
+        # sample from l, weight by ratio: draw candidates ∝ wg, pick max ratio
+        cands = self.rng.choice(k, size=min(self.n_candidates, 4 * k),
+                                p=wg / wg.sum())
+        best = cands[np.argmax(ratio[cands])]
+        return (best + 0.5) / k

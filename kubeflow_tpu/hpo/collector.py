@@ -1,0 +1,127 @@
+"""Trial metrics collector — Katib's metrics-collector sidecar (SURVEY.md
+§2.3, ⊘ katib pkg/metricscollector/v1beta1 + webhook inject_webhook.go).
+
+The reference injects a sidecar that scrapes stdout regexes or tfevents and
+pushes observations to the db-manager. Here the trial controller attaches a
+collector to each trial: a `FileTail` thread that follows the trainer's
+structured JSONL metric stream *while the job runs* (so early stopping sees
+intermediate metrics), plus a final text scrape of pod logs for the
+reference-style `name=value` stdout protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Sequence
+
+from kubeflow_tpu.hpo.observations import ObservationDB
+
+# matches "loss=0.123", "accuracy = 97.5" — the Katib stdout format
+_KV_RE = re.compile(
+    r"(?P<name>[A-Za-z][\w./-]*)\s*=\s*"
+    r"(?P<value>[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)(?![\w.])")
+_STEP_RE = re.compile(r"\[step (?P<step>\d+)\]")
+
+
+def parse_jsonl_line(line: str) -> tuple[int, dict[str, float]] | None:
+    """One MetricsWriter record → (step, {metric: value})."""
+    line = line.strip()
+    if not line or not line.startswith("{"):
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if "metrics" not in rec:
+        return None
+    out = {}
+    for k, v in rec["metrics"].items():
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return int(rec.get("step", 0)), out
+
+
+def collect_text(db: ObservationDB, trial: str, text: str,
+                 metric_names: Sequence[str]) -> int:
+    """Scrape free-form log text (JSONL lines and `k=v` pairs). Returns the
+    number of observations recorded."""
+    wanted = set(metric_names)
+    n = 0
+    step = 0
+    for line in text.splitlines():
+        rec = parse_jsonl_line(line)
+        if rec is not None:
+            step, metrics = rec
+            for k, v in metrics.items():
+                if k in wanted:
+                    db.report(trial, k, v, step)
+                    n += 1
+            continue
+        m = _STEP_RE.search(line)
+        if m:
+            step = int(m.group("step"))
+        for kv in _KV_RE.finditer(line):
+            if kv.group("name") in wanted:
+                db.report(trial, kv.group("name"),
+                          float(kv.group("value")), step)
+                n += 1
+    return n
+
+
+class FileTail:
+    """Follows a JSONL metrics file, reporting new records into the DB.
+    Survives the file not existing yet (trainer creates it on first write)."""
+
+    def __init__(self, db: ObservationDB, trial: str, path: str,
+                 metric_names: Sequence[str], poll: float = 0.2):
+        self.db = db
+        self.trial = trial
+        self.path = path
+        self.wanted = set(metric_names)
+        self.poll = poll
+        self._stop = threading.Event()
+        self._pos = 0
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"collector-{self.trial}")
+        self._thread.start()
+
+    def stop(self, final_pass: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if final_pass:
+            self._drain()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            self._drain()
+
+    def _drain(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as fh:
+                fh.seek(self._pos)
+                chunk = fh.read()
+                self._pos = fh.tell()
+        except OSError:
+            return
+        # only complete lines; keep a partial tail for the next drain
+        if chunk and not chunk.endswith("\n"):
+            cut = chunk.rfind("\n") + 1
+            self._pos -= len(chunk) - cut
+            chunk = chunk[:cut]
+        for line in chunk.splitlines():
+            rec = parse_jsonl_line(line)
+            if rec is None:
+                continue
+            step, metrics = rec
+            for k, v in metrics.items():
+                if k in self.wanted:
+                    self.db.report(self.trial, k, v, step)

@@ -1,0 +1,51 @@
+"""Early-stopping rules — Katib's medianstop service (SURVEY.md §2.3,
+⊘ katib pkg/earlystopping/v1beta1/medianstop/service.py).
+
+Median-stopping rule (Golovin et al., Vizier): stop a running trial at step s
+if its best objective so far is worse than the median of the *running
+averages up to step s* of all completed trials. Settings (Katib names):
+`min_trials_required` (default 3), `start_step` (default 4).
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Sequence
+
+from kubeflow_tpu.hpo.observations import ObservationDB
+
+
+class MedianStop:
+    name = "medianstop"
+
+    def __init__(self, settings: dict | None = None):
+        s = settings or {}
+        self.min_trials = int(s.get("min_trials_required", 3))
+        self.start_step = int(s.get("start_step", 4))
+
+    def should_stop(self, db: ObservationDB, trial: str, metric: str,
+                    maximize: bool, completed: Sequence[str]) -> bool:
+        if len(completed) < self.min_trials:
+            return False
+        obs = db.get(trial, metric)
+        if not obs:
+            return False
+        step = obs[-1].step
+        if step < self.start_step:
+            return False
+        best = (max if maximize else min)(o.value for o in obs)
+        avgs = []
+        for other in completed:
+            vals = [o.value for o in db.get(other, metric) if o.step <= step]
+            if vals:
+                avgs.append(sum(vals) / len(vals))
+        if len(avgs) < self.min_trials:
+            return False
+        med = statistics.median(avgs)
+        return best < med if maximize else best > med
+
+
+def make_early_stopping(name: str, settings: dict | None = None):
+    if name in ("medianstop", "median"):
+        return MedianStop(settings)
+    raise ValueError(f"unknown early-stopping algorithm {name!r}")

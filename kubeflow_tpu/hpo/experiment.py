@@ -1,0 +1,329 @@
+"""Experiment + Suggestion controllers — Katib's experiment/suggestion
+reconcilers (SURVEY.md §2.3, §3.3, ⊘ katib
+pkg/controller.v1beta1/experiment/experiment_controller.go and
+pkg/controller.v1beta1/suggestion/suggestion_controller.go).
+
+Flow (mirrors §3.3): Experiment creates one Suggestion; the experiment loop
+raises `suggestion.spec.requests` as budget allows; the suggestion controller
+runs the algorithm (the per-experiment "service") and appends parameter
+assignments to `suggestion.status.assignments`; the experiment turns each
+fresh assignment into a Trial; trial observations flow back as algorithm
+history. Budget semantics are Katib's: `parallelTrialCount`, `maxTrialCount`,
+`maxFailedTrialCount`, optional objective `goal`.
+
+Experiment spec:
+    kind: Experiment
+    spec:
+      objective:
+        type: minimize | maximize
+        objectiveMetricName: loss
+        goal: 0.01                       # optional
+        additionalMetricNames: [acc]
+      algorithm: {algorithmName: tpe, algorithmSettings: {...}}
+      parameters: [{name, parameterType, feasibleSpace}, ...]
+      parallelTrialCount: 3
+      maxTrialCount: 12
+      maxFailedTrialCount: 3
+      earlyStopping: {algorithmName: medianstop, algorithmSettings: {...}}
+      trialTemplate:
+        trialParameters: [{name: lr, reference: lr}, ...]   # optional mapping
+        spec: <JAXJob spec with ${trialParameters.*}>
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished, set_condition)
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+from kubeflow_tpu.hpo import algorithms as alg
+from kubeflow_tpu.hpo.observations import ObservationDB, default_db
+from kubeflow_tpu.hpo.space import SearchSpace, SpaceError
+from kubeflow_tpu.hpo.trial import (EXPERIMENT_LABEL, TRIAL_KIND,
+                                    trial_finished)
+
+EXPERIMENT_KIND = "Experiment"
+SUGGESTION_KIND = "Suggestion"
+
+
+def validate_experiment(exp: dict[str, Any]) -> list[str]:
+    errs = []
+    spec = exp.get("spec", {})
+    obj = spec.get("objective", {})
+    if obj.get("type", "minimize") not in ("minimize", "maximize"):
+        errs.append(f"objective.type invalid: {obj.get('type')}")
+    if not obj.get("objectiveMetricName"):
+        errs.append("objective.objectiveMetricName is required")
+    name = spec.get("algorithm", {}).get("algorithmName", "random")
+    if name not in alg.algorithm_names():
+        errs.append(f"unknown algorithm {name!r}")
+    try:
+        SearchSpace.parse(spec.get("parameters", []))
+    except SpaceError as e:
+        errs.append(f"parameters: {e}")
+    tt = spec.get("trialTemplate", {})
+    if "spec" not in tt:
+        errs.append("trialTemplate.spec is required")
+    for key in ("parallelTrialCount", "maxTrialCount", "maxFailedTrialCount"):
+        v = spec.get(key)
+        if v is not None and (not isinstance(v, int) or v < 1):
+            errs.append(f"{key} must be a positive int")
+    return errs
+
+
+class SuggestionController(Controller):
+    """Runs the algorithm service per experiment. History is rebuilt from
+    trial statuses each call, so a restarted controller resumes cleanly
+    (resumePolicy analog)."""
+
+    kind = SUGGESTION_KIND
+    resync_period = 0.5
+
+    def __init__(self, cluster, db: ObservationDB | None = None):
+        super().__init__(cluster)
+        self.db = db or default_db()
+        self._algos: dict[str, alg.Algorithm] = {}
+
+    def _algorithm(self, sug: dict[str, Any]) -> alg.Algorithm:
+        uid = sug["metadata"]["uid"]
+        if uid not in self._algos:
+            spec = sug["spec"]
+            self._algos[uid] = alg.make_algorithm(
+                spec.get("algorithmName", "random"),
+                SearchSpace.parse(spec["parameters"]),
+                spec.get("algorithmSettings"),
+                seed=int(sug["metadata"]["uid"][:8], 16))
+        return self._algos[uid]
+
+    def _history(self, sug: dict[str, Any]) -> list[alg.TrialResult]:
+        ns = sug["metadata"].get("namespace", "default")
+        maximize = sug["spec"].get("objectiveType") == "maximize"
+        history = []
+        for t in self.store.list(TRIAL_KIND, ns, labels={
+                EXPERIMENT_LABEL: sug["spec"].get("experiment", "")}):
+            if not trial_finished(t["status"]):
+                continue
+            value = t["status"].get("objectiveValue")
+            if value is not None and maximize:
+                value = -value
+            status = ("Succeeded" if has_condition(
+                t["status"], JobConditionType.SUCCEEDED) else
+                "EarlyStopped" if has_condition(t["status"], "EarlyStopped")
+                else "Failed")
+            history.append(alg.TrialResult(
+                params=t["spec"].get("parameterAssignments", {}),
+                value=value, status=status))
+        return history
+
+    def reconcile(self, sug: dict[str, Any]) -> float | None:
+        requests = sug["spec"].get("requests", 0)
+        assignments = sug["status"].get("assignments", [])
+        need = requests - len(assignments)
+        if need <= 0:
+            return None
+        algorithm = self._algorithm(sug)
+        batch = algorithm.suggest(need, self._history(sug))
+        if not batch:
+            # algorithm exhausted (e.g. full grid enumerated)
+            self.store.mutate(
+                SUGGESTION_KIND, sug["metadata"]["name"],
+                lambda o: o["status"].update(exhausted=True),
+                sug["metadata"].get("namespace", "default"))
+            return None
+        self.store.mutate(
+            SUGGESTION_KIND, sug["metadata"]["name"],
+            lambda o: o["status"].setdefault("assignments", []).extend(batch),
+            sug["metadata"].get("namespace", "default"))
+        return 0.0
+
+
+class ExperimentController(Controller):
+    kind = EXPERIMENT_KIND
+    owned_kinds = (SUGGESTION_KIND, TRIAL_KIND)
+    resync_period = 0.5
+
+    def reconcile(self, exp: dict[str, Any]) -> float | None:
+        name = exp["metadata"]["name"]
+        ns = exp["metadata"].get("namespace", "default")
+        status = exp["status"]
+        if is_finished(status):
+            return None
+
+        errs = validate_experiment(exp)
+        if errs:
+            self._finish(exp, JobConditionType.FAILED, "InvalidSpec",
+                         "; ".join(errs))
+            return None
+        if not status.get("conditions"):
+            self.store.mutate(EXPERIMENT_KIND, name, lambda o: (
+                o["status"].update(startTime=time.time()),
+                set_condition(o["status"], JobConditionType.CREATED,
+                              "ExperimentCreated", "experiment created")), ns)
+            return 0.0
+
+        spec = exp["spec"]
+        trials = self.store.list(TRIAL_KIND, ns,
+                                 labels={EXPERIMENT_LABEL: name})
+        running = [t for t in trials if not trial_finished(t["status"])]
+        succeeded = [t for t in trials if has_condition(
+            t["status"], JobConditionType.SUCCEEDED)]
+        early = [t for t in trials if has_condition(t["status"],
+                                                    "EarlyStopped")]
+        failed = [t for t in trials if has_condition(
+            t["status"], JobConditionType.FAILED)]
+
+        optimal = self._optimal(spec, succeeded + early)
+        counts = {"running": len(running), "succeeded": len(succeeded),
+                  "earlyStopped": len(early), "failed": len(failed),
+                  "created": len(trials)}
+
+        def write(o):
+            o["status"]["trials"] = counts
+            if optimal is not None:
+                o["status"]["currentOptimalTrial"] = optimal
+            if running:
+                set_condition(o["status"], JobConditionType.RUNNING,
+                              "ExperimentRunning", "trials running")
+        self.store.mutate(EXPERIMENT_KIND, name, write, ns)
+
+        max_failed = spec.get("maxFailedTrialCount", 3)
+        if len(failed) > max_failed:
+            self._finish(exp, JobConditionType.FAILED,
+                         "MaxFailedTrialsReached",
+                         f"{len(failed)} failed trials > {max_failed}")
+            return None
+        if self._goal_reached(spec, optimal):
+            self._finish(exp, JobConditionType.SUCCEEDED, "GoalReached",
+                         f"objective goal reached: {optimal['observation']}")
+            return None
+        max_trials = spec.get("maxTrialCount", 12)
+        done = len(succeeded) + len(early) + len(failed)
+        sug = self.store.try_get(SUGGESTION_KIND, name, ns)
+        exhausted = bool(sug and sug["status"].get("exhausted"))
+        if (done >= max_trials or (exhausted and not running
+                                   and self._consumed(sug) >= len(trials))):
+            self._finish(exp, JobConditionType.SUCCEEDED, "MaxTrialsReached",
+                         f"{done} trials completed")
+            return None
+
+        # -- budget: request + materialize suggestions ------------------------
+        parallel = spec.get("parallelTrialCount", 3)
+        want_new = min(parallel - len(running), max_trials - len(trials))
+        if want_new > 0:
+            sug = self._ensure_suggestion(exp)
+            target = len(trials) + want_new
+            if sug["spec"].get("requests", 0) < target:
+                self.store.mutate(
+                    SUGGESTION_KIND, name,
+                    lambda o: o["spec"].update(requests=target), ns)
+            for idx, assignment in enumerate(
+                    sug["status"].get("assignments", [])):
+                self._ensure_trial(exp, idx, assignment)
+        return 0.2
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _consumed(sug) -> int:
+        return len(sug["status"].get("assignments", [])) if sug else 0
+
+    def _ensure_suggestion(self, exp: dict[str, Any]) -> dict[str, Any]:
+        name = exp["metadata"]["name"]
+        ns = exp["metadata"].get("namespace", "default")
+        sug = self.store.try_get(SUGGESTION_KIND, name, ns)
+        if sug is not None:
+            return sug
+        spec = exp["spec"]
+        sug = new_resource(SUGGESTION_KIND, name, spec={
+            "experiment": name,
+            "algorithmName": spec.get("algorithm", {}).get("algorithmName",
+                                                           "random"),
+            "algorithmSettings": spec.get("algorithm", {}).get(
+                "algorithmSettings", {}),
+            "parameters": spec.get("parameters", []),
+            "objectiveType": spec.get("objective", {}).get("type",
+                                                           "minimize"),
+            "requests": 0,
+        }, namespace=ns, owner=exp)
+        try:
+            return self.store.create(sug)
+        except AlreadyExistsError:
+            return self.store.get(SUGGESTION_KIND, name, ns)
+
+    def _trial_spec(self, exp: dict[str, Any],
+                    assignment: dict[str, Any]) -> dict[str, Any]:
+        spec = exp["spec"]
+        tt = spec.get("trialTemplate", {})
+        # trialParameters may rename: template placeholder name → space name
+        mapping = {p.get("name"): p.get("reference", p.get("name"))
+                   for p in tt.get("trialParameters", [])}
+        if mapping:
+            params = {tp_name: assignment[ref]
+                      for tp_name, ref in mapping.items()}
+        else:
+            params = dict(assignment)
+        return {
+            "experiment": exp["metadata"]["name"],
+            "parameterAssignments": params,
+            "objective": spec.get("objective", {}),
+            "template": tt["spec"],
+            "earlyStopping": spec.get("earlyStopping"),
+        }
+
+    def _ensure_trial(self, exp: dict[str, Any], idx: int,
+                      assignment: dict[str, Any]) -> None:
+        name = f"{exp['metadata']['name']}-{idx:04d}"
+        ns = exp["metadata"].get("namespace", "default")
+        if self.store.try_get(TRIAL_KIND, name, ns) is not None:
+            return
+        trial = new_resource(
+            TRIAL_KIND, name, spec=self._trial_spec(exp, assignment),
+            namespace=ns,
+            labels={EXPERIMENT_LABEL: exp["metadata"]["name"]},
+            owner=exp)
+        try:
+            self.store.create(trial)
+        except AlreadyExistsError:
+            pass
+
+    def _optimal(self, spec: dict[str, Any],
+                 finished: list[dict[str, Any]]) -> dict[str, Any] | None:
+        maximize = spec.get("objective", {}).get("type") == "maximize"
+        best, best_v = None, None
+        for t in finished:
+            v = t["status"].get("objectiveValue")
+            if v is None:
+                continue
+            if best_v is None or (v > best_v if maximize else v < best_v):
+                best, best_v = t, v
+        if best is None:
+            return None
+        return {
+            "bestTrialName": best["metadata"]["name"],
+            "parameterAssignments": best["spec"].get("parameterAssignments",
+                                                     {}),
+            "observation": best["status"].get("observation"),
+            "objectiveValue": best_v,
+        }
+
+    def _goal_reached(self, spec: dict[str, Any],
+                      optimal: dict[str, Any] | None) -> bool:
+        goal = spec.get("objective", {}).get("goal")
+        if goal is None or optimal is None:
+            return False
+        v = optimal["objectiveValue"]
+        if spec.get("objective", {}).get("type") == "maximize":
+            return v >= goal
+        return v <= goal
+
+    def _finish(self, exp: dict[str, Any], ctype: str, reason: str,
+                message: str) -> None:
+        ns = exp["metadata"].get("namespace", "default")
+        self.store.mutate(EXPERIMENT_KIND, exp["metadata"]["name"],
+                          lambda o: (
+                              o["status"].update(completionTime=time.time()),
+                              set_condition(o["status"], ctype, reason,
+                                            message)), ns)

@@ -1,0 +1,123 @@
+"""Observation-log store — Katib's db-manager (SURVEY.md §2.3, ⊘ katib
+`api/v1beta1/api.proto` ReportObservationLog/GetObservationLog over MySQL).
+
+Stores per-trial metric time series. Backed by sqlite (the environment's
+MySQL stand-in) so logs survive process restarts and experiments can resume
+(`resumePolicy`), or fully in-memory for tests. A process-wide default
+instance lets in-process trial workers report metrics directly — the
+metrics-collector sidecar path for thread-backend pods.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterable
+
+
+@dataclass(frozen=True)
+class Observation:
+    trial: str
+    metric: str
+    value: float
+    step: int
+    timestamp: float
+
+
+class ObservationDB:
+    """Thread-safe metric log: report / get / latest / delete."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS observation_logs ("
+            " trial TEXT NOT NULL, metric TEXT NOT NULL,"
+            " value REAL NOT NULL, step INTEGER NOT NULL, ts REAL NOT NULL)")
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS idx_trial_metric"
+            " ON observation_logs (trial, metric, step)")
+        self._db.commit()
+
+    def report(self, trial: str, metric: str, value: float,
+               step: int = 0, timestamp: float | None = None) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO observation_logs VALUES (?,?,?,?,?)",
+                (trial, metric, float(value), int(step),
+                 time.time() if timestamp is None else timestamp))
+            self._db.commit()
+
+    def report_many(self, obs: Iterable[Observation]) -> None:
+        with self._lock:
+            self._db.executemany(
+                "INSERT INTO observation_logs VALUES (?,?,?,?,?)",
+                [(o.trial, o.metric, o.value, o.step, o.timestamp)
+                 for o in obs])
+            self._db.commit()
+
+    def get(self, trial: str, metric: str | None = None) -> list[Observation]:
+        q = ("SELECT trial, metric, value, step, ts FROM observation_logs"
+             " WHERE trial = ?")
+        args: tuple = (trial,)
+        if metric is not None:
+            q += " AND metric = ?"
+            args += (metric,)
+        q += " ORDER BY step, ts"
+        with self._lock:
+            rows = self._db.execute(q, args).fetchall()
+        return [Observation(*r) for r in rows]
+
+    def latest(self, trial: str, metric: str) -> Observation | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT trial, metric, value, step, ts FROM observation_logs"
+                " WHERE trial = ? AND metric = ?"
+                " ORDER BY step DESC, ts DESC LIMIT 1",
+                (trial, metric)).fetchone()
+        return None if row is None else Observation(*row)
+
+    def best(self, trial: str, metric: str, maximize: bool) -> float | None:
+        with self._lock:
+            row = self._db.execute(
+                f"SELECT {'MAX' if maximize else 'MIN'}(value)"
+                " FROM observation_logs WHERE trial = ? AND metric = ?",
+                (trial, metric)).fetchone()
+        return None if row is None or row[0] is None else float(row[0])
+
+    def delete_trial(self, trial: str) -> None:
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM observation_logs WHERE trial = ?", (trial,))
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+_default: ObservationDB | None = None
+_default_lock = threading.Lock()
+
+
+def default_db() -> ObservationDB:
+    """Process-wide DB used by in-process workers to report metrics
+    (set_default_db from tests/clusters to scope it)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ObservationDB()
+        return _default
+
+
+def set_default_db(db: ObservationDB | None) -> None:
+    global _default
+    with _default_lock:
+        _default = db
+
+
+def report_metric(trial: str, metric: str, value: float, step: int = 0) -> None:
+    """Convenience for worker code: `report_metric(env['KTPU_TRIAL'], ...)`."""
+    default_db().report(trial, metric, value, step)

@@ -1,0 +1,183 @@
+"""Hyperparameter search space — Katib's `parameters:` block (SURVEY.md §2.3,
+⊘ katib pkg/apis/controller/experiments/v1beta1 `ParameterSpec`/`FeasibleSpace`).
+
+Four parameter types with the Katib YAML shape:
+
+    parameters:
+      - name: lr
+        parameterType: double          # double | int | categorical | discrete
+        feasibleSpace: {min: 1e-4, max: 1e-1, scale: log}   # step optional
+      - name: optimizer
+        parameterType: categorical
+        feasibleSpace: {list: [adamw, sgd, lion]}
+
+Beyond the Katib shape we add a *unit-cube embedding* (`to_unit`/`from_unit`):
+every parameter maps to [0,1], log-scaled where requested, categoricals by
+index. Model-based algorithms (GP, TPE, CMA-ES) operate on the cube and decode
+back — that keeps each algorithm free of per-type branching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import numpy as np
+
+
+class SpaceError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter:
+    name: str
+    type: str                       # double | int | categorical | discrete
+    min: float | None = None
+    max: float | None = None
+    step: float | None = None
+    values: tuple[Any, ...] = ()    # categorical/discrete choices
+    scale: str = "linear"           # linear | log
+
+    def __post_init__(self):
+        if self.type in ("double", "int"):
+            if self.min is None or self.max is None:
+                raise SpaceError(f"{self.name}: min/max required for {self.type}")
+            if self.max <= self.min:
+                raise SpaceError(f"{self.name}: max must be > min")
+            if self.scale == "log" and self.min <= 0:
+                raise SpaceError(f"{self.name}: log scale requires min > 0")
+        elif self.type in ("categorical", "discrete"):
+            if not self.values:
+                raise SpaceError(f"{self.name}: list required for {self.type}")
+        else:
+            raise SpaceError(f"{self.name}: unknown parameterType {self.type!r}")
+        if self.scale not in ("linear", "log"):
+            raise SpaceError(f"{self.name}: unknown scale {self.scale!r}")
+
+    # -- unit-cube embedding --------------------------------------------------
+
+    @property
+    def n_choices(self) -> int:
+        """Number of discrete choices (0 → continuous)."""
+        if self.type in ("categorical", "discrete"):
+            return len(self.values)
+        if self.type == "int" and self.step in (None, 1):
+            return int(self.max - self.min) + 1
+        if self.step:
+            return int((self.max - self.min) / self.step) + 1
+        return 0
+
+    def _lo_hi(self) -> tuple[float, float]:
+        if self.scale == "log":
+            return math.log(self.min), math.log(self.max)
+        return float(self.min), float(self.max)
+
+    def from_unit(self, u: float) -> Any:
+        u = min(max(float(u), 0.0), 1.0)
+        if self.type in ("categorical", "discrete"):
+            idx = min(int(u * len(self.values)), len(self.values) - 1)
+            return self.values[idx]
+        lo, hi = self._lo_hi()
+        x = lo + u * (hi - lo)
+        if self.scale == "log":
+            x = math.exp(x)
+        if self.step:
+            x = self.min + round((x - self.min) / self.step) * self.step
+        x = min(max(x, self.min), self.max)
+        return int(round(x)) if self.type == "int" else float(x)
+
+    def to_unit(self, value: Any) -> float:
+        if self.type in ("categorical", "discrete"):
+            try:
+                idx = self.values.index(value)
+            except ValueError:
+                raise SpaceError(f"{self.name}: {value!r} not in choices")
+            return (idx + 0.5) / len(self.values)
+        lo, hi = self._lo_hi()
+        x = math.log(float(value)) if self.scale == "log" else float(value)
+        return min(max((x - lo) / (hi - lo), 0.0), 1.0)
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        return self.from_unit(rng.uniform())
+
+    def grid(self, n: int) -> list[Any]:
+        """Up to n distinct values spanning the space (grid search)."""
+        if self.type in ("categorical", "discrete"):
+            return list(self.values)
+        k = self.n_choices
+        if 0 < k <= n:
+            n = k
+        if n == 1:
+            return [self.from_unit(0.5)]
+        out: list[Any] = []
+        for i in range(n):
+            v = self.from_unit(i / (n - 1))
+            if not out or v != out[-1]:
+                out.append(v)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpace:
+    parameters: tuple[Parameter, ...]
+
+    @classmethod
+    def parse(cls, specs: Sequence[dict[str, Any]]) -> "SearchSpace":
+        """From the Katib-shaped `parameters:` list."""
+        params = []
+        seen: set[str] = set()
+        for p in specs:
+            name = p.get("name")
+            if not name:
+                raise SpaceError("parameter missing name")
+            if name in seen:
+                raise SpaceError(f"duplicate parameter {name!r}")
+            seen.add(name)
+            fs = p.get("feasibleSpace", {})
+            ptype = p.get("parameterType", "double")
+            values = fs.get("list", ())
+            if ptype == "discrete":
+                values = tuple(
+                    float(v) if isinstance(v, str) else v for v in values)
+            params.append(Parameter(
+                name=name, type=ptype,
+                min=None if fs.get("min") is None else float(fs["min"]),
+                max=None if fs.get("max") is None else float(fs["max"]),
+                step=None if fs.get("step") in (None, "") else float(fs["step"]),
+                values=tuple(values),
+                scale=fs.get("scale", "linear")))
+        if not params:
+            raise SpaceError("search space is empty")
+        return cls(parameters=tuple(params))
+
+    def __len__(self) -> int:
+        return len(self.parameters)
+
+    def __iter__(self):
+        return iter(self.parameters)
+
+    def names(self) -> list[str]:
+        return [p.name for p in self.parameters]
+
+    def sample(self, rng: np.random.Generator) -> dict[str, Any]:
+        return {p.name: p.sample(rng) for p in self.parameters}
+
+    def to_unit(self, assignment: dict[str, Any]) -> np.ndarray:
+        return np.array([p.to_unit(assignment[p.name])
+                         for p in self.parameters])
+
+    def from_unit(self, u: np.ndarray) -> dict[str, Any]:
+        return {p.name: p.from_unit(u[i])
+                for i, p in enumerate(self.parameters)}
+
+    def cardinality(self) -> float:
+        """Total distinct points (inf if any axis is continuous)."""
+        total = 1.0
+        for p in self.parameters:
+            k = p.n_choices
+            if k == 0:
+                return math.inf
+            total *= k
+        return total

@@ -1,0 +1,278 @@
+"""Trial controller — Katib's trial reconciler (SURVEY.md §2.3, §3.3,
+⊘ katib pkg/controller.v1beta1/trial/trial_controller.go).
+
+A Trial materializes one point of the search space: it instantiates the
+experiment's trialTemplate (a JAXJob spec with `${trialParameters.*}`
+placeholders substituted), attaches a metrics collector to the running job,
+extracts the objective observation on completion, and applies early stopping
+against sibling trials.
+
+Spec:
+    kind: Trial
+    spec:
+      experiment: my-exp
+      parameterAssignments: {lr: 0.01, layers: 4}
+      objective: {type: minimize, objectiveMetricName: loss,
+                  additionalMetricNames: [...], metricStrategies: {loss: min}}
+      template: <JAXJob spec>          # placeholders already wired by the
+      earlyStopping: {...}             # experiment controller
+Status: conditions (Created → Running → Succeeded | Failed | EarlyStopped)
+plus `observation: {metrics: [{name, latest, min, max}]}`.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+import threading
+from typing import Any
+
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished, set_condition)
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.jobs import JOB_KIND, JOB_NAME_LABEL
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+from kubeflow_tpu.hpo.collector import FileTail, collect_text
+from kubeflow_tpu.hpo.earlystopping import make_early_stopping
+from kubeflow_tpu.hpo.observations import ObservationDB, default_db
+
+TRIAL_KIND = "Trial"
+EXPERIMENT_LABEL = "kubeflow-tpu/experiment"
+EARLY_STOPPED = "EarlyStopped"
+
+_PLACEHOLDER = re.compile(r"\$\{trialParameters\.([\w.-]+)\}")
+
+
+def trial_finished(status: dict[str, Any]) -> bool:
+    return is_finished(status) or has_condition(status, EARLY_STOPPED)
+
+
+def substitute(node: Any, assignments: dict[str, Any]) -> Any:
+    """Replace ${trialParameters.x} through a spec tree. A string that is
+    exactly one placeholder becomes the typed value; mixed strings
+    interpolate."""
+    if isinstance(node, dict):
+        return {k: substitute(v, assignments) for k, v in node.items()}
+    if isinstance(node, list):
+        return [substitute(v, assignments) for v in node]
+    if isinstance(node, str):
+        m = _PLACEHOLDER.fullmatch(node)
+        if m:
+            if m.group(1) not in assignments:
+                raise KeyError(f"unresolved trial parameter {m.group(1)!r}")
+            return assignments[m.group(1)]
+        return _PLACEHOLDER.sub(
+            lambda mm: str(assignments[mm.group(1)]), node)
+    return node
+
+
+class TrialController(Controller):
+    kind = TRIAL_KIND
+    owned_kinds = (JOB_KIND,)
+    resync_period = 0.5   # early stopping needs a frequent look
+
+    def __init__(self, cluster, db: ObservationDB | None = None,
+                 metrics_dir: str | None = None):
+        super().__init__(cluster)
+        self.db = db or default_db()
+        self.metrics_dir = metrics_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-metrics")
+        os.makedirs(self.metrics_dir, exist_ok=True)
+        self._collectors: dict[str, FileTail] = {}
+        self._clock = threading.Lock()
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, trial: dict[str, Any]) -> float | None:
+        name = trial["metadata"]["name"]
+        ns = trial["metadata"].get("namespace", "default")
+        status = trial["status"]
+        if trial_finished(status):
+            self._stop_collector(trial, final=False)
+            return None
+
+        if not status.get("conditions"):
+            self.store.mutate(TRIAL_KIND, name, lambda o: set_condition(
+                o["status"], JobConditionType.CREATED, "TrialCreated",
+                f"Trial {name} created."), ns)
+            return 0.0
+
+        job = self.store.try_get(JOB_KIND, name, ns)
+        if job is None:
+            self._create_job(trial)
+            return 0.1
+
+        if has_condition(job["status"], JobConditionType.SUCCEEDED):
+            self._complete(trial, job, JobConditionType.SUCCEEDED)
+            return None
+        if has_condition(job["status"], JobConditionType.FAILED):
+            self._complete(trial, job, JobConditionType.FAILED)
+            return None
+
+        if has_condition(job["status"], JobConditionType.RUNNING):
+            if not has_condition(status, JobConditionType.RUNNING):
+                self.store.mutate(TRIAL_KIND, name, lambda o: set_condition(
+                    o["status"], JobConditionType.RUNNING, "JobRunning",
+                    "trial job is running"), ns)
+            self._ensure_collector(trial)
+            if self._maybe_early_stop(trial):
+                return None
+        return 0.2
+
+    # -- job materialization --------------------------------------------------
+
+    def _metrics_path(self, trial: dict[str, Any]) -> str:
+        return os.path.join(self.metrics_dir,
+                            f"{trial['metadata']['uid']}.jsonl")
+
+    def _metric_names(self, trial: dict[str, Any]) -> list[str]:
+        obj = trial["spec"].get("objective", {})
+        names = [obj.get("objectiveMetricName", "loss")]
+        names += list(obj.get("additionalMetricNames", ()))
+        return names
+
+    def _create_job(self, trial: dict[str, Any]) -> None:
+        ns = trial["metadata"].get("namespace", "default")
+        name = trial["metadata"]["name"]
+        assignments = trial["spec"].get("parameterAssignments", {})
+        spec = substitute(copy.deepcopy(trial["spec"]["template"]), assignments)
+        # inject trial identity + metrics stream target into every replica
+        for rspec in spec.get("replicaSpecs", {}).values():
+            env = rspec.setdefault("template", {}).setdefault("env", {})
+            env.setdefault("KTPU_TRIAL_NAME", name)
+            env.setdefault("KTPU_METRICS_FILE", self._metrics_path(trial))
+        job = new_resource(
+            JOB_KIND, name, spec=spec, namespace=ns,
+            labels={EXPERIMENT_LABEL:
+                    trial["spec"].get("experiment", ""),
+                    "kubeflow-tpu/trial": name},
+            owner=trial)
+        try:
+            self.store.create(job)
+        except AlreadyExistsError:
+            pass
+
+    # -- metrics & completion -------------------------------------------------
+
+    def _ensure_collector(self, trial: dict[str, Any]) -> None:
+        uid = trial["metadata"]["uid"]
+        with self._clock:
+            if uid in self._collectors:
+                return
+            tail = FileTail(self.db, trial["metadata"]["name"],
+                            self._metrics_path(trial),
+                            self._metric_names(trial))
+            self._collectors[uid] = tail
+        tail.start()
+
+    def _stop_collector(self, trial: dict[str, Any], final: bool) -> None:
+        with self._clock:
+            tail = self._collectors.pop(trial["metadata"]["uid"], None)
+        if tail is not None:
+            tail.stop(final_pass=final)
+
+    def _scrape_logs(self, trial: dict[str, Any]) -> None:
+        """Final stdout scrape (reference-style regex path) for jobs that
+        never wrote the structured file."""
+        name = trial["metadata"]["name"]
+        ns = trial["metadata"].get("namespace", "default")
+        executor = getattr(self.cluster, "executor", None)
+        if executor is None:
+            return
+        for pod in self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name}):
+            collect_text(self.db, name, executor.logs(
+                pod["metadata"]["name"], ns), self._metric_names(trial))
+
+    def observation(self, trial: dict[str, Any]) -> dict[str, Any] | None:
+        """Aggregate the DB series into Katib's observation shape."""
+        name = trial["metadata"]["name"]
+        metrics = []
+        for mname in self._metric_names(trial):
+            obs = self.db.get(name, mname)
+            if not obs:
+                continue
+            vals = [o.value for o in obs]
+            metrics.append({"name": mname, "latest": vals[-1],
+                            "min": min(vals), "max": max(vals)})
+        return {"metrics": metrics} if metrics else None
+
+    def objective_value(self, trial: dict[str, Any]) -> float | None:
+        """Extract the objective per metricStrategies (default: best value in
+        the objective direction, Katib's default extraction)."""
+        obj = trial["spec"].get("objective", {})
+        mname = obj.get("objectiveMetricName", "loss")
+        strategy = obj.get("metricStrategies", {}).get(
+            mname, "max" if obj.get("type") == "maximize" else "min")
+        obs = self.db.get(trial["metadata"]["name"], mname)
+        if not obs:
+            return None
+        vals = [o.value for o in obs]
+        if strategy == "latest":
+            return vals[-1]
+        return max(vals) if strategy == "max" else min(vals)
+
+    def _complete(self, trial: dict[str, Any], job: dict[str, Any],
+                  outcome: str) -> None:
+        name = trial["metadata"]["name"]
+        ns = trial["metadata"].get("namespace", "default")
+        # ensure a collector exists so fast jobs that finished before the
+        # Running edge still get their metrics file drained
+        self._ensure_collector(trial)
+        self._stop_collector(trial, final=True)
+        self._scrape_logs(trial)
+        observation = self.observation(trial)
+        value = self.objective_value(trial)
+
+        def write(o):
+            if observation:
+                o["status"]["observation"] = observation
+            if value is not None:
+                o["status"]["objectiveValue"] = value
+            if outcome == JobConditionType.SUCCEEDED and observation is None:
+                set_condition(o["status"], JobConditionType.FAILED,
+                              "MetricsUnavailable",
+                              "job succeeded but objective metric missing")
+            elif outcome == JobConditionType.SUCCEEDED:
+                set_condition(o["status"], JobConditionType.SUCCEEDED,
+                              "TrialSucceeded", "trial completed")
+            else:
+                set_condition(o["status"], JobConditionType.FAILED,
+                              "TrialFailed", "trial job failed")
+        self.store.mutate(TRIAL_KIND, name, write, ns)
+
+    # -- early stopping -------------------------------------------------------
+
+    def _maybe_early_stop(self, trial: dict[str, Any]) -> bool:
+        es = trial["spec"].get("earlyStopping")
+        if not es:
+            return False
+        name = trial["metadata"]["name"]
+        ns = trial["metadata"].get("namespace", "default")
+        obj = trial["spec"].get("objective", {})
+        rule = make_early_stopping(es.get("algorithmName", "medianstop"),
+                                   es.get("algorithmSettings"))
+        completed = [
+            t["metadata"]["name"]
+            for t in self.store.list(TRIAL_KIND, ns, labels={
+                EXPERIMENT_LABEL: trial["spec"].get("experiment", "")})
+            if has_condition(t["status"], JobConditionType.SUCCEEDED)]
+        if not rule.should_stop(
+                self.db, name, obj.get("objectiveMetricName", "loss"),
+                obj.get("type") == "maximize", completed):
+            return False
+        self._stop_collector(trial, final=True)
+        observation = self.observation(trial)
+        value = self.objective_value(trial)
+        self.store.try_delete(JOB_KIND, name, ns)
+
+        def write(o):
+            if observation:
+                o["status"]["observation"] = observation
+            if value is not None:
+                o["status"]["objectiveValue"] = value
+            set_condition(o["status"], EARLY_STOPPED, "MedianStopRule",
+                          "trial stopped early: below median of completed "
+                          "trials")
+        self.store.mutate(TRIAL_KIND, name, write, ns)
+        return True

@@ -1,0 +1,373 @@
+"""HPO tests — Katib test-strategy analog (SURVEY.md §4): algorithm unit
+tests on analytic objectives, collector/early-stopping units, and e2e
+experiments on the in-process cluster where trials really execute.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import hpo
+from kubeflow_tpu.control import (Cluster, JAXJobController, new_resource,
+                                  worker_target)
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.hpo.algorithms import TrialResult, make_algorithm
+from kubeflow_tpu.hpo.space import Parameter, SearchSpace, SpaceError
+from kubeflow_tpu.training.metrics_writer import MetricsWriter
+
+# -- search space -------------------------------------------------------------
+
+
+SPECS = [
+    {"name": "lr", "parameterType": "double",
+     "feasibleSpace": {"min": 1e-4, "max": 1e-1, "scale": "log"}},
+    {"name": "layers", "parameterType": "int",
+     "feasibleSpace": {"min": 1, "max": 8}},
+    {"name": "opt", "parameterType": "categorical",
+     "feasibleSpace": {"list": ["adamw", "sgd", "lion"]}},
+    {"name": "dropout", "parameterType": "discrete",
+     "feasibleSpace": {"list": [0.0, 0.1, 0.5]}},
+]
+
+
+class TestSpace:
+    def test_parse_sample_bounds(self):
+        space = SearchSpace.parse(SPECS)
+        rng = np.random.default_rng(0)
+        for _ in range(100):
+            s = space.sample(rng)
+            assert 1e-4 <= s["lr"] <= 1e-1
+            assert 1 <= s["layers"] <= 8 and isinstance(s["layers"], int)
+            assert s["opt"] in ("adamw", "sgd", "lion")
+            assert s["dropout"] in (0.0, 0.1, 0.5)
+
+    def test_unit_roundtrip(self):
+        space = SearchSpace.parse(SPECS)
+        rng = np.random.default_rng(1)
+        for _ in range(20):
+            s = space.sample(rng)
+            u = space.to_unit(s)
+            assert ((0 <= u) & (u <= 1)).all()
+            back = space.from_unit(u)
+            assert back["layers"] == s["layers"]
+            assert back["opt"] == s["opt"]
+            assert math.isclose(back["lr"], s["lr"], rel_tol=1e-6)
+
+    def test_log_scale_spreads_decades(self):
+        p = Parameter("lr", "double", min=1e-4, max=1.0, scale="log")
+        assert p.from_unit(0.5) == pytest.approx(1e-2, rel=1e-6)
+
+    def test_grid_and_cardinality(self):
+        space = SearchSpace.parse([SPECS[1], SPECS[2]])
+        assert space.cardinality() == 24
+        p = space.parameters[0]
+        assert p.grid(100) == [1, 2, 3, 4, 5, 6, 7, 8]
+
+    @pytest.mark.parametrize("bad", [
+        [{"name": "x", "parameterType": "double", "feasibleSpace": {}}],
+        [{"name": "x", "parameterType": "double",
+          "feasibleSpace": {"min": 2, "max": 1}}],
+        [{"name": "x", "parameterType": "categorical",
+          "feasibleSpace": {"list": []}}],
+        [{"name": "x", "parameterType": "nope", "feasibleSpace": {}}],
+        [],
+        [{"name": "x", "parameterType": "double",
+          "feasibleSpace": {"min": -1, "max": 1, "scale": "log"}}],
+    ])
+    def test_validation(self, bad):
+        with pytest.raises(SpaceError):
+            SearchSpace.parse(bad)
+
+
+# -- algorithms ---------------------------------------------------------------
+
+
+QUAD_SPACE = SearchSpace.parse([
+    {"name": "x", "parameterType": "double",
+     "feasibleSpace": {"min": -1.0, "max": 1.0}},
+    {"name": "y", "parameterType": "double",
+     "feasibleSpace": {"min": -1.0, "max": 1.0}},
+])
+
+
+def quad(params) -> float:
+    return (params["x"] - 0.3) ** 2 + (params["y"] + 0.2) ** 2
+
+
+def run_optimizer(name, budget=40, batch=4, settings=None) -> float:
+    algo = make_algorithm(name, QUAD_SPACE, settings, seed=7)
+    history: list[TrialResult] = []
+    while len(history) < budget:
+        for p in algo.suggest(batch, history):
+            history.append(TrialResult(params=p, value=quad(p)))
+    return min(t.value for t in history)
+
+
+class TestAlgorithms:
+    @pytest.mark.parametrize("name", ["random", "sobol", "tpe",
+                                      "bayesianoptimization", "cmaes"])
+    def test_stays_in_bounds_and_improves(self, name):
+        best = run_optimizer(name)
+        assert best < 0.15   # random alone gets ~0.02 on this budget
+
+    @pytest.mark.parametrize("name", ["tpe", "bayesianoptimization", "cmaes"])
+    def test_model_based_beats_coarse_threshold(self, name):
+        assert run_optimizer(name, budget=60) < 0.05
+
+    def test_grid_enumerates_exactly_once(self):
+        space = SearchSpace.parse([
+            {"name": "a", "parameterType": "int",
+             "feasibleSpace": {"min": 0, "max": 2}},
+            {"name": "b", "parameterType": "categorical",
+             "feasibleSpace": {"list": ["u", "v"]}}])
+        algo = make_algorithm("grid", space)
+        history = []
+        seen = []
+        while True:
+            batch = algo.suggest(4, history)
+            if not batch:
+                break
+            for p in batch:
+                seen.append((p["a"], p["b"]))
+                history.append(TrialResult(params=p, value=0.0))
+        assert len(seen) == 6 and len(set(seen)) == 6
+
+    def test_quasirandom_deterministic(self):
+        a1 = make_algorithm("sobol", QUAD_SPACE, seed=3)
+        a2 = make_algorithm("sobol", QUAD_SPACE, seed=3)
+        assert a1.suggest(5, []) == a2.suggest(5, [])
+
+    def test_hyperband_schedules_resource(self):
+        space = SearchSpace.parse([
+            {"name": "lr", "parameterType": "double",
+             "feasibleSpace": {"min": 0.001, "max": 1.0, "scale": "log"}},
+            {"name": "epochs", "parameterType": "int",
+             "feasibleSpace": {"min": 1, "max": 9}}])
+        algo = make_algorithm("hyperband", space,
+                              {"resource_name": "epochs", "eta": 3})
+        history = []
+        first = algo.suggest(9, history)   # rung 0 size = eta^s_max = 9
+        assert all(p["epochs"] == 1 for p in first)  # lowest rung
+        for p in first:
+            history.append(TrialResult(params=p, value=(p["lr"] - 0.1) ** 2))
+        # full rung-0 results → promotions appear at eta× resource
+        later = algo.suggest(6, history)
+        assert any(p["epochs"] >= 3 for p in later)
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            make_algorithm("annealing", QUAD_SPACE)
+
+
+# -- observations / collector / early stopping --------------------------------
+
+
+class TestObservations:
+    def test_report_get_latest_best(self):
+        db = hpo.ObservationDB()
+        for step, v in enumerate([1.0, 0.5, 0.7]):
+            db.report("t1", "loss", v, step)
+        assert [o.value for o in db.get("t1", "loss")] == [1.0, 0.5, 0.7]
+        assert db.latest("t1", "loss").value == 0.7
+        assert db.best("t1", "loss", maximize=False) == 0.5
+        db.delete_trial("t1")
+        assert db.get("t1") == []
+
+    def test_collect_text_formats(self):
+        db = hpo.ObservationDB()
+        text = "\n".join([
+            '{"step": 1, "metrics": {"loss": 0.9, "acc": 0.1}, "ts": 0}',
+            "[step 2] loss=0.5 acc=0.6",
+            "noise line without metrics",
+            "final: loss = 0.25",
+        ])
+        n = hpo.collect_text(db, "t", text, ["loss", "acc"])
+        assert n == 5
+        losses = [o.value for o in db.get("t", "loss")]
+        assert losses == [0.9, 0.5, 0.25]
+
+    def test_file_tail(self, tmp_path):
+        db = hpo.ObservationDB()
+        path = str(tmp_path / "m.jsonl")
+        tail = hpo.FileTail(db, "t", path, ["loss"], poll=0.05)
+        tail.start()
+        w = MetricsWriter(path, echo=False)
+        for i in range(5):
+            w.write(i, {"loss": 1.0 / (i + 1)})
+        w.close()
+        tail.stop(final_pass=True)
+        assert [o.step for o in db.get("t", "loss")] == list(range(5))
+
+
+class TestMedianStop:
+    def make_db(self):
+        db = hpo.ObservationDB()
+        # three completed trials with healthy descending loss
+        for t, base in [("c1", 1.0), ("c2", 0.9), ("c3", 1.1)]:
+            for step in range(10):
+                db.report(t, "loss", base / (step + 1), step)
+        return db
+
+    def test_stops_bad_trial(self):
+        db = self.make_db()
+        rule = hpo.MedianStop({"start_step": 4})
+        for step in range(6):
+            db.report("bad", "loss", 5.0, step)
+        assert rule.should_stop(db, "bad", "loss", False, ["c1", "c2", "c3"])
+
+    def test_keeps_good_trial_and_respects_start_step(self):
+        db = self.make_db()
+        rule = hpo.MedianStop({"start_step": 4})
+        db.report("good", "loss", 0.01, 5)
+        assert not rule.should_stop(db, "good", "loss", False,
+                                    ["c1", "c2", "c3"])
+        db.report("young", "loss", 9.9, 1)   # below start_step
+        assert not rule.should_stop(db, "young", "loss", False,
+                                    ["c1", "c2", "c3"])
+        assert not rule.should_stop(db, "bad", "loss", False, ["c1"])  # few
+
+
+# -- trial template substitution ----------------------------------------------
+
+
+def test_substitute_typed_and_interpolated():
+    tree = {
+        "env": {"LR": "${trialParameters.lr}",
+                "TAG": "run-${trialParameters.layers}"},
+        "nested": [{"v": "${trialParameters.layers}"}],
+    }
+    out = hpo.substitute(tree, {"lr": 0.01, "layers": 4})
+    assert out["env"]["LR"] == 0.01          # typed, not str
+    assert out["env"]["TAG"] == "run-4"      # interpolated
+    assert out["nested"][0]["v"] == 4
+    with pytest.raises(KeyError):
+        hpo.substitute({"x": "${trialParameters.nope}"}, {})
+
+
+# -- e2e experiments ----------------------------------------------------------
+
+
+@worker_target("hpo_quad")
+def _hpo_quad(env, cancel):
+    """Writes the quadratic objective to the structured metrics stream."""
+    x = float(env["X"])
+    y = float(env["Y"])
+    w = MetricsWriter(env["KTPU_METRICS_FILE"], echo=False)
+    for step in range(3):
+        w.write(step, {"loss": (x - 0.3) ** 2 + (y + 0.2) ** 2 + 1.0 / (step + 1)})
+    w.write(3, {"loss": (x - 0.3) ** 2 + (y + 0.2) ** 2})
+    w.close()
+
+
+def make_experiment(name, *, algorithm="random", max_trials=6, parallel=2,
+                    goal=None, parameters=None, settings=None):
+    objective = {"type": "minimize", "objectiveMetricName": "loss"}
+    if goal is not None:
+        objective["goal"] = goal
+    return new_resource("Experiment", name, spec={
+        "objective": objective,
+        "algorithm": {"algorithmName": algorithm,
+                      "algorithmSettings": settings or {}},
+        "parameters": parameters or [
+            {"name": "x", "parameterType": "double",
+             "feasibleSpace": {"min": -1.0, "max": 1.0}},
+            {"name": "y", "parameterType": "double",
+             "feasibleSpace": {"min": -1.0, "max": 1.0}},
+        ],
+        "parallelTrialCount": parallel,
+        "maxTrialCount": max_trials,
+        "maxFailedTrialCount": 3,
+        "trialTemplate": {"spec": {
+            "replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"backend": "thread", "target": "hpo_quad",
+                             "env": {"X": "${trialParameters.x}",
+                                     "Y": "${trialParameters.y}"},
+                             "resources": {"cpu": 1}},
+            }}}},
+    })
+
+
+@pytest.fixture()
+def hpo_cluster(tmp_path):
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    db = hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path))
+    with c:
+        yield c, db
+    hpo.set_default_db(None)
+
+
+def wait_exp(cluster, name, timeout=60):
+    return cluster.wait_for("Experiment", name,
+                            lambda o: is_finished(o["status"]),
+                            timeout=timeout)
+
+
+class TestExperimentE2E:
+    def test_random_search_completes_with_optimum(self, hpo_cluster):
+        cluster, db = hpo_cluster
+        cluster.store.create(make_experiment("rand-e2e"))
+        exp = wait_exp(cluster, "rand-e2e")
+        assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
+        assert exp["status"]["trials"]["succeeded"] >= 6
+        opt = exp["status"]["currentOptimalTrial"]
+        p = opt["parameterAssignments"]
+        assert opt["objectiveValue"] == pytest.approx(
+            (p["X"] - 0.3) ** 2 if False else
+            (p["x"] - 0.3) ** 2 + (p["y"] + 0.2) ** 2, rel=1e-6)
+        # observation carries the metric series aggregates
+        metrics = {m["name"]: m for m in opt["observation"]["metrics"]}
+        assert metrics["loss"]["min"] == pytest.approx(
+            opt["objectiveValue"], rel=1e-6)
+
+    def test_goal_short_circuits(self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        # goal generous enough that the first completed trial satisfies it
+        cluster.store.create(make_experiment("goal-e2e", goal=5.0,
+                                             max_trials=50))
+        exp = wait_exp(cluster, "goal-e2e")
+        cond = [c for c in exp["status"]["conditions"]
+                if c["type"] == JobConditionType.SUCCEEDED][0]
+        assert cond["reason"] == "GoalReached"
+        assert exp["status"]["trials"]["created"] < 50
+
+    def test_grid_exhaustion_completes(self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        cluster.store.create(make_experiment(
+            "grid-e2e", algorithm="grid", max_trials=100,
+            parameters=[
+                {"name": "x", "parameterType": "discrete",
+                 "feasibleSpace": {"list": [-0.5, 0.0, 0.3]}},
+                {"name": "y", "parameterType": "discrete",
+                 "feasibleSpace": {"list": [-0.2, 0.4]}},
+            ]))
+        exp = wait_exp(cluster, "grid-e2e")
+        assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
+        assert exp["status"]["trials"]["succeeded"] == 6
+        opt = exp["status"]["currentOptimalTrial"]
+        assert opt["parameterAssignments"] == {"x": 0.3, "y": -0.2}
+
+    def test_invalid_experiment_fails(self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        bad = make_experiment("bad-exp")
+        bad["spec"]["algorithm"]["algorithmName"] = "nonexistent"
+        cluster.store.create(bad)
+        exp = wait_exp(cluster, "bad-exp")
+        cond = [c for c in exp["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "InvalidSpec"
+
+    def test_tpe_experiment_improves_over_first_trials(self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        cluster.store.create(make_experiment(
+            "tpe-e2e", algorithm="tpe", max_trials=14, parallel=2,
+            settings={"n_initial_points": 4}))
+        exp = wait_exp(cluster, "tpe-e2e", timeout=120)
+        assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
+        assert exp["status"]["currentOptimalTrial"]["objectiveValue"] < 0.5
