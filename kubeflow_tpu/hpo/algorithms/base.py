@@ -61,22 +61,6 @@ class Algorithm:
     def _finished(self, history: Sequence[TrialResult]) -> list[TrialResult]:
         return [t for t in history if t.ok]
 
-    def _dedup(self, batch: list[dict[str, Any]],
-               history: Sequence[TrialResult]) -> list[dict[str, Any]]:
-        """Drop exact repeats of already-run points when the space is discrete
-        enough for collisions to waste budget."""
-        if self.space.cardinality() == float("inf"):
-            return batch
-        seen = {tuple(sorted(t.params.items())) for t in history}
-        out = []
-        for p in batch:
-            k = tuple(sorted(p.items()))
-            if k not in seen:
-                seen.add(k)
-                out.append(p)
-        return out
-
-
 _REGISTRY: dict[str, Callable[..., Algorithm]] = {}
 
 

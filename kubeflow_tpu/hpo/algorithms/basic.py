@@ -117,9 +117,7 @@ class Hyperband(Algorithm):
         self.s_max = int(np.floor(np.log(self.r_max / self.r_min)
                                   / np.log(self.eta)))
         self._rung = 0
-        self._rung_size = int(np.ceil((self.s_max + 1)
-                                      * self.eta ** self.s_max
-                                      / (self.s_max + 1)))
+        self._rung_size = int(self.eta ** self.s_max)
         self._promoted: list[dict[str, Any]] = []
 
     def _resource_at(self, rung: int) -> Any:

@@ -190,10 +190,10 @@ class ExperimentController(Controller):
         self.store.mutate(EXPERIMENT_KIND, name, write, ns)
 
         max_failed = spec.get("maxFailedTrialCount", 3)
-        if len(failed) > max_failed:
+        if len(failed) >= max_failed:
             self._finish(exp, JobConditionType.FAILED,
                          "MaxFailedTrialsReached",
-                         f"{len(failed)} failed trials > {max_failed}")
+                         f"{len(failed)} failed trials >= {max_failed}")
             return None
         if self._goal_reached(spec, optimal):
             self._finish(exp, JobConditionType.SUCCEEDED, "GoalReached",
@@ -257,17 +257,18 @@ class ExperimentController(Controller):
                     assignment: dict[str, Any]) -> dict[str, Any]:
         spec = exp["spec"]
         tt = spec.get("trialTemplate", {})
-        # trialParameters may rename: template placeholder name → space name
+        # trialParameters may rename: template placeholder name → space name.
+        # parameterAssignments stays space-keyed (it is the algorithm-history
+        # record); the renamed map only drives template substitution.
         mapping = {p.get("name"): p.get("reference", p.get("name"))
                    for p in tt.get("trialParameters", [])}
-        if mapping:
-            params = {tp_name: assignment[ref]
-                      for tp_name, ref in mapping.items()}
-        else:
-            params = dict(assignment)
+        substitutions = ({tp_name: assignment[ref]
+                          for tp_name, ref in mapping.items()}
+                         if mapping else dict(assignment))
         return {
             "experiment": exp["metadata"]["name"],
-            "parameterAssignments": params,
+            "parameterAssignments": dict(assignment),
+            "substitutions": substitutions,
             "objective": spec.get("objective", {}),
             "template": tt["spec"],
             "earlyStopping": spec.get("earlyStopping"),

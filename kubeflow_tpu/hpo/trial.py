@@ -135,7 +135,8 @@ class TrialController(Controller):
     def _create_job(self, trial: dict[str, Any]) -> None:
         ns = trial["metadata"].get("namespace", "default")
         name = trial["metadata"]["name"]
-        assignments = trial["spec"].get("parameterAssignments", {})
+        assignments = trial["spec"].get(
+            "substitutions", trial["spec"].get("parameterAssignments", {}))
         spec = substitute(copy.deepcopy(trial["spec"]["template"]), assignments)
         # inject trial identity + metrics stream target into every replica
         for rspec in spec.get("replicaSpecs", {}).values():
@@ -229,7 +230,7 @@ class TrialController(Controller):
                 o["status"]["observation"] = observation
             if value is not None:
                 o["status"]["objectiveValue"] = value
-            if outcome == JobConditionType.SUCCEEDED and observation is None:
+            if outcome == JobConditionType.SUCCEEDED and value is None:
                 set_condition(o["status"], JobConditionType.FAILED,
                               "MetricsUnavailable",
                               "job succeeded but objective metric missing")

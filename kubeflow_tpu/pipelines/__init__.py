@@ -1,0 +1,42 @@
+"""ML pipelines — the Kubeflow Pipelines analog (SURVEY.md §2.5).
+
+Author with the DSL, compile to a self-contained IR, execute as a
+PipelineRun on the cluster (one pod per task, step caching by digest,
+artifact + lineage records in the metadata store), schedule with
+ScheduledRun.
+
+    from kubeflow_tpu import pipelines as kfp
+
+    @kfp.dsl.component
+    def double(n: int) -> int:
+        return n * 2
+
+    @kfp.dsl.pipeline(name="demo")
+    def demo(n: int = 3):
+        double(n=double(n=n).output)
+
+    spec = kfp.compile_pipeline(demo)
+    # cluster.add(PipelineRunController); create PipelineRun with the spec
+"""
+
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.pipelines.artifacts import (Artifact, ArtifactStore,
+                                              json_digest)
+from kubeflow_tpu.pipelines.controllers import (PIPELINE_KIND, RUN_KIND,
+                                                SCHEDULED_KIND,
+                                                PipelineRunController,
+                                                ScheduledRunController,
+                                                validate_run)
+from kubeflow_tpu.pipelines.dsl import (Component, DSLError, Pipeline,
+                                        compile_pipeline, component,
+                                        pipeline)
+from kubeflow_tpu.pipelines.launcher import run_task
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+
+__all__ = [
+    "Artifact", "ArtifactStore", "Component", "DSLError", "MetadataStore",
+    "PIPELINE_KIND", "Pipeline", "PipelineRunController", "RUN_KIND",
+    "SCHEDULED_KIND", "ScheduledRunController", "compile_pipeline",
+    "component", "dsl", "json_digest", "pipeline", "run_task",
+    "validate_run",
+]

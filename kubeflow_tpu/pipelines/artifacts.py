@@ -1,0 +1,83 @@
+"""Content-addressed artifact store — the MinIO object-store analog
+(SURVEY.md §2.5; ⊘ kubeflow/pipelines artifact passing via MinIO in
+`backend/src/v2/component/launcher_v2.go`).
+
+Artifacts are JSON-serialized values (pipeline parameters and component
+outputs) plus opaque files, stored once per content digest under a local
+root. URIs are `ktpu://<sha256>`; the store resolves them against its root,
+so a spec/metadata record stays valid across processes sharing the root.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from dataclasses import dataclass
+from typing import Any
+
+SCHEME = "ktpu://"
+
+
+@dataclass(frozen=True)
+class Artifact:
+    uri: str
+    digest: str
+
+    @property
+    def short(self) -> str:
+        return self.digest[:12]
+
+
+def json_digest(value: Any) -> str:
+    """Canonical-JSON sha256 — the cache-key building block."""
+    blob = json.dumps(value, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class ArtifactStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "objects"), exist_ok=True)
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.root, "objects", digest[:2], digest)
+
+    def put_json(self, value: Any) -> Artifact:
+        blob = json.dumps(value, sort_keys=True, default=str).encode()
+        digest = hashlib.sha256(blob).hexdigest()
+        path = self._path(digest)
+        if not os.path.exists(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)   # atomic: concurrent writers converge
+        return Artifact(uri=SCHEME + digest, digest=digest)
+
+    def put_file(self, src: str) -> Artifact:
+        h = hashlib.sha256()
+        with open(src, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        digest = h.hexdigest()
+        path = self._path(digest)
+        if not os.path.exists(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            shutil.copyfile(src, path + ".tmp")
+            os.replace(path + ".tmp", path)
+        return Artifact(uri=SCHEME + digest, digest=digest)
+
+    def resolve(self, uri: str) -> str:
+        if not uri.startswith(SCHEME):
+            raise ValueError(f"not a {SCHEME} uri: {uri}")
+        path = self._path(uri[len(SCHEME):])
+        if not os.path.exists(path):
+            raise FileNotFoundError(uri)
+        return path
+
+    def get_json(self, uri: str) -> Any:
+        with open(self.resolve(uri)) as f:
+            return json.load(f)

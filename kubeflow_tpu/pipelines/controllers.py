@@ -1,0 +1,371 @@
+"""Pipeline run engine — the KFP api-server + Argo DAG walk + cache server +
+ScheduledWorkflow controller, as reconcilers (SURVEY.md §2.5, §3.4; ⊘
+kubeflow/pipelines `backend/src/apiserver/resource/resource_manager.go`,
+Argo DAG execution, `backend/src/cache/server/mutation.go`,
+`backend/src/crd/controller/scheduledworkflow/controller.go`).
+
+Resources:
+
+    kind: Pipeline        # uploaded compiled spec (api-server upload analog)
+    spec: <compiled IR>
+
+    kind: PipelineRun
+    spec:
+      pipelineSpec: <IR>            # inline …
+      pipelineRef: name             # … or reference to an uploaded Pipeline
+      parameters: {n: 5}
+      backend: thread | subprocess  # per-task pod backend (default thread)
+      cacheEnabled: true
+      taskResources: {cpu: 1}
+    status:
+      conditions; tasks: {name: {state, outputs: {out: {uri, digest}},
+                                 cached, executionId}}
+
+    kind: ScheduledRun
+    spec:
+      schedule: {cron: "*/5 * * * *"} | {intervalSeconds: 30}
+      suspend: false
+      maxRuns: 10                   # stop after N spawned runs (optional)
+      runSpec: <PipelineRun spec>
+
+Each task executes as a Pod (thread target or `python -m
+kubeflow_tpu.pipelines.launcher` subprocess) over a self-contained task dir;
+outputs become content-addressed artifacts; executions/artifacts/lineage are
+recorded in the MetadataStore, whose cache_key lookup short-circuits repeated
+steps exactly like KFP's cache server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from kubeflow_tpu.control.conditions import (JobConditionType, is_finished,
+                                             set_condition)
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+from kubeflow_tpu.control.executor import worker_target
+from kubeflow_tpu.pipelines import launcher
+from kubeflow_tpu.pipelines.artifacts import Artifact, ArtifactStore, \
+    json_digest
+from kubeflow_tpu.pipelines.metadata import MetadataStore
+from kubeflow_tpu.utils import cron
+
+PIPELINE_KIND = "Pipeline"
+RUN_KIND = "PipelineRun"
+SCHEDULED_KIND = "ScheduledRun"
+RUN_LABEL = "kubeflow-tpu/pipeline-run"
+
+
+@worker_target("pipeline_task")
+def _pipeline_task(env, cancel):
+    """Thread-backend pod target: run one task dir in-process (through
+    launcher.main so failures land in error.txt like the subprocess path)."""
+    rc = launcher.main([env["KTPU_TASK_DIR"]])
+    if rc != 0:
+        raise SystemExit(rc)
+
+
+def validate_run(run: dict[str, Any]) -> list[str]:
+    spec = run.get("spec", {})
+    if not spec.get("pipelineSpec") and not spec.get("pipelineRef"):
+        return ["spec.pipelineSpec or spec.pipelineRef is required"]
+    return []
+
+
+class PipelineRunController(Controller):
+    kind = RUN_KIND
+    owned_kinds = ("Pod",)
+    resync_period = 0.5
+
+    def __init__(self, cluster, root: str | None = None,
+                 metadata: MetadataStore | None = None):
+        super().__init__(cluster)
+        self.root = root or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-pipelines")
+        os.makedirs(self.root, exist_ok=True)
+        self.artifacts = ArtifactStore(os.path.join(self.root, "artifacts"))
+        self.metadata = metadata or MetadataStore(
+            os.path.join(self.root, "metadata.sqlite"))
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, run: dict[str, Any]) -> float | None:
+        name = run["metadata"]["name"]
+        ns = run["metadata"].get("namespace", "default")
+        status = run["status"]
+        if is_finished(status):
+            return None
+
+        errs = validate_run(run)
+        if errs:
+            self._finish(run, JobConditionType.FAILED, "InvalidSpec",
+                         "; ".join(errs))
+            return None
+        if not status.get("conditions"):
+            self.metadata.get_or_create_context(self._run_id(run))
+            self.store.mutate(RUN_KIND, name, lambda o: (
+                o["status"].update(startTime=time.time(), tasks={}),
+                set_condition(o["status"], JobConditionType.CREATED,
+                              "RunCreated", "pipeline run created")), ns)
+            return 0.0
+
+        try:
+            spec = self._pipeline_spec(run)
+        except KeyError as e:
+            self._finish(run, JobConditionType.FAILED, "PipelineNotFound",
+                         str(e))
+            return None
+        dag = spec["root"]["dag"]["tasks"]
+        tasks: dict[str, Any] = dict(status.get("tasks", {}))
+        changed = False
+
+        for tname, tir in dag.items():
+            st = tasks.get(tname, {})
+            state = st.get("state")
+            if state in ("Succeeded", "Cached"):
+                continue
+            if state == "Failed":
+                self._finish(run, JobConditionType.FAILED, "TaskFailed",
+                             f"task {tname} failed: {st.get('message', '')}")
+                return None
+            if state == "Running":
+                new_st = self._check_pod(run, spec, tname, st)
+                if new_st is not None:
+                    tasks[tname] = new_st
+                    changed = True
+                continue
+            # Pending: are data + ordering dependencies satisfied?
+            deps = tir["dependencies"]
+            if all(tasks.get(d, {}).get("state") in ("Succeeded", "Cached")
+                   for d in deps):
+                tasks[tname] = self._start_task(run, spec, tname, tir, tasks)
+                changed = True
+
+        if changed:
+            self.store.mutate(RUN_KIND, name,
+                              lambda o: o["status"].update(tasks=tasks), ns)
+        if all(tasks.get(t, {}).get("state") in ("Succeeded", "Cached")
+               for t in dag):
+            self._finish(run, JobConditionType.SUCCEEDED, "RunSucceeded",
+                         f"{len(dag)} tasks completed "
+                         f"({sum(1 for t in tasks.values() if t.get('state') == 'Cached')} cached)")
+            return None
+        if not status.get("conditions") or changed:
+            return 0.05
+        return 0.2
+
+    # -- task lifecycle -------------------------------------------------------
+
+    @staticmethod
+    def _run_id(run: dict[str, Any]) -> str:
+        return (f"{run['metadata'].get('namespace', 'default')}/"
+                f"{run['metadata']['name']}")
+
+    def _pipeline_spec(self, run: dict[str, Any]) -> dict[str, Any]:
+        spec = run["spec"]
+        if spec.get("pipelineSpec"):
+            return spec["pipelineSpec"]
+        ref = spec["pipelineRef"]
+        obj = self.store.try_get(
+            PIPELINE_KIND, ref, run["metadata"].get("namespace", "default"))
+        if obj is None:
+            raise KeyError(f"Pipeline {ref!r} not found")
+        return obj["spec"]
+
+    def _resolve_inputs(self, run: dict[str, Any], spec: dict[str, Any],
+                        tir: dict[str, Any],
+                        tasks: dict[str, Any]) -> dict[str, Any]:
+        params = dict(spec.get("parameters", {}))
+        params.update(run["spec"].get("parameters", {}))
+        comp = spec["components"][tir["component"]]
+        resolved = {}
+        for iname, binding in tir["inputs"].items():
+            if "constant" in binding:
+                resolved[iname] = binding["constant"]
+            elif "pipelineParam" in binding:
+                pname = binding["pipelineParam"]
+                if params.get(pname) is None:
+                    raise ValueError(f"pipeline parameter {pname!r} not set")
+                resolved[iname] = params[pname]
+            else:
+                to = binding["taskOutput"]
+                out = tasks[to["task"]]["outputs"][to["output"]]
+                resolved[iname] = self.artifacts.get_json(out["uri"])
+        for iname, ispec in comp["inputs"].items():
+            if iname not in resolved and "default" in ispec:
+                resolved[iname] = ispec["default"]
+        return resolved
+
+    def _task_dir(self, run: dict[str, Any], tname: str) -> str:
+        d = os.path.join(self.root, "runs", run["metadata"]["uid"], tname)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _start_task(self, run: dict[str, Any], spec: dict[str, Any],
+                    tname: str, tir: dict[str, Any],
+                    tasks: dict[str, Any]) -> dict[str, Any]:
+        comp = spec["components"][tir["component"]]
+        try:
+            inputs = self._resolve_inputs(run, spec, tir, tasks)
+        except (ValueError, KeyError) as e:
+            return {"state": "Failed", "message": f"input resolution: {e}"}
+        cache_key = json_digest({"component": comp["digest"],
+                                 "inputs": inputs})
+        run_id = self._run_id(run)
+        if run["spec"].get("cacheEnabled", True):
+            hit = self.metadata.cached_outputs(cache_key)
+            if hit is not None:
+                eid = self.metadata.create_execution(
+                    run_id, tname, tir["component"], cache_key)
+                self.metadata.finish_execution(eid, "CACHED")
+                return {"state": "Cached", "cached": True,
+                        "outputs": {n: {"uri": a.uri, "digest": a.digest}
+                                    for n, a in hit.items()},
+                        "executionId": eid}
+        task_dir = self._task_dir(run, tname)
+        with open(os.path.join(task_dir, "component.json"), "w") as f:
+            json.dump(comp, f)
+        with open(os.path.join(task_dir, "inputs.json"), "w") as f:
+            json.dump(inputs, f, default=str)
+        eid = self.metadata.create_execution(run_id, tname, tir["component"],
+                                             cache_key)
+        for iname, ival in inputs.items():
+            self.metadata.record_io(eid, iname, self.artifacts.put_json(ival),
+                                    "INPUT")
+        backend = run["spec"].get("backend", "thread")
+        template: dict[str, Any] = {
+            "resources": run["spec"].get("taskResources", {"cpu": 1}),
+            "env": {"KTPU_TASK_DIR": task_dir},
+        }
+        if backend == "subprocess":
+            template["backend"] = "subprocess"
+            template["argv"] = [sys.executable, "-m",
+                                "kubeflow_tpu.pipelines.launcher", task_dir]
+        else:
+            template["backend"] = "thread"
+            template["target"] = "pipeline_task"
+        pod = new_resource(
+            "Pod", self._pod_name(run, tname), spec=template,
+            namespace=run["metadata"].get("namespace", "default"),
+            labels={RUN_LABEL: run["metadata"]["name"],
+                    "kubeflow-tpu/pipeline-task": tname},
+            owner=run)
+        try:
+            self.store.create(pod)
+        except AlreadyExistsError:
+            pass
+        return {"state": "Running", "executionId": eid,
+                "cacheKey": cache_key}
+
+    @staticmethod
+    def _pod_name(run: dict[str, Any], tname: str) -> str:
+        return f"{run['metadata']['name']}-{tname}"
+
+    def _check_pod(self, run: dict[str, Any], spec: dict[str, Any],
+                   tname: str, st: dict[str, Any]) -> dict[str, Any] | None:
+        ns = run["metadata"].get("namespace", "default")
+        pod = self.store.try_get("Pod", self._pod_name(run, tname), ns)
+        if pod is None:
+            return {"state": "Failed", "message": "pod disappeared"}
+        phase = pod["status"].get("phase", "Pending")
+        if phase == "Failed":
+            err_path = os.path.join(self._task_dir(run, tname), "error.txt")
+            msg = ""
+            if os.path.exists(err_path):
+                with open(err_path) as f:
+                    msg = f.read()[-2000:]
+            self.metadata.finish_execution(st.get("executionId", 0), "FAILED")
+            return {**st, "state": "Failed", "message": msg or "task failed"}
+        if phase != "Succeeded":
+            return None
+        out_path = os.path.join(self._task_dir(run, tname), "outputs.json")
+        comp = spec["components"][spec["root"]["dag"]["tasks"][tname]
+                                  ["component"]]
+        values: dict[str, Any] = {}
+        if os.path.exists(out_path):
+            with open(out_path) as f:
+                values = json.load(f)
+        elif comp.get("outputs"):
+            self.metadata.finish_execution(st.get("executionId", 0), "FAILED")
+            return {**st, "state": "Failed",
+                    "message": "pod succeeded but wrote no outputs.json"}
+        arts = {n: self.artifacts.put_json(v) for n, v in values.items()}
+        self.metadata.finish_execution(st.get("executionId", 0), "COMPLETE",
+                                       arts)
+        return {**st, "state": "Succeeded",
+                "outputs": {n: {"uri": a.uri, "digest": a.digest}
+                            for n, a in arts.items()}}
+
+    def _finish(self, run: dict[str, Any], ctype: str, reason: str,
+                message: str) -> None:
+        ns = run["metadata"].get("namespace", "default")
+        self.store.mutate(RUN_KIND, run["metadata"]["name"], lambda o: (
+            o["status"].update(completionTime=time.time()),
+            set_condition(o["status"], ctype, reason, message)), ns)
+        # kill any still-running task pods of a failed run
+        if ctype == JobConditionType.FAILED:
+            for p in self.store.list("Pod", ns, labels={
+                    RUN_LABEL: run["metadata"]["name"]}):
+                if p["status"].get("phase") not in ("Succeeded", "Failed"):
+                    self.store.try_delete("Pod", p["metadata"]["name"], ns)
+
+    # -- public queries (SDK backing) -----------------------------------------
+
+    def task_output(self, run_name: str, task: str, output: str = "Output",
+                    namespace: str = "default") -> Any:
+        run = self.store.get(RUN_KIND, run_name, namespace)
+        out = run["status"]["tasks"][task]["outputs"][output]
+        return self.artifacts.get_json(out["uri"])
+
+
+class ScheduledRunController(Controller):
+    kind = SCHEDULED_KIND
+    resync_period = 0.5
+
+    def reconcile(self, sched: dict[str, Any]) -> float | None:
+        name = sched["metadata"]["name"]
+        ns = sched["metadata"].get("namespace", "default")
+        spec = sched["spec"]
+        status = sched["status"]
+        if spec.get("suspend"):
+            return None
+        max_runs = spec.get("maxRuns")
+        count = status.get("runCount", 0)
+        if max_runs is not None and count >= max_runs:
+            return None
+
+        now = time.time()
+        next_at = status.get("nextScheduleTime")
+        if next_at is None:
+            next_at = self._next(spec, status.get("lastScheduleTime", now))
+            self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]
+                              .update(nextScheduleTime=next_at), ns)
+        if now < next_at:
+            return min(next_at - now, 1.0)
+
+        run = new_resource(RUN_KIND, f"{name}-{count}",
+                           spec=spec.get("runSpec", {}), namespace=ns,
+                           labels={"kubeflow-tpu/scheduled-by": name},
+                           owner=sched)
+        try:
+            self.store.create(run)
+        except AlreadyExistsError:
+            pass
+        after = self._next(spec, now)
+        self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"].update(
+            lastScheduleTime=now, runCount=count + 1,
+            nextScheduleTime=after), ns)
+        return min(after - now, 1.0)
+
+    @staticmethod
+    def _next(spec: dict[str, Any], after: float) -> float:
+        sched = spec.get("schedule", {})
+        if "intervalSeconds" in sched:
+            return after + float(sched["intervalSeconds"])
+        if "cron" in sched:
+            return cron.next_fire(sched["cron"], after)
+        raise ValueError("schedule needs cron or intervalSeconds")

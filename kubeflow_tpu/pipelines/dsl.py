@@ -1,0 +1,262 @@
+"""Pipelines DSL + compiler — the `kfp.dsl` / `kfp.compiler` analog
+(SURVEY.md §2.5, §3.4; ⊘ kubeflow/pipelines `sdk/python/kfp/dsl/pipeline_task.py`
+and `compiler/compiler.py`).
+
+KFP-v2-style authoring: `@component` functions composed inside a
+`@pipeline` function; data flows by passing `task.output` /
+`task.outputs["name"]`. The compiler traces the pipeline function with
+placeholder parameters and emits a self-contained IR (PipelineSpec analog):
+component sources embedded (KFP's own trick, so any process can execute a
+task with no registry), a DAG of tasks with typed input bindings, and
+per-component digests that drive step caching.
+
+    @dsl.component
+    def double(n: int) -> int:
+        return n * 2
+
+    @dsl.pipeline(name="demo")
+    def demo(n: int = 3):
+        a = double(n=n)
+        b = double(n=a.output)
+
+    spec = dsl.compile_pipeline(demo)
+
+Control flow: tasks run when their data dependencies complete; explicit
+ordering via `task.after(other)`. (KFP's dsl.Condition/ParallelFor are
+compiled control-flow containers; here conditional/fan-out steps are plain
+Python inside components — idiomatic for a single-IR engine.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import re
+import textwrap
+import typing
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+_ACTIVE: list["_PipelineContext"] = []
+
+SINGLE_OUTPUT = "Output"
+
+
+class DSLError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class PipelineParam:
+    name: str
+
+
+@dataclass(frozen=True)
+class TaskOutput:
+    task: str
+    output: str
+
+
+def _strip_decorators(source: str) -> str:
+    lines = textwrap.dedent(source).splitlines()
+    i = 0
+    while i < len(lines) and not re.match(r"\s*(async\s+)?def\s", lines[i]):
+        i += 1
+    return "\n".join(lines[i:])
+
+
+def _type_name(t: Any) -> str:
+    if t is inspect.Parameter.empty or t is None:
+        return "Any"
+    return getattr(t, "__name__", str(t))
+
+
+class Component:
+    """A containerized-step analog: a Python function plus its embedded
+    source, input signature, and output schema."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.name = fn.__name__
+        try:
+            self.source = _strip_decorators(inspect.getsource(fn))
+        except OSError as e:
+            raise DSLError(
+                f"cannot read source of {fn.__name__!r} — components must be "
+                "defined in a real file (not a REPL/stdin) so their source "
+                "can be embedded in the pipeline spec") from e
+        self.digest = hashlib.sha256(self.source.encode()).hexdigest()
+        try:
+            # eval_str resolves PEP-563 string annotations (files with
+            # `from __future__ import annotations`)
+            sig = inspect.signature(fn, eval_str=True)
+        except NameError:
+            sig = inspect.signature(fn)
+        self.inputs = {
+            p.name: {"type": _type_name(p.annotation),
+                     **({} if p.default is inspect.Parameter.empty
+                        else {"default": p.default})}
+            for p in sig.parameters.values()}
+        ret = sig.return_annotation
+        if ret is inspect.Signature.empty or ret is None:
+            self.outputs: dict[str, dict] = {}
+        elif (isinstance(ret, type) and issubclass(ret, tuple)
+              and hasattr(ret, "_fields")):   # NamedTuple → named outputs
+            hints = typing.get_type_hints(ret)
+            self.outputs = {f: {"type": _type_name(hints.get(f))}
+                            for f in ret._fields}
+        else:
+            self.outputs = {SINGLE_OUTPUT: {"type": _type_name(ret)}}
+
+    def to_ir(self) -> dict[str, Any]:
+        return {"functionName": self.name, "source": self.source,
+                "digest": self.digest, "inputs": self.inputs,
+                "outputs": self.outputs}
+
+    def __call__(self, **kwargs):
+        if not _ACTIVE:
+            return self.fn(**kwargs)   # plain call outside a pipeline trace
+        return _ACTIVE[-1].add_task(self, kwargs)
+
+
+class Task:
+    def __init__(self, name: str, component: Component,
+                 inputs: dict[str, Any]):
+        self.name = name
+        self.component = component
+        self.inputs = inputs
+        self.dependencies: set[str] = set()
+        for v in inputs.values():
+            if isinstance(v, TaskOutput):
+                self.dependencies.add(v.task)
+            elif isinstance(v, Task):
+                raise DSLError(
+                    f"pass {v.name}.output (or .outputs[name]), not the task")
+
+    def after(self, *tasks: "Task") -> "Task":
+        self.dependencies.update(t.name for t in tasks)
+        return self
+
+    @property
+    def output(self) -> TaskOutput:
+        outs = list(self.component.outputs)
+        if len(outs) != 1:
+            raise DSLError(
+                f"{self.name} has outputs {outs}; use .outputs[name]")
+        return TaskOutput(self.name, outs[0])
+
+    @property
+    def outputs(self) -> dict[str, TaskOutput]:
+        return {o: TaskOutput(self.name, o) for o in self.component.outputs}
+
+    def to_ir(self) -> dict[str, Any]:
+        def encode(v):
+            if isinstance(v, TaskOutput):
+                return {"taskOutput": {"task": v.task, "output": v.output}}
+            if isinstance(v, PipelineParam):
+                return {"pipelineParam": v.name}
+            return {"constant": v}
+        return {"component": self.component.name,
+                "inputs": {k: encode(v) for k, v in self.inputs.items()},
+                "dependencies": sorted(self.dependencies)}
+
+
+class _PipelineContext:
+    def __init__(self):
+        self.tasks: dict[str, Task] = {}
+        self.components: dict[str, Component] = {}
+
+    def add_task(self, component: Component, kwargs: dict[str, Any]) -> Task:
+        known = self.components.get(component.name)
+        if known is not None and known.digest != component.digest:
+            raise DSLError(
+                f"two different components named {component.name!r}")
+        self.components[component.name] = component
+        unknown = set(kwargs) - set(component.inputs)
+        if unknown:
+            raise DSLError(f"{component.name}: unknown inputs {unknown}")
+        missing = [k for k, s in component.inputs.items()
+                   if k not in kwargs and "default" not in s]
+        if missing:
+            raise DSLError(f"{component.name}: missing inputs {missing}")
+        base = component.name
+        name, i = base, 1
+        while name in self.tasks:
+            i += 1
+            name = f"{base}-{i}"
+        task = Task(name, component, kwargs)
+        self.tasks[name] = task
+        return task
+
+
+class Pipeline:
+    def __init__(self, fn: Callable, name: str | None = None,
+                 description: str = ""):
+        self.fn = fn
+        self.name = name or fn.__name__
+        self.description = description
+        sig = inspect.signature(fn)
+        self.params = {
+            p.name: (None if p.default is inspect.Parameter.empty
+                     else p.default)
+            for p in sig.parameters.values()}
+
+    def __call__(self, **kwargs):
+        return self.fn(**kwargs)
+
+
+def component(fn: Callable) -> Component:
+    return Component(fn)
+
+
+def pipeline(name: str | None = None, description: str = ""):
+    def deco(fn: Callable) -> Pipeline:
+        return Pipeline(fn, name, description)
+    if callable(name):   # bare @pipeline
+        fn, name = name, None
+        return Pipeline(fn)
+    return deco
+
+
+def compile_pipeline(p: Pipeline) -> dict[str, Any]:
+    """Trace the pipeline function → IR dict (the PipelineSpec analog)."""
+    if isinstance(p, Callable) and not isinstance(p, Pipeline):  # type: ignore
+        p = Pipeline(p)
+    ctx = _PipelineContext()
+    _ACTIVE.append(ctx)
+    try:
+        p.fn(**{k: PipelineParam(k) for k in p.params})
+    finally:
+        _ACTIVE.pop()
+    if not ctx.tasks:
+        raise DSLError(f"pipeline {p.name!r} defines no tasks")
+    spec = {
+        "pipelineInfo": {"name": p.name, "description": p.description},
+        "components": {c.name: c.to_ir() for c in ctx.components.values()},
+        "root": {"dag": {"tasks": {t.name: t.to_ir()
+                                   for t in ctx.tasks.values()}}},
+        "parameters": p.params,
+        "schemaVersion": "ktpu/v1",
+    }
+    _check_acyclic(spec)
+    return spec
+
+
+def _check_acyclic(spec: dict[str, Any]) -> None:
+    tasks = spec["root"]["dag"]["tasks"]
+    state: dict[str, int] = {}   # 0 visiting, 1 done
+
+    def visit(name: str, stack: tuple[str, ...]) -> None:
+        if state.get(name) == 1:
+            return
+        if state.get(name) == 0:
+            raise DSLError(f"dependency cycle: {' -> '.join(stack + (name,))}")
+        if name not in tasks:
+            raise DSLError(f"unknown dependency {name!r}")
+        state[name] = 0
+        for dep in tasks[name]["dependencies"]:
+            visit(dep, stack + (name,))
+        state[name] = 1
+
+    for name in tasks:
+        visit(name, ())

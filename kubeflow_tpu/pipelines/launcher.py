@@ -1,0 +1,74 @@
+"""Per-task launcher — the KFP v2 driver/launcher analog (SURVEY.md §2.5,
+⊘ kubeflow/pipelines `backend/src/v2/component/launcher_v2.go`).
+
+Executes ONE pipeline task from a self-contained task directory prepared by
+the run controller:
+
+    task_dir/component.json   — embedded source + functionName + outputs
+    task_dir/inputs.json      — fully resolved input values
+    task_dir/outputs.json     — written here: {output name: value}
+    task_dir/error.txt        — traceback on failure
+
+Deliberately dependency-light (stdlib only, no jax import): as a subprocess
+entry (`python -m kubeflow_tpu.pipelines.launcher <task_dir>`) it starts in
+milliseconds; the thread-backend pod target calls `run_task` in-process.
+Component functions import their own dependencies inside the function body —
+the KFP packaging convention.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+from typing import Any
+
+
+def _normalize_outputs(value: Any, outputs: dict[str, Any]) -> dict[str, Any]:
+    if not outputs:
+        return {}
+    if (isinstance(value, tuple) and hasattr(value, "_fields")):
+        return {f: getattr(value, f) for f in value._fields}
+    if len(outputs) == 1:
+        return {next(iter(outputs)): value}
+    # multiple declared outputs but a plain tuple returned: zip positionally
+    if isinstance(value, tuple) and len(value) == len(outputs):
+        return dict(zip(outputs, value))
+    raise TypeError(
+        f"component returned {type(value).__name__}, cannot map to "
+        f"declared outputs {list(outputs)}")
+
+
+def run_task(task_dir: str) -> dict[str, Any]:
+    with open(os.path.join(task_dir, "component.json")) as f:
+        comp = json.load(f)
+    with open(os.path.join(task_dir, "inputs.json")) as f:
+        inputs = json.load(f)
+    namespace: dict[str, Any] = {}
+    exec(compile(comp["source"], f"<component {comp['functionName']}>",
+                 "exec"), namespace)
+    fn = namespace[comp["functionName"]]
+    result = fn(**inputs)
+    out = _normalize_outputs(result, comp.get("outputs", {}))
+    tmp = os.path.join(task_dir, "outputs.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(out, f, default=str)
+    os.replace(tmp, os.path.join(task_dir, "outputs.json"))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    task_dir = argv[0]
+    try:
+        run_task(task_dir)
+        return 0
+    except Exception:
+        with open(os.path.join(task_dir, "error.txt"), "w") as f:
+            f.write(traceback.format_exc())
+        traceback.print_exc()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

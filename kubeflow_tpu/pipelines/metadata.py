@@ -1,0 +1,179 @@
+"""ML Metadata store — the MLMD analog (SURVEY.md §2.5, §2.6; ⊘
+google/ml-metadata `metadata_store_server`, consumed by kubeflow/pipelines
+`backend/src/v2/driver/driver.go` for context/caching and `launcher_v2.go`
+for execution/artifact records).
+
+Same conceptual model as MLMD: **Artifacts** (things with URIs), **Executions**
+(component runs with state), **Events** (input/output edges), **Contexts**
+(pipeline runs grouping executions). Backed by sqlite (the environment's
+MySQL stand-in). This table layout is the contract for the C++ native store
+(native/metadata_store) — both speak the same schema so the Python fallback
+and the C++ gRPC server are interchangeable.
+
+Also serves as KFP's cache server (⊘ `backend/src/cache/server/mutation.go`):
+`cached_outputs(cache_key)` is the digest-match short-circuit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import sqlite3
+from typing import Any
+
+from kubeflow_tpu.pipelines.artifacts import Artifact
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS artifacts (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  uri TEXT NOT NULL, digest TEXT NOT NULL, type TEXT NOT NULL DEFAULT 'Json',
+  created REAL NOT NULL);
+CREATE INDEX IF NOT EXISTS idx_artifact_digest ON artifacts (digest);
+CREATE TABLE IF NOT EXISTS executions (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  run TEXT NOT NULL, task TEXT NOT NULL, component TEXT NOT NULL,
+  cache_key TEXT, state TEXT NOT NULL DEFAULT 'RUNNING',
+  start REAL NOT NULL, end REAL);
+CREATE INDEX IF NOT EXISTS idx_exec_cache ON executions (cache_key, state);
+CREATE INDEX IF NOT EXISTS idx_exec_run ON executions (run);
+CREATE TABLE IF NOT EXISTS events (
+  execution_id INTEGER NOT NULL REFERENCES executions(id),
+  artifact_id INTEGER NOT NULL REFERENCES artifacts(id),
+  direction TEXT NOT NULL CHECK (direction IN ('INPUT','OUTPUT')),
+  name TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS contexts (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT NOT NULL UNIQUE, type TEXT NOT NULL DEFAULT 'PipelineRun',
+  created REAL NOT NULL);
+CREATE TABLE IF NOT EXISTS associations (
+  context_id INTEGER NOT NULL REFERENCES contexts(id),
+  execution_id INTEGER NOT NULL REFERENCES executions(id));
+"""
+
+
+class MetadataStore:
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+
+    # -- contexts -------------------------------------------------------------
+
+    def get_or_create_context(self, name: str,
+                              ctype: str = "PipelineRun") -> int:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT id FROM contexts WHERE name = ?", (name,)).fetchone()
+            if row:
+                return int(row[0])
+            cur = self._db.execute(
+                "INSERT INTO contexts (name, type, created) VALUES (?,?,?)",
+                (name, ctype, time.time()))
+            self._db.commit()
+            return int(cur.lastrowid)
+
+    # -- executions -----------------------------------------------------------
+
+    def create_execution(self, run: str, task: str, component: str,
+                         cache_key: str | None = None) -> int:
+        with self._lock:
+            cur = self._db.execute(
+                "INSERT INTO executions (run, task, component, cache_key,"
+                " state, start) VALUES (?,?,?,?, 'RUNNING', ?)",
+                (run, task, component, cache_key, time.time()))
+            eid = int(cur.lastrowid)
+            ctx = self._db.execute(
+                "SELECT id FROM contexts WHERE name = ?", (run,)).fetchone()
+            if ctx:
+                self._db.execute(
+                    "INSERT INTO associations VALUES (?,?)", (ctx[0], eid))
+            self._db.commit()
+            return eid
+
+    def _artifact_id(self, art: Artifact, atype: str) -> int:
+        row = self._db.execute(
+            "SELECT id FROM artifacts WHERE digest = ?",
+            (art.digest,)).fetchone()
+        if row:
+            return int(row[0])
+        cur = self._db.execute(
+            "INSERT INTO artifacts (uri, digest, type, created)"
+            " VALUES (?,?,?,?)", (art.uri, art.digest, atype, time.time()))
+        return int(cur.lastrowid)
+
+    def record_io(self, execution_id: int, name: str, art: Artifact,
+                  direction: str, atype: str = "Json") -> None:
+        with self._lock:
+            aid = self._artifact_id(art, atype)
+            self._db.execute(
+                "INSERT INTO events VALUES (?,?,?,?)",
+                (execution_id, aid, direction, name))
+            self._db.commit()
+
+    def finish_execution(self, execution_id: int, state: str,
+                         outputs: dict[str, Artifact] | None = None) -> None:
+        with self._lock:
+            for name, art in (outputs or {}).items():
+                aid = self._artifact_id(art, "Json")
+                self._db.execute(
+                    "INSERT INTO events VALUES (?,?,'OUTPUT',?)",
+                    (execution_id, aid, name))
+            self._db.execute(
+                "UPDATE executions SET state = ?, end = ? WHERE id = ?",
+                (state, time.time(), execution_id))
+            self._db.commit()
+
+    # -- cache (KFP cache-server analog) --------------------------------------
+
+    def cached_outputs(self, cache_key: str) -> dict[str, Artifact] | None:
+        """Outputs of the latest COMPLETE execution with this cache key."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT id FROM executions WHERE cache_key = ?"
+                " AND state = 'COMPLETE' ORDER BY id DESC LIMIT 1",
+                (cache_key,)).fetchone()
+            if not row:
+                return None
+            rows = self._db.execute(
+                "SELECT e.name, a.uri, a.digest FROM events e"
+                " JOIN artifacts a ON a.id = e.artifact_id"
+                " WHERE e.execution_id = ? AND e.direction = 'OUTPUT'",
+                (row[0],)).fetchall()
+        return {name: Artifact(uri=uri, digest=digest)
+                for name, uri, digest in rows}
+
+    # -- lineage & queries ----------------------------------------------------
+
+    def executions_for_run(self, run: str) -> list[dict[str, Any]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT id, task, component, cache_key, state, start, end"
+                " FROM executions WHERE run = ? ORDER BY id", (run,)).fetchall()
+        return [dict(zip(("id", "task", "component", "cache_key", "state",
+                          "start", "end"), r)) for r in rows]
+
+    def lineage(self, digest: str) -> dict[str, Any] | None:
+        """Which execution produced this artifact, and from which inputs —
+        the KFP UI lineage-view query."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT e.execution_id, x.run, x.task FROM events e"
+                " JOIN artifacts a ON a.id = e.artifact_id"
+                " JOIN executions x ON x.id = e.execution_id"
+                " WHERE a.digest = ? AND e.direction = 'OUTPUT'"
+                " ORDER BY e.execution_id DESC LIMIT 1", (digest,)).fetchone()
+            if not row:
+                return None
+            eid, run, task = row
+            inputs = self._db.execute(
+                "SELECT e.name, a.digest FROM events e"
+                " JOIN artifacts a ON a.id = e.artifact_id"
+                " WHERE e.execution_id = ? AND e.direction = 'INPUT'",
+                (eid,)).fetchall()
+        return {"run": run, "task": task,
+                "inputs": {name: d for name, d in inputs}}
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

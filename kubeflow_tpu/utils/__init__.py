@@ -1,0 +1,6 @@
+"""Shared utilities: cron parsing, (more to come: prometheus-style metrics
+registry, yaml spec loading)."""
+
+from kubeflow_tpu.utils import cron
+
+__all__ = ["cron"]

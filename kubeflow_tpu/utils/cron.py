@@ -1,0 +1,65 @@
+"""Minimal 5-field cron parser for ScheduledRun (SURVEY.md §2.5, ⊘
+kubeflow/pipelines `backend/src/crd/controller/scheduledworkflow` which uses
+robfig/cron). Supports `*`, lists, ranges, and `*/step` per field:
+minute hour day-of-month month day-of-week (0=Sunday).
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+
+
+class CronError(ValueError):
+    pass
+
+
+_BOUNDS = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+
+
+def _parse_field(text: str, lo: int, hi: int) -> set[int]:
+    out: set[int] = set()
+    for part in text.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+            if step < 1:
+                raise CronError(f"bad step in {text!r}")
+        if part in ("*", ""):
+            lo2, hi2 = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            lo2, hi2 = int(a), int(b)
+        else:
+            lo2 = hi2 = int(part)
+        if not (lo <= lo2 <= hi and lo <= hi2 <= hi and lo2 <= hi2):
+            raise CronError(f"field {text!r} out of range [{lo},{hi}]")
+        out.update(range(lo2, hi2 + 1, step))
+    return out
+
+
+def parse(expr: str) -> list[set[int]]:
+    fields = expr.split()
+    if len(fields) != 5:
+        raise CronError(f"expected 5 fields, got {len(fields)}: {expr!r}")
+    return [_parse_field(f, lo, hi)
+            for f, (lo, hi) in zip(fields, _BOUNDS)]
+
+
+def next_fire(expr: str, after: float) -> float:
+    """Next matching time strictly after `after` (unix seconds, localtime),
+    minute granularity."""
+    minutes, hours, doms, months, dows = parse(expr)
+    t = int(after // 60 + 1) * 60
+    for _ in range(60 * 24 * 366 * 4):   # four years of minutes, then give up
+        st = time.localtime(t)
+        if (st.tm_min in minutes and st.tm_hour in hours
+                and st.tm_mon in months
+                # k8s cron: dom/dow are OR'd when both restricted
+                and (st.tm_mday in doms or (st.tm_wday + 1) % 7 in dows
+                     if len(doms) < 31 and len(dows) < 7
+                     else st.tm_mday in doms and (st.tm_wday + 1) % 7 in dows)):
+            return float(t)
+        t += 60
+    raise CronError(f"no fire time within 4 years for {expr!r}")

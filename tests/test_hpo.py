@@ -319,7 +319,6 @@ class TestExperimentE2E:
         opt = exp["status"]["currentOptimalTrial"]
         p = opt["parameterAssignments"]
         assert opt["objectiveValue"] == pytest.approx(
-            (p["X"] - 0.3) ** 2 if False else
             (p["x"] - 0.3) ** 2 + (p["y"] + 0.2) ** 2, rel=1e-6)
         # observation carries the metric series aggregates
         metrics = {m["name"]: m for m in opt["observation"]["metrics"]}
@@ -362,6 +361,28 @@ class TestExperimentE2E:
         cond = [c for c in exp["status"]["conditions"]
                 if c["type"] == JobConditionType.FAILED][0]
         assert cond["reason"] == "InvalidSpec"
+
+    def test_trial_parameter_rename_keeps_history_space_keyed(
+            self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        exp = make_experiment("ren-e2e", algorithm="tpe", max_trials=5,
+                              settings={"n_initial_points": 2})
+        exp["spec"]["trialTemplate"] = {
+            "trialParameters": [{"name": "XX", "reference": "x"},
+                                {"name": "YY", "reference": "y"}],
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"backend": "thread", "target": "hpo_quad",
+                             "env": {"X": "${trialParameters.XX}",
+                                     "Y": "${trialParameters.YY}"},
+                             "resources": {"cpu": 1}},
+            }}}}
+        cluster.store.create(exp)
+        done = wait_exp(cluster, "ren-e2e")
+        assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+        # assignments stay space-keyed so model-based history works
+        opt = done["status"]["currentOptimalTrial"]
+        assert set(opt["parameterAssignments"]) == {"x", "y"}
 
     def test_tpe_experiment_improves_over_first_trials(self, hpo_cluster):
         cluster, _ = hpo_cluster

@@ -1,0 +1,314 @@
+"""Pipelines tests — KFP test-strategy analog (SURVEY.md §4.3): compiler
+golden-shape tests, launcher/metadata units, and e2e DAG runs on the
+in-process cluster (thread + subprocess backends), including cache hits and
+lineage.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import pytest
+
+from kubeflow_tpu import pipelines as kfp
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.utils import cron
+
+# -- components used throughout -----------------------------------------------
+
+
+@dsl.component
+def double(n: int) -> int:
+    return n * 2
+
+
+@dsl.component
+def add(a: int, b: int = 10) -> int:
+    return a + b
+
+
+class Stats(NamedTuple):
+    total: int
+    mean: float
+
+
+@dsl.component
+def stats(x: int, y: int) -> Stats:
+    from typing import NamedTuple  # noqa: F401  (components self-import)
+    class Stats(NamedTuple):
+        total: int
+        mean: float
+    return Stats(total=x + y, mean=(x + y) / 2)
+
+
+@dsl.component
+def boom() -> int:
+    raise RuntimeError("kaboom")
+
+
+@dsl.pipeline(name="demo", description="diamond dag")
+def demo(n: int = 3):
+    a = double(n=n)
+    b = double(n=a.output)
+    c = add(a=a.output)
+    s = stats(x=b.output, y=c.output)
+    return s
+
+
+# -- DSL / compiler -----------------------------------------------------------
+
+
+class TestCompiler:
+    def test_ir_shape(self):
+        spec = kfp.compile_pipeline(demo)
+        assert spec["pipelineInfo"]["name"] == "demo"
+        assert set(spec["components"]) == {"double", "add", "stats"}
+        tasks = spec["root"]["dag"]["tasks"]
+        assert set(tasks) == {"double", "double-2", "add", "stats"}
+        assert tasks["double-2"]["inputs"]["n"] == {
+            "taskOutput": {"task": "double", "output": "Output"}}
+        assert tasks["double"]["inputs"]["n"] == {"pipelineParam": "n"}
+        assert tasks["stats"]["dependencies"] == ["add", "double-2"]
+        assert spec["parameters"] == {"n": 3}
+        assert spec["components"]["stats"]["outputs"] == {
+            "total": {"type": "int"}, "mean": {"type": "float"}}
+        # source embedded and decorator-stripped → self-contained IR
+        assert spec["components"]["double"]["source"].startswith("def double")
+
+    def test_component_plain_call(self):
+        assert double(n=4) == 8   # outside pipeline context: normal function
+
+    def test_compile_is_deterministic(self):
+        assert kfp.compile_pipeline(demo) == kfp.compile_pipeline(demo)
+
+    def test_unknown_and_missing_inputs(self):
+        @dsl.pipeline
+        def bad_unknown():
+            double(m=1)
+        with pytest.raises(dsl.DSLError, match="unknown inputs"):
+            kfp.compile_pipeline(bad_unknown)
+
+        @dsl.pipeline
+        def bad_missing():
+            add()
+        with pytest.raises(dsl.DSLError, match="missing inputs"):
+            kfp.compile_pipeline(bad_missing)
+
+    def test_passing_task_not_output_raises(self):
+        @dsl.pipeline
+        def bad():
+            a = double(n=1)
+            double(n=a)
+        with pytest.raises(dsl.DSLError, match="not the task"):
+            kfp.compile_pipeline(bad)
+
+    def test_empty_pipeline_raises(self):
+        @dsl.pipeline
+        def empty():
+            pass
+        with pytest.raises(dsl.DSLError, match="no tasks"):
+            kfp.compile_pipeline(empty)
+
+    def test_explicit_after_ordering(self):
+        @dsl.pipeline
+        def ordered():
+            a = double(n=1)
+            double(n=2).after(a)
+        spec = kfp.compile_pipeline(ordered)
+        assert spec["root"]["dag"]["tasks"]["double-2"]["dependencies"] == [
+            "double"]
+
+
+# -- launcher -----------------------------------------------------------------
+
+
+class TestLauncher:
+    def test_run_task_roundtrip(self, tmp_path):
+        import json
+        comp = dsl.component(lambda: None)  # placeholder; build by hand
+        spec = {"functionName": "f", "outputs": {"Output": {"type": "int"}},
+                "source": "def f(a, b=1):\n    return a + b\n"}
+        (tmp_path / "component.json").write_text(json.dumps(spec))
+        (tmp_path / "inputs.json").write_text('{"a": 41}')
+        out = kfp.run_task(str(tmp_path))
+        assert out == {"Output": 42}
+        assert json.loads((tmp_path / "outputs.json").read_text()) == {
+            "Output": 42}
+
+
+# -- metadata store -----------------------------------------------------------
+
+
+class TestMetadata:
+    def test_execution_cache_and_lineage(self, tmp_path):
+        md = kfp.MetadataStore()
+        store = kfp.ArtifactStore(str(tmp_path))
+        md.get_or_create_context("default/r1")
+        eid = md.create_execution("default/r1", "t1", "double", "ck-1")
+        a_in = store.put_json(21)
+        md.record_io(eid, "n", a_in, "INPUT")
+        a_out = store.put_json(42)
+        md.finish_execution(eid, "COMPLETE", {"Output": a_out})
+
+        hit = md.cached_outputs("ck-1")
+        assert hit is not None and hit["Output"].digest == a_out.digest
+        assert md.cached_outputs("ck-missing") is None
+
+        lin = md.lineage(a_out.digest)
+        assert lin["task"] == "t1" and lin["inputs"]["n"] == a_in.digest
+        execs = md.executions_for_run("default/r1")
+        assert len(execs) == 1 and execs[0]["state"] == "COMPLETE"
+
+    def test_failed_execution_not_cached(self):
+        md = kfp.MetadataStore()
+        eid = md.create_execution("r", "t", "c", "ck")
+        md.finish_execution(eid, "FAILED")
+        assert md.cached_outputs("ck") is None
+
+
+# -- cron ---------------------------------------------------------------------
+
+
+class TestCron:
+    def test_every_five_minutes(self):
+        base = time.mktime((2026, 7, 29, 10, 2, 0, 0, 0, -1))
+        nxt = cron.next_fire("*/5 * * * *", base)
+        assert time.localtime(nxt).tm_min == 5
+
+    def test_specific_time_and_validation(self):
+        base = time.mktime((2026, 7, 29, 10, 2, 0, 0, 0, -1))
+        nxt = cron.next_fire("30 14 * * *", base)
+        st = time.localtime(nxt)
+        assert (st.tm_hour, st.tm_min) == (14, 30)
+        with pytest.raises(cron.CronError):
+            cron.parse("61 * * * *")
+        with pytest.raises(cron.CronError):
+            cron.parse("* * * *")
+
+
+# -- e2e ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pipe_cluster(tmp_path):
+    c = Cluster(n_devices=8)
+    ctrl = c.add(kfp.PipelineRunController, root=str(tmp_path))
+    c.add(kfp.ScheduledRunController)
+    with c:
+        yield c, ctrl
+
+
+def wait_run(cluster, name, timeout=60):
+    return cluster.wait_for(kfp.RUN_KIND, name,
+                            lambda o: is_finished(o["status"]),
+                            timeout=timeout)
+
+
+class TestRunE2E:
+    def test_diamond_dag_thread_backend(self, pipe_cluster):
+        cluster, ctrl = pipe_cluster
+        spec = kfp.compile_pipeline(demo)
+        cluster.store.create(new_resource(kfp.RUN_KIND, "r1", spec={
+            "pipelineSpec": spec, "parameters": {"n": 5}}))
+        run = wait_run(cluster, "r1")
+        assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+            run["status"]
+        # n=5: a=10, b=20, c=20, stats.total=40, mean=20.0
+        assert ctrl.task_output("r1", "stats", "total") == 40
+        assert ctrl.task_output("r1", "stats", "mean") == 20.0
+        execs = ctrl.metadata.executions_for_run("default/r1")
+        assert {e["task"] for e in execs} == {"double", "double-2", "add",
+                                              "stats"}
+        assert all(e["state"] == "COMPLETE" for e in execs)
+
+    def test_cache_hit_on_rerun(self, pipe_cluster):
+        cluster, ctrl = pipe_cluster
+        spec = kfp.compile_pipeline(demo)
+        for name in ("c1", "c2"):
+            cluster.store.create(new_resource(kfp.RUN_KIND, name, spec={
+                "pipelineSpec": spec, "parameters": {"n": 5}}))
+            wait_run(cluster, name)
+        run2 = cluster.store.get(kfp.RUN_KIND, "c2")
+        states = {t: s["state"] for t, s in run2["status"]["tasks"].items()}
+        assert set(states.values()) == {"Cached"}
+        # changing a parameter misses the cache
+        cluster.store.create(new_resource(kfp.RUN_KIND, "c3", spec={
+            "pipelineSpec": spec, "parameters": {"n": 6}}))
+        run3 = wait_run(cluster, "c3")
+        assert run3["status"]["tasks"]["double"]["state"] == "Succeeded"
+
+    def test_failing_task_fails_run(self, pipe_cluster):
+        cluster, _ = pipe_cluster
+
+        @dsl.pipeline
+        def failing():
+            add(a=boom().output)
+        cluster.store.create(new_resource(kfp.RUN_KIND, "f1", spec={
+            "pipelineSpec": kfp.compile_pipeline(failing)}))
+        run = wait_run(cluster, "f1")
+        cond = [c for c in run["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert "boom" in cond["message"]
+        assert "kaboom" in run["status"]["tasks"]["boom"]["message"]
+        # downstream task never started
+        assert "add" not in run["status"]["tasks"]
+
+    def test_subprocess_backend(self, pipe_cluster):
+        cluster, ctrl = pipe_cluster
+
+        @dsl.pipeline
+        def small(n: int = 4):
+            double(n=n)
+        cluster.store.create(new_resource(kfp.RUN_KIND, "sub1", spec={
+            "pipelineSpec": kfp.compile_pipeline(small),
+            "backend": "subprocess"}))
+        run = wait_run(cluster, "sub1", timeout=120)
+        assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+            run["status"]
+        assert ctrl.task_output("sub1", "double") == 8
+
+    def test_pipeline_ref_and_missing_ref(self, pipe_cluster):
+        cluster, ctrl = pipe_cluster
+        spec = kfp.compile_pipeline(demo)
+        cluster.store.create(new_resource(kfp.PIPELINE_KIND, "demo-pl",
+                                          spec=spec))
+        cluster.store.create(new_resource(kfp.RUN_KIND, "ref1", spec={
+            "pipelineRef": "demo-pl"}))
+        run = wait_run(cluster, "ref1")
+        assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+        # default n=3: a=6, b=12, c=16 → total=28
+        assert ctrl.task_output("ref1", "stats", "total") == 28
+
+        cluster.store.create(new_resource(kfp.RUN_KIND, "ref2", spec={
+            "pipelineRef": "nope"}))
+        run2 = wait_run(cluster, "ref2")
+        cond = [c for c in run2["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert cond["reason"] == "PipelineNotFound"
+
+    def test_scheduled_run_interval(self, pipe_cluster):
+        cluster, _ = pipe_cluster
+
+        @dsl.pipeline
+        def tick(n: int = 1):
+            double(n=n)
+        cluster.store.create(new_resource(kfp.SCHEDULED_KIND, "sched", spec={
+            "schedule": {"intervalSeconds": 0.3},
+            "maxRuns": 2,
+            "runSpec": {"pipelineSpec": kfp.compile_pipeline(tick)},
+        }))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            runs = cluster.store.list(kfp.RUN_KIND, labels={
+                "kubeflow-tpu/scheduled-by": "sched"})
+            if len(runs) == 2 and all(is_finished(r["status"]) for r in runs):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("scheduled runs did not complete")
+        sched = cluster.store.get(kfp.SCHEDULED_KIND, "sched")
+        assert sched["status"]["runCount"] == 2
