@@ -162,7 +162,8 @@ class Controller:
             try:
                 ns, name = key.split("/", 1)
                 obj = self.store.try_get(self.kind, name, ns)
-                requeue = self.reconcile(obj) if obj is not None else None
+                requeue = (self.reconcile(obj) if obj is not None
+                           else self.reconcile_deleted(name, ns))
                 self.queue.forget(key)
                 if requeue is not None:
                     self.queue.add(key, requeue)
@@ -177,6 +178,11 @@ class Controller:
 
     def reconcile(self, obj: dict[str, Any]) -> float | None:
         raise NotImplementedError
+
+    def reconcile_deleted(self, name: str, namespace: str) -> float | None:
+        """Hook for controllers holding out-of-store resources (servers,
+        sockets) — the finalizer analog. Default: nothing to clean."""
+        return None
 
 
 class Cluster:

@@ -189,8 +189,11 @@ class ExperimentController(Controller):
                               "ExperimentRunning", "trials running")
         self.store.mutate(EXPERIMENT_KIND, name, write, ns)
 
+        # Katib semantics: fail once failed trials REACH the budget;
+        # maxFailedTrialCount=0 means "fail on the first failure", not
+        # "fail immediately with none".
         max_failed = spec.get("maxFailedTrialCount", 3)
-        if len(failed) >= max_failed:
+        if failed and len(failed) >= max(1, max_failed):
             self._finish(exp, JobConditionType.FAILED,
                          "MaxFailedTrialsReached",
                          f"{len(failed)} failed trials >= {max_failed}")

@@ -154,9 +154,7 @@ class PipelineRunController(Controller):
                          f"{len(dag)} tasks completed "
                          f"({sum(1 for t in tasks.values() if t.get('state') == 'Cached')} cached)")
             return None
-        if not status.get("conditions") or changed:
-            return 0.05
-        return 0.2
+        return 0.05 if changed else 0.2
 
     # -- task lifecycle -------------------------------------------------------
 
@@ -270,7 +268,8 @@ class PipelineRunController(Controller):
         ns = run["metadata"].get("namespace", "default")
         pod = self.store.try_get("Pod", self._pod_name(run, tname), ns)
         if pod is None:
-            return {"state": "Failed", "message": "pod disappeared"}
+            self.metadata.finish_execution(st.get("executionId", 0), "FAILED")
+            return {**st, "state": "Failed", "message": "pod disappeared"}
         phase = pod["status"].get("phase", "Pending")
         if phase == "Failed":
             err_path = os.path.join(self._task_dir(run, tname), "error.txt")
@@ -339,12 +338,15 @@ class ScheduledRunController(Controller):
             return None
 
         now = time.time()
-        next_at = status.get("nextScheduleTime")
-        if next_at is None:
-            next_at = self._next(spec, status.get("lastScheduleTime", now))
-            self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]
-                              .update(nextScheduleTime=next_at), ns)
+        # recompute from the spec every pass: editing spec.schedule takes
+        # effect immediately instead of waiting out a stale persisted time
+        base = status.get("lastScheduleTime",
+                          sched["metadata"].get("creationTimestamp", now))
+        next_at = self._next(spec, base)
         if now < next_at:
+            if status.get("nextScheduleTime") != next_at:
+                self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]
+                                  .update(nextScheduleTime=next_at), ns)
             return min(next_at - now, 1.0)
 
         run = new_resource(RUN_KIND, f"{name}-{count}",

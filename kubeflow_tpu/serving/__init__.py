@@ -1,0 +1,29 @@
+"""Model serving — the KServe analog (SURVEY.md §2.4).
+
+kserve-style Model/ModelServer with V1 + V2 (Open Inference Protocol) REST
+dataplanes, dynamic batching, storage initializer, and an InferenceService
+controller providing canary traffic splits and scale-to-zero behind a
+per-service router (the Knative/Istio analog).
+"""
+
+from kubeflow_tpu.serving.batching import DynamicBatcher
+from kubeflow_tpu.serving.controller import (ISVC_KIND,
+                                             InferenceServiceController,
+                                             validate_isvc)
+from kubeflow_tpu.serving.model import (FunctionModel, Model, ModelError,
+                                        ModelRepository, load_model,
+                                        serving_runtime)
+from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
+                                           InferTensor, ProtocolError,
+                                           v1_decode, v1_encode)
+from kubeflow_tpu.serving.router import Router
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.storage import StorageError, download
+
+__all__ = [
+    "DynamicBatcher", "FunctionModel", "ISVC_KIND", "InferRequest",
+    "InferResponse", "InferTensor", "InferenceServiceController", "Model",
+    "ModelError", "ModelRepository", "ModelServer", "ProtocolError",
+    "Router", "StorageError", "download", "load_model", "serving_runtime",
+    "v1_decode", "v1_encode", "validate_isvc",
+]

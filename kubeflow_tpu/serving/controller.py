@@ -1,0 +1,310 @@
+"""InferenceService controller — the kserve reconciler analog (SURVEY.md
+§2.4, §3.5; ⊘ kserve `pkg/controller/v1beta1/inferenceservice/controller.go`
++ `reconcilers/knative/ksvc_reconciler.go`).
+
+Spec (kserve shape, canary made explicit):
+
+    kind: InferenceService
+    spec:
+      predictor:
+        model:
+          modelFormat: mean | echo | python | ...   # ServingRuntime registry
+          uri: file:///...                          # storage initializer
+          config: {...}                             # runtime kwargs
+        minReplicas: 1          # 0 → scale-to-zero via router activator
+        scaleToZeroIdleSeconds: 60
+        batching: {maxBatchSize: 16, maxLatencyMs: 5}
+      transformer:
+        className: pkg.mod:TransformerClass         # pre/postprocess wrapper
+      canaryTrafficPercent: 20        # with spec.canary.model = new revision
+      canary: {model: {...}}
+    status:
+      url (router), components.{predictor,canary}.{ready,port,revision}
+
+Where kserve materializes Knative Services, this controller materializes
+in-process ModelServer instances (the revision analog) behind a per-service
+Router (the Istio/Knative ingress analog): same control loop — resolve
+runtime, storage-init, wait ready, shift traffic, scale to zero on idle.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from typing import Any
+
+from kubeflow_tpu.control.conditions import JobConditionType, set_condition
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.pipelines.artifacts import json_digest
+from kubeflow_tpu.serving import storage
+from kubeflow_tpu.serving.model import (Model, ModelError, ModelRepository,
+                                        load_model)
+from kubeflow_tpu.serving.router import Router
+from kubeflow_tpu.serving.server import ModelServer
+
+ISVC_KIND = "InferenceService"
+
+
+def validate_isvc(isvc: dict[str, Any]) -> list[str]:
+    spec = isvc.get("spec", {})
+    errs = []
+    model = spec.get("predictor", {}).get("model")
+    if not model:
+        errs.append("spec.predictor.model is required")
+    elif not model.get("modelFormat"):
+        errs.append("spec.predictor.model.modelFormat is required")
+    pct = spec.get("canaryTrafficPercent", 0)
+    if not isinstance(pct, int) or not 0 <= pct <= 100:
+        errs.append("canaryTrafficPercent must be an int in [0,100]")
+    if pct > 0 and not spec.get("canary", {}).get("model"):
+        errs.append("canaryTrafficPercent > 0 requires spec.canary.model")
+    return errs
+
+
+class _Transformer(Model):
+    """Chains a transformer's pre/postprocess around a predictor — the
+    transformer-component analog (kserve runs it as a separate service; here
+    it wraps in-process, same dataplane contract)."""
+
+    def __init__(self, inner: Model, transformer: Model):
+        super().__init__(inner.name)
+        self.inner = inner
+        self.transformer = transformer
+
+    def load(self) -> None:
+        self.inner.load()
+        if not self.inner.ready:
+            self.inner._mark_ready()
+        self._mark_ready()
+
+    def preprocess(self, payload):
+        return self.transformer.preprocess(payload)
+
+    def predict(self, payload):
+        return self.inner.predict(self.inner.preprocess(payload))
+
+    def postprocess(self, result):
+        return self.transformer.postprocess(self.inner.postprocess(result))
+
+
+class _Instance:
+    """One running revision: model + server (the Knative revision analog)."""
+
+    def __init__(self, isvc_name: str, component: str, revision: str,
+                 server: ModelServer):
+        self.isvc_name = isvc_name
+        self.component = component
+        self.revision = revision
+        self.server = server
+
+    def stop(self) -> None:
+        self.server.stop()
+
+
+class InferenceServiceController(Controller):
+    kind = ISVC_KIND
+    resync_period = 1.0
+
+    def __init__(self, cluster, artifact_root: str | None = None):
+        super().__init__(cluster)
+        self.artifact_root = artifact_root
+        self._lock = threading.RLock()
+        # keys carry the namespace: two ISVCs named alike in different
+        # namespaces must never share a router or a model server
+        self._instances: dict[tuple[str, str, str], _Instance] = {}
+        self._routers: dict[tuple[str, str], Router] = {}
+
+    def stop(self) -> None:
+        super().stop()
+        with self._lock:
+            for inst in self._instances.values():
+                inst.stop()
+            self._instances.clear()
+            for r in self._routers.values():
+                r.stop()
+            self._routers.clear()
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile_deleted(self, name: str, namespace: str) -> float | None:
+        for component in ("predictor", "canary"):
+            self._stop_instance(namespace, name, component)
+        with self._lock:
+            router = self._routers.pop((namespace, name), None)
+        if router is not None:
+            router.stop()
+        return None
+
+    def reconcile(self, isvc: dict[str, Any]) -> float | None:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        errs = validate_isvc(isvc)
+        if errs:
+            self.store.mutate(ISVC_KIND, name, lambda o: set_condition(
+                o["status"], JobConditionType.FAILED, "InvalidSpec",
+                "; ".join(errs)), ns)
+            return None
+        spec = isvc["spec"]
+        router = self._ensure_router(isvc)
+
+        components = {}
+        scale_to_zero = spec.get("predictor", {}).get("minReplicas", 1) == 0
+        # default predictor
+        try:
+            default = self._reconcile_component(
+                isvc, "predictor", spec["predictor"],
+                lazy=scale_to_zero)
+        except (ModelError, storage.StorageError, ImportError) as e:
+            self.store.mutate(ISVC_KIND, name, lambda o: set_condition(
+                o["status"], JobConditionType.FAILED, "ModelLoadFailed",
+                str(e)), ns)
+            return None
+        components["predictor"] = default
+
+        pct = spec.get("canaryTrafficPercent", 0)
+        canary = None
+        if pct > 0:
+            canary_spec = dict(spec["canary"])
+            canary_spec.setdefault("batching",
+                                   spec["predictor"].get("batching"))
+            canary = self._reconcile_component(isvc, "canary", canary_spec,
+                                               lazy=False)
+            components["canary"] = canary
+        else:
+            self._stop_instance(ns, name, "canary")
+
+        self._scale_to_zero_check(isvc, default)
+        router.set_backends(
+            default.get("port"),
+            canary.get("port") if canary else None, pct)
+
+        def write(o):
+            o["status"]["url"] = router.url
+            o["status"]["components"] = components
+            o["status"]["traffic"] = {"canaryPercent": pct}
+            if default.get("ready") or (scale_to_zero
+                                        and default.get("scaledToZero")):
+                set_condition(o["status"], "Ready", "PredictorReady",
+                              "predictor is ready" if default.get("ready")
+                              else "scaled to zero; activates on request")
+        self.store.mutate(ISVC_KIND, name, write, ns)
+        return 1.0 if scale_to_zero else None
+
+    # -- component lifecycle --------------------------------------------------
+
+    @staticmethod
+    def _revision_of(comp_spec: dict[str, Any]) -> str:
+        return json_digest(comp_spec)[:12]
+
+    def _build_model(self, isvc: dict[str, Any],
+                     comp_spec: dict[str, Any]) -> Model:
+        mspec = comp_spec["model"]
+        uri = mspec.get("uri")
+        local = None
+        if uri:
+            local = storage.download(uri, artifact_root=self.artifact_root)
+        model = load_model(mspec["modelFormat"], isvc["metadata"]["name"],
+                           uri=local, **mspec.get("config", {}))
+        tspec = isvc["spec"].get("transformer")
+        if tspec and tspec.get("className"):
+            mod, _, cls = tspec["className"].partition(":")
+            transformer = getattr(importlib.import_module(mod), cls)(
+                model.name)
+            model = _Transformer(model, transformer)
+        return model
+
+    def _start_instance(self, isvc: dict[str, Any], component: str,
+                        comp_spec: dict[str, Any]) -> _Instance:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        model = self._build_model(isvc, comp_spec)
+        repo = ModelRepository()
+        repo.register(model)   # loads; raises on failure
+        batching = comp_spec.get("batching")
+        server = ModelServer(
+            repo, name=f"{name}-{component}",
+            batching={model.name: batching} if batching else None)
+        server.start()
+        inst = _Instance(name, component, self._revision_of(comp_spec),
+                         server)
+        with self._lock:
+            self._instances[(ns, name, component)] = inst
+        return inst
+
+    def _reconcile_component(self, isvc: dict[str, Any], component: str,
+                             comp_spec: dict[str, Any],
+                             lazy: bool) -> dict[str, Any]:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        revision = self._revision_of(comp_spec)
+        with self._lock:
+            inst = self._instances.get((ns, name, component))
+        if inst is not None and inst.revision != revision:
+            self._stop_instance(ns, name, component)   # rollout: replace
+            inst = None
+        if inst is None:
+            if lazy:
+                return {"ready": False, "scaledToZero": True,
+                        "revision": revision}
+            inst = self._start_instance(isvc, component, comp_spec)
+        return {"ready": True, "port": inst.server.port,
+                "revision": inst.revision}
+
+    def _stop_instance(self, ns: str, name: str, component: str) -> None:
+        with self._lock:
+            inst = self._instances.pop((ns, name, component), None)
+        if inst is not None:
+            inst.stop()
+
+    # -- scale to zero --------------------------------------------------------
+
+    def _ensure_router(self, isvc: dict[str, Any]) -> Router:
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        with self._lock:
+            router = self._routers.get((ns, name))
+            if router is None:
+                router = Router(
+                    f"{ns}/{name}",
+                    activator=lambda: self._activate(ns, name))
+                self._routers[(ns, name)] = router
+            return router
+
+    def _activate(self, ns: str, name: str) -> int | None:
+        """Router callback on scale-from-zero: start the predictor now."""
+        isvc = self.store.try_get(ISVC_KIND, name, ns)
+        if isvc is None:
+            return None
+        with self._lock:
+            inst = self._instances.get((ns, name, "predictor"))
+            if inst is None:
+                inst = self._start_instance(isvc, "predictor",
+                                            isvc["spec"]["predictor"])
+        self.queue.add(self.key_of(isvc))   # refresh status.components
+        return inst.server.port
+
+    def _scale_to_zero_check(self, isvc: dict[str, Any],
+                             default: dict[str, Any]) -> None:
+        spec = isvc["spec"].get("predictor", {})
+        if spec.get("minReplicas", 1) != 0:
+            return
+        idle = float(spec.get("scaleToZeroIdleSeconds", 60))
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        with self._lock:
+            router = self._routers.get((ns, name))
+            inst = self._instances.get((ns, name, "predictor"))
+        if inst is None or router is None:
+            return
+        last = router.last_request_time
+        if last and time.time() - last > idle:
+            self._stop_instance(ns, name, "predictor")
+            default.update(ready=False, scaledToZero=True)
+            default.pop("port", None)
+
+    # -- queries --------------------------------------------------------------
+
+    def url_of(self, name: str, namespace: str = "default") -> str:
+        isvc = self.store.get(ISVC_KIND, name, namespace)
+        return isvc["status"]["url"]

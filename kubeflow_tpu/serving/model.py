@@ -1,0 +1,181 @@
+"""Serving model abstraction — the `kserve.Model` analog (SURVEY.md §2.4,
+⊘ kserve `python/kserve/kserve/model.py`).
+
+A Model has the kserve lifecycle: `load()` → ready; per-request
+`preprocess → predict → postprocess`, optional `explain`. A ModelRepository
+holds many named models (the multi-model serving analog). ServingRuntimes
+map a modelFormat string to a loader — the ClusterServingRuntime analog
+(⊘ kserve `pkg/apis/serving/v1alpha1/servingruntime_types.go`): the
+InferenceService controller resolves `spec.predictor.model.modelFormat`
+through this registry exactly like KServe resolves runtime images.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+
+class ModelError(Exception):
+    pass
+
+
+class Model:
+    """Subclass and override load/predict (and optionally pre/postprocess,
+    explain). predict receives and returns protocol-level dicts or numpy
+    arrays depending on the caller; batchable models should accept stacked
+    inputs."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+        self.load_time: float | None = None
+
+    def load(self) -> None:
+        self.ready = True
+
+    def _mark_ready(self) -> None:
+        self.ready = True
+        self.load_time = time.time()
+
+    def preprocess(self, payload: Any) -> Any:
+        return payload
+
+    def predict(self, payload: Any) -> Any:
+        raise NotImplementedError
+
+    def postprocess(self, result: Any) -> Any:
+        return result
+
+    def explain(self, payload: Any) -> Any:
+        raise ModelError(f"model {self.name!r} does not support explain")
+
+    def unload(self) -> None:
+        self.ready = False
+
+    # -- metadata (V2 model-metadata endpoint) --------------------------------
+
+    def input_spec(self) -> list[dict[str, Any]]:
+        return []
+
+    def output_spec(self) -> list[dict[str, Any]]:
+        return []
+
+
+class FunctionModel(Model):
+    """Wrap a plain callable as a model (the custom-predictor shortcut)."""
+
+    def __init__(self, name: str, fn: Callable[[Any], Any],
+                 explainer: Callable[[Any], Any] | None = None):
+        super().__init__(name)
+        self.fn = fn
+        self.explainer = explainer
+
+    def load(self) -> None:
+        self._mark_ready()
+
+    def predict(self, payload: Any) -> Any:
+        return self.fn(payload)
+
+    def explain(self, payload: Any) -> Any:
+        if self.explainer is None:
+            return super().explain(payload)
+        return self.explainer(payload)
+
+
+class ModelRepository:
+    """Named-model registry with readiness tracking (multi-model serving,
+    ⊘ kserve `pkg/agent` puller's repository)."""
+
+    def __init__(self):
+        self._models: dict[str, Model] = {}
+        self._lock = threading.RLock()
+
+    def register(self, model: Model, load: bool = True) -> Model:
+        with self._lock:
+            self._models[model.name] = model
+        if load and not model.ready:
+            model.load()
+            if not model.ready:
+                model._mark_ready()
+        return model
+
+    def get(self, name: str) -> Model:
+        with self._lock:
+            m = self._models.get(name)
+        if m is None:
+            raise ModelError(f"model {name!r} not found")
+        return m
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            m = self._models.pop(name, None)
+        if m is not None:
+            m.unload()
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def ready(self, name: str) -> bool:
+        try:
+            return self.get(name).ready
+        except ModelError:
+            return False
+
+
+# -- serving runtimes ---------------------------------------------------------
+
+_RUNTIMES: dict[str, Callable[..., Model]] = {}
+
+
+def serving_runtime(model_format: str):
+    """Register a loader: (name, uri, **config) -> Model."""
+    def deco(fn):
+        _RUNTIMES[model_format] = fn
+        return fn
+    return deco
+
+
+def load_model(model_format: str, name: str, uri: str | None = None,
+               **config: Any) -> Model:
+    if model_format not in _RUNTIMES:
+        raise ModelError(
+            f"no serving runtime for modelFormat {model_format!r}; "
+            f"known: {sorted(_RUNTIMES)}")
+    return _RUNTIMES[model_format](name, uri, **config)
+
+
+@serving_runtime("python")
+def _python_runtime(name: str, uri: str | None, *, className: str,
+                    **config: Any) -> Model:
+    """className = "pkg.module:ClassName"; class(name, uri=..., **config)."""
+    mod_name, _, cls_name = className.partition(":")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    return cls(name, uri=uri, **config)
+
+
+@serving_runtime("echo")
+def _echo_runtime(name: str, uri: str | None, **config: Any) -> Model:
+    """Diagnostic runtime used by tests and smoke checks."""
+    return FunctionModel(name, lambda payload: payload)
+
+
+def unwrap_single_tensor(payload: Any) -> Any:
+    """V2 requests arrive as {tensor_name: array}; simple single-input
+    models accept either dataplane by unwrapping a one-entry dict."""
+    if isinstance(payload, dict) and len(payload) == 1:
+        return next(iter(payload.values()))
+    return payload
+
+
+@serving_runtime("mean")
+def _mean_runtime(name: str, uri: str | None, **config: Any) -> Model:
+    """Tiny numeric runtime: row-wise mean (the sklearn-iris-demo analog)."""
+    return FunctionModel(
+        name, lambda x: np.asarray(unwrap_single_tensor(x),
+                                   dtype=np.float64).mean(axis=-1))

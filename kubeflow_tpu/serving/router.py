@@ -1,0 +1,127 @@
+"""Traffic router — the Istio ingress + Knative activator analog (SURVEY.md
+§3.5: "Istio ingress ⇉ Knative activator/queue-proxy (concurrency,
+scale-from-zero)").
+
+One Router per InferenceService: an HTTP reverse proxy that
+  - splits traffic between the default and canary backends by percentage
+    (deterministic modular schedule, so a 20% canary gets exactly every
+    5th request — testable, no RNG flakes);
+  - on scale-to-zero services, calls the activator hook to spin the backend
+    up on first request and records last-request time for idle scale-down.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+class Router:
+    def __init__(self, name: str, port: int = 0,
+                 activator: Callable[[], int | None] | None = None,
+                 activation_timeout: float = 30.0):
+        self.name = name
+        self.activator = activator
+        self.activation_timeout = activation_timeout
+        self._lock = threading.Lock()
+        self._default_port: int | None = None
+        self._canary_port: int | None = None
+        self._canary_percent = 0
+        self._count = 0
+        self.canary_count = 0
+        self.total_count = 0
+        self.last_request_time: float = 0.0
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _proxy(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                code, body = router.forward(self.command, self.path, raw)
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _proxy
+            do_POST = _proxy
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=f"router-{name}").start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def set_backends(self, default_port: int | None,
+                     canary_port: int | None = None,
+                     canary_percent: int = 0) -> None:
+        with self._lock:
+            self._default_port = default_port
+            self._canary_port = canary_port
+            self._canary_percent = max(0, min(100, int(canary_percent)))
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing --------------------------------------------------------------
+
+    def _pick(self) -> tuple[int | None, bool]:
+        with self._lock:
+            self._count += 1
+            n, pct = self._count, self._canary_percent
+            use_canary = (self._canary_port is not None and pct > 0
+                          and (n * pct) // 100 > ((n - 1) * pct) // 100)
+            return ((self._canary_port, True) if use_canary
+                    else (self._default_port, False))
+
+    def forward(self, method: str, path: str, body: bytes
+                ) -> tuple[int, bytes]:
+        self.last_request_time = time.time()
+        port, is_canary = self._pick()
+        if port is None and self.activator is not None:
+            port = self._activate()
+        if port is None:
+            return 503, json.dumps(
+                {"error": f"{self.name}: no ready backend"}).encode()
+        with self._lock:
+            self.total_count += 1
+            if is_canary:
+                self.canary_count += 1
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            conn.request(method, path, body=body or None,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+        except OSError as e:
+            return 502, json.dumps(
+                {"error": f"backend unreachable: {e}"}).encode()
+
+    def _activate(self) -> int | None:
+        """Scale-from-zero: ask the controller to start the backend, then
+        wait for it (the Knative activator hold-and-release)."""
+        deadline = time.monotonic() + self.activation_timeout
+        port = self.activator()
+        while port is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            port = self.activator()
+        if port is not None:
+            with self._lock:
+                self._default_port = port
+        return port

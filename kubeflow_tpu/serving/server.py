@@ -1,0 +1,211 @@
+"""Model server — the kserve ModelServer analog (SURVEY.md §2.4, §3.5,
+⊘ kserve `python/kserve/kserve/model_server.py` `ModelServer.start` and
+`kserve/protocol/rest/server.py`).
+
+Threaded HTTP server speaking both dataplanes:
+
+    V1:  POST /v1/models/<m>:predict | :explain
+    V2:  GET  /v2                     (server metadata)
+         GET  /v2/health/live|ready
+         GET  /v2/models/<m>         (model metadata)
+         GET  /v2/models/<m>/ready
+         POST /v2/models/<m>/infer
+    GET /metrics                      (prometheus text, request counters)
+
+Optional per-model dynamic batching (serving/batching.py). One server
+instance is the "pod" of an InferenceService revision; the controller
+manages instances and the router splits traffic — the Knative/Istio analog.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from kubeflow_tpu.serving.batching import DynamicBatcher
+from kubeflow_tpu.serving.model import Model, ModelError, ModelRepository
+from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
+                                           ProtocolError, v1_decode,
+                                           v1_encode)
+
+
+class ModelServer:
+    def __init__(self, repository: ModelRepository | None = None,
+                 port: int = 0, name: str = "kubeflow-tpu-server",
+                 batching: dict[str, Any] | None = None):
+        self.repository = repository or ModelRepository()
+        self.name = name
+        self._batchers: dict[str, DynamicBatcher] = {}
+        self._batch_cfg = batching or {}
+        self._metrics_lock = threading.Lock()
+        self.request_count: dict[tuple[str, str], int] = {}
+        self.latency_sum: dict[str, float] = {}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _send(self, code: int, payload: dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    self._send(*server._handle_get(self.path))
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length)
+                    self._send(*server._handle_post(self.path, raw))
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, background: bool = True) -> "ModelServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"model-server-{self.port}")
+        self._thread.start()
+        if not background:
+            self._thread.join()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for b in self._batchers.values():
+            b.stop()
+        self._batchers.clear()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- routing --------------------------------------------------------------
+
+    def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:
+        if path in ("/", "/v2"):
+            return 200, {"name": self.name, "version": "2",
+                         "extensions": ["health", "models", "metrics"]}
+        if path == "/v2/health/live":
+            return 200, {"live": True}
+        if path == "/v2/health/ready":
+            ready = all(self.repository.ready(n)
+                        for n in self.repository.names())
+            return (200 if ready else 503), {"ready": ready}
+        if path == "/v1/models" or path == "/v2/models":
+            return 200, {"models": self.repository.names()}
+        if path == "/metrics":
+            return 200, self._metrics()
+        parts = path.strip("/").split("/")
+        if len(parts) >= 3 and parts[0] == "v2" and parts[1] == "models":
+            name = parts[2]
+            if len(parts) == 4 and parts[3] == "ready":
+                ok = self.repository.ready(name)
+                return (200 if ok else 503), {"name": name, "ready": ok}
+            if len(parts) == 3:
+                try:
+                    m = self.repository.get(name)
+                except ModelError as e:
+                    return 404, {"error": str(e)}
+                return 200, {"name": name, "platform": "jax-tpu",
+                             "inputs": m.input_spec(),
+                             "outputs": m.output_spec()}
+        return 404, {"error": f"no route {path}"}
+
+    def _handle_post(self, path: str, raw: bytes) -> tuple[int, dict[str, Any]]:
+        try:
+            body = json.loads(raw) if raw else {}
+        except json.JSONDecodeError as e:
+            return 400, {"error": f"bad json: {e}"}
+        parts = path.strip("/").split("/")
+        try:
+            if len(parts) == 3 and parts[0] == "v1" and parts[1] == "models":
+                name, _, verb = parts[2].partition(":")
+                return self._v1(name, verb or "predict", body)
+            if (len(parts) == 4 and parts[0] == "v2"
+                    and parts[1] == "models" and parts[3] == "infer"):
+                return self._v2_infer(parts[2], body)
+        except ProtocolError as e:
+            return 400, {"error": str(e)}
+        except ModelError as e:
+            return 404, {"error": str(e)}
+        return 404, {"error": f"no route {path}"}
+
+    # -- dataplanes -----------------------------------------------------------
+
+    def _predictor(self, model: Model):
+        cfg = self._batch_cfg.get(model.name)
+        if not cfg:
+            return model.predict
+        if model.name not in self._batchers:
+            self._batchers[model.name] = DynamicBatcher(
+                model.predict,
+                max_batch_size=int(cfg.get("maxBatchSize", 16)),
+                max_latency_ms=float(cfg.get("maxLatencyMs", 5.0)))
+        return self._batchers[model.name]
+
+    def _observe(self, model: str, verb: str, dt: float) -> None:
+        with self._metrics_lock:
+            key = (model, verb)
+            self.request_count[key] = self.request_count.get(key, 0) + 1
+            self.latency_sum[model] = self.latency_sum.get(model, 0.0) + dt
+
+    def _v1(self, name: str, verb: str, body: dict[str, Any]
+            ) -> tuple[int, dict[str, Any]]:
+        model = self.repository.get(name)
+        if not model.ready:
+            return 503, {"error": f"model {name!r} not ready"}
+        instances = v1_decode(body)
+        t0 = time.perf_counter()
+        payload = model.preprocess(instances)
+        if verb == "predict":
+            result = self._predictor(model)(payload)
+        elif verb == "explain":
+            result = model.explain(payload)
+        else:
+            return 400, {"error": f"unknown verb {verb!r}"}
+        result = model.postprocess(result)
+        self._observe(name, verb, time.perf_counter() - t0)
+        return 200, v1_encode(result)
+
+    def _v2_infer(self, name: str, body: dict[str, Any]
+                  ) -> tuple[int, dict[str, Any]]:
+        model = self.repository.get(name)
+        if not model.ready:
+            return 503, {"error": f"model {name!r} not ready"}
+        req = InferRequest.from_json(name, body)
+        t0 = time.perf_counter()
+        payload = model.preprocess(req.as_dict())
+        result = model.postprocess(self._predictor(model)(payload))
+        self._observe(name, "infer", time.perf_counter() - t0)
+        return 200, InferResponse.from_result(name, result,
+                                              id=req.id).to_json()
+
+    # -- metrics --------------------------------------------------------------
+
+    def _metrics(self) -> dict[str, Any]:
+        with self._metrics_lock:
+            return {
+                "request_count": {f"{m}:{v}": n for (m, v), n
+                                  in self.request_count.items()},
+                "latency_sum_s": dict(self.latency_sum),
+            }

@@ -1,0 +1,54 @@
+"""Model storage initializer — the kserve storage-initializer analog
+(SURVEY.md §2.4, ⊘ kserve `python/kserve/kserve/storage/storage.py`
+`Storage.download`).
+
+Resolves a model URI to a local path before the predictor loads:
+  - `file:///path` or a bare path — used directly (or copied if copy=True)
+  - `ktpu://<digest>` — fetched from a pipelines ArtifactStore root
+    (KTPU_ARTIFACT_ROOT env or explicit root), linking training outputs to
+    serving exactly like KFP artifacts feed KServe
+  - `gs://`, `s3://`, `hf://` — recognized but unavailable in this
+    offline environment; raise with a clear message (the cloud SDK hooks
+    belong here).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class StorageError(Exception):
+    pass
+
+
+def download(uri: str, dest_dir: str | None = None, *,
+             artifact_root: str | None = None, copy: bool = False) -> str:
+    """Resolve `uri` to a local filesystem path (the /mnt/models analog)."""
+    if uri.startswith("ktpu://"):
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        root = artifact_root or os.environ.get("KTPU_ARTIFACT_ROOT")
+        if not root:
+            raise StorageError(
+                "ktpu:// uri needs artifact_root (or KTPU_ARTIFACT_ROOT)")
+        path = ArtifactStore(root).resolve(uri)
+    elif uri.startswith("file://"):
+        path = uri[len("file://"):]
+    elif any(uri.startswith(s) for s in ("gs://", "s3://", "hf://",
+                                         "https://", "http://")):
+        raise StorageError(
+            f"scheme of {uri!r} requires network access, unavailable here; "
+            "mount the model locally and use file://")
+    else:
+        path = uri
+    if not os.path.exists(path):
+        raise StorageError(f"model path does not exist: {path}")
+    if not copy or dest_dir is None:
+        return path
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, os.path.basename(path.rstrip("/")))
+    if os.path.isdir(path):
+        shutil.copytree(path, dest, dirs_exist_ok=True)
+    else:
+        shutil.copyfile(path, dest)
+    return dest

@@ -31,6 +31,8 @@ def _parse_field(text: str, lo: int, hi: int) -> set[int]:
         elif "-" in part:
             a, b = part.split("-", 1)
             lo2, hi2 = int(a), int(b)
+        elif step != 1:
+            lo2, hi2 = int(part), hi   # standard cron: "30/15" = 30..max/15
         else:
             lo2 = hi2 = int(part)
         if not (lo <= lo2 <= hi and lo <= hi2 <= hi and lo2 <= hi2):

@@ -179,6 +179,10 @@ class TestCron:
         nxt = cron.next_fire("*/5 * * * *", base)
         assert time.localtime(nxt).tm_min == 5
 
+    def test_value_slash_step_spans_to_max(self):
+        # standard cron: "30/15" in the minute field = 30, 45
+        assert cron.parse("30/15 * * * *")[0] == {30, 45}
+
     def test_specific_time_and_validation(self):
         base = time.mktime((2026, 7, 29, 10, 2, 0, 0, 0, -1))
         nxt = cron.next_fire("30 14 * * *", base)

@@ -1,0 +1,353 @@
+"""Serving tests — kserve test-strategy analog (SURVEY.md §4.3): protocol
+round-trips with a dummy Model, real HTTP against ModelServer, and e2e
+InferenceService reconciles (canary split, rollout, scale-to-zero) like the
+kserve sklearn-iris e2e, minus the cluster.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import serving
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import has_condition
+from kubeflow_tpu.serving.model import FunctionModel, ModelRepository
+from kubeflow_tpu.serving.protocol import InferRequest, InferTensor
+
+# -- helpers ------------------------------------------------------------------
+
+
+def http_json(url: str, method: str, path: str, body=None):
+    host, port = url.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp.status, data
+
+
+class SquareModel(serving.Model):
+    """Dummy model used across tests; batch-shaped in/out."""
+
+    def __init__(self, name, uri=None, **cfg):
+        super().__init__(name)
+
+    def load(self):
+        self._mark_ready()
+
+    def predict(self, payload):
+        if isinstance(payload, dict):   # V2 tensor dict
+            x = payload["x"]
+            return {"y": np.asarray(x, dtype=np.float32) ** 2}
+        return (np.asarray(payload, dtype=np.float64) ** 2).tolist()
+
+    def input_spec(self):
+        return [{"name": "x", "datatype": "FP32", "shape": [-1]}]
+
+
+# -- protocol -----------------------------------------------------------------
+
+
+class TestProtocol:
+    def test_v2_tensor_roundtrip(self):
+        t = InferTensor(name="x", data=np.arange(6, dtype=np.float32)
+                        .reshape(2, 3))
+        j = t.to_json()
+        assert j["datatype"] == "FP32" and j["shape"] == [2, 3]
+        back = InferTensor.from_json(j)
+        np.testing.assert_array_equal(back.data, t.data)
+
+    def test_v2_request_validation(self):
+        with pytest.raises(serving.ProtocolError):
+            InferRequest.from_json("m", {})
+        with pytest.raises(serving.ProtocolError):
+            InferTensor.from_json({"name": "x", "shape": [3],
+                                   "datatype": "FP99", "data": [1, 2, 3]})
+        with pytest.raises(serving.ProtocolError):
+            InferTensor.from_json({"name": "x", "shape": [2, 2],
+                                   "datatype": "FP32", "data": [1, 2, 3]})
+
+    def test_v1_codec(self):
+        assert serving.v1_decode({"instances": [[1, 2]]}) == [[1, 2]]
+        with pytest.raises(serving.ProtocolError):
+            serving.v1_decode({"inputs": []})
+        enc = serving.v1_encode(np.array([1.0, 2.0]))
+        assert enc == {"predictions": [1.0, 2.0]}
+
+
+# -- server -------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    repo = ModelRepository()
+    repo.register(SquareModel("sq"))
+    s = serving.ModelServer(repo).start()
+    yield s
+    s.stop()
+
+
+class TestModelServer:
+    def test_v1_predict(self, server):
+        code, out = http_json(server.url, "POST", "/v1/models/sq:predict",
+                              {"instances": [[1, 2], [3, 4]]})
+        assert code == 200
+        assert out["predictions"] == [[1.0, 4.0], [9.0, 16.0]]
+
+    def test_v2_infer(self, server):
+        code, out = http_json(server.url, "POST", "/v2/models/sq/infer", {
+            "id": "r1",
+            "inputs": [{"name": "x", "shape": [3], "datatype": "FP32",
+                        "data": [1, 2, 3]}]})
+        assert code == 200 and out["id"] == "r1"
+        assert out["outputs"][0]["name"] == "y"
+        assert out["outputs"][0]["data"] == [1.0, 4.0, 9.0]
+
+    def test_metadata_and_health(self, server):
+        assert http_json(server.url, "GET", "/v2")[0] == 200
+        assert http_json(server.url, "GET", "/v2/health/live")[1]["live"]
+        assert http_json(server.url, "GET", "/v2/health/ready")[1]["ready"]
+        code, meta = http_json(server.url, "GET", "/v2/models/sq")
+        assert code == 200 and meta["inputs"][0]["name"] == "x"
+        assert http_json(server.url, "GET", "/v2/models/sq/ready")[0] == 200
+        assert http_json(server.url, "GET", "/v2/models/nope")[0] == 404
+
+    def test_explain_unsupported_and_metrics(self, server):
+        code, out = http_json(server.url, "POST", "/v1/models/sq:explain",
+                              {"instances": [[1]]})
+        assert code == 404 and "explain" in out["error"]
+        http_json(server.url, "POST", "/v1/models/sq:predict",
+                  {"instances": [[1]]})
+        _, metrics = http_json(server.url, "GET", "/metrics")
+        assert metrics["request_count"]["sq:predict"] >= 1
+
+
+# -- dynamic batching ---------------------------------------------------------
+
+
+class TestBatching:
+    def test_batches_concurrent_requests(self):
+        batch_sizes = []
+
+        def fn(x):
+            batch_sizes.append(len(x))
+            return np.asarray(x) * 2
+
+        b = serving.DynamicBatcher(fn, max_batch_size=8, max_latency_ms=50)
+        results = [None] * 6
+
+        def call(i):
+            results[i] = b(np.array([[i]]))
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b.stop()
+        assert max(batch_sizes) > 1          # coalescing happened
+        for i in range(6):
+            assert results[i].tolist() == [[2 * i]]
+
+    def test_error_propagates_to_all(self):
+        def bad(x):
+            raise ValueError("nope")
+        b = serving.DynamicBatcher(bad, max_batch_size=4, max_latency_ms=5)
+        with pytest.raises(ValueError, match="nope"):
+            b(np.array([[1]]))
+        b.stop()
+
+
+# -- storage ------------------------------------------------------------------
+
+
+class TestStorage:
+    def test_file_and_plain_paths(self, tmp_path):
+        p = tmp_path / "weights.bin"
+        p.write_bytes(b"w")
+        assert serving.download(f"file://{p}") == str(p)
+        assert serving.download(str(p)) == str(p)
+        with pytest.raises(serving.StorageError, match="does not exist"):
+            serving.download(str(tmp_path / "missing"))
+        with pytest.raises(serving.StorageError, match="network"):
+            serving.download("gs://bucket/model")
+
+    def test_ktpu_artifact_uri(self, tmp_path):
+        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+        store = ArtifactStore(str(tmp_path))
+        art = store.put_json({"w": [1, 2]})
+        local = serving.download(art.uri, artifact_root=str(tmp_path))
+        assert json.load(open(local)) == {"w": [1, 2]}
+
+
+# -- InferenceService e2e -----------------------------------------------------
+
+
+def make_isvc(name, *, fmt="mean", canary_pct=0, canary_fmt="echo",
+              min_replicas=1, idle=60, batching=None):
+    spec = {"predictor": {"model": {"modelFormat": fmt},
+                          "minReplicas": min_replicas,
+                          "scaleToZeroIdleSeconds": idle}}
+    if batching:
+        spec["predictor"]["batching"] = batching
+    if canary_pct:
+        spec["canaryTrafficPercent"] = canary_pct
+        spec["canary"] = {"model": {"modelFormat": canary_fmt}}
+    return new_resource(serving.ISVC_KIND, name, spec=spec)
+
+
+@pytest.fixture()
+def isvc_cluster():
+    c = Cluster(n_devices=8)
+    ctrl = c.add(serving.InferenceServiceController)
+    with c:
+        yield c, ctrl
+
+
+def wait_ready(cluster, name, timeout=30):
+    return cluster.wait_for(
+        serving.ISVC_KIND, name,
+        lambda o: has_condition(o["status"], "Ready"), timeout=timeout)
+
+
+class TestInferenceServiceE2E:
+    def test_predict_through_router(self, isvc_cluster):
+        cluster, _ = isvc_cluster
+        cluster.store.create(make_isvc("iris"))
+        isvc = wait_ready(cluster, "iris")
+        url = isvc["status"]["url"]
+        code, out = http_json(url, "POST", "/v1/models/iris:predict",
+                              {"instances": [[1.0, 2.0, 3.0]]})
+        assert code == 200 and out["predictions"] == [2.0]
+
+    def test_invalid_spec(self, isvc_cluster):
+        cluster, _ = isvc_cluster
+        bad = make_isvc("bad")
+        del bad["spec"]["predictor"]["model"]["modelFormat"]
+        cluster.store.create(bad)
+        isvc = cluster.wait_for(
+            serving.ISVC_KIND, "bad",
+            lambda o: has_condition(o["status"], "Failed"), timeout=30)
+        assert "model" in isvc["status"]["conditions"][0]["message"]
+
+    def test_canary_split_exact(self, isvc_cluster):
+        cluster, ctrl = isvc_cluster
+        cluster.store.create(make_isvc("canary", canary_pct=25))
+        isvc = wait_ready(cluster, "canary")
+        url = isvc["status"]["url"]
+        for _ in range(20):
+            code, _ = http_json(url, "POST", "/v1/models/canary:predict",
+                                {"instances": [[2.0, 4.0]]})
+            assert code == 200
+        router = ctrl._routers[("default", "canary")]
+        assert router.canary_count == 5    # exactly 25% of 20, deterministic
+
+    def test_revision_rollout(self, isvc_cluster):
+        cluster, ctrl = isvc_cluster
+        cluster.store.create(make_isvc("roll"))
+        isvc = wait_ready(cluster, "roll")
+        rev1 = isvc["status"]["components"]["predictor"]["revision"]
+        # update model format → new revision replaces old
+        cluster.store.mutate(serving.ISVC_KIND, "roll", lambda o: o["spec"]
+                             ["predictor"]["model"].update(modelFormat="echo"))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            cur = cluster.store.get(serving.ISVC_KIND, "roll")
+            rev2 = cur["status"]["components"]["predictor"]["revision"]
+            if rev2 != rev1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("revision did not roll")
+        url = cur["status"]["url"]
+        code, out = http_json(url, "POST", "/v1/models/roll:predict",
+                              {"instances": [[7]]})
+        assert out["predictions"] == [[7]]   # echo now
+
+    def test_scale_to_zero_and_activation(self, isvc_cluster):
+        cluster, ctrl = isvc_cluster
+        cluster.store.create(make_isvc("zero", min_replicas=0, idle=0.5))
+        isvc = wait_ready(cluster, "zero")
+        comp = isvc["status"]["components"]["predictor"]
+        assert comp.get("scaledToZero") and not comp["ready"]
+        # first request activates
+        url = isvc["status"]["url"]
+        code, out = http_json(url, "POST", "/v1/models/zero:predict",
+                              {"instances": [[4.0, 6.0]]})
+        assert code == 200 and out["predictions"] == [5.0]
+        # idle long enough → scaled back down
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with ctrl._lock:
+                gone = ("zero", "predictor") not in ctrl._instances
+            if gone:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("did not scale back to zero")
+
+    def test_namespace_isolation_and_delete_cleanup(self, isvc_cluster):
+        cluster, ctrl = isvc_cluster
+        a = make_isvc("same", fmt="mean")
+        a["metadata"]["namespace"] = "ns-a"
+        b = make_isvc("same", fmt="echo")
+        b["metadata"]["namespace"] = "ns-b"
+        cluster.store.create(a)
+        cluster.store.create(b)
+        ia = cluster.wait_for(serving.ISVC_KIND, "same",
+                              lambda o: has_condition(o["status"], "Ready"),
+                              namespace="ns-a", timeout=30)
+        ib = cluster.wait_for(serving.ISVC_KIND, "same",
+                              lambda o: has_condition(o["status"], "Ready"),
+                              namespace="ns-b", timeout=30)
+        assert ia["status"]["url"] != ib["status"]["url"]
+        # each namespace gets its own model: mean vs echo
+        _, oa = http_json(ia["status"]["url"], "POST",
+                          "/v1/models/same:predict", {"instances": [[2, 4]]})
+        _, ob = http_json(ib["status"]["url"], "POST",
+                          "/v1/models/same:predict", {"instances": [[2, 4]]})
+        assert oa["predictions"] == [3.0] and ob["predictions"] == [[2, 4]]
+        # deleting one cleans its server + router, leaves the other serving
+        cluster.store.delete(serving.ISVC_KIND, "same", "ns-a")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with ctrl._lock:
+                gone = (("ns-a", "same", "predictor") not in ctrl._instances
+                        and ("ns-a", "same") not in ctrl._routers)
+            if gone:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("deleted ISVC resources not cleaned up")
+        code, _ = http_json(ib["status"]["url"], "POST",
+                            "/v1/models/same:predict", {"instances": [[1]]})
+        assert code == 200
+
+    def test_batched_predictor(self, isvc_cluster):
+        cluster, _ = isvc_cluster
+        cluster.store.create(make_isvc(
+            "batched", batching={"maxBatchSize": 8, "maxLatencyMs": 20}))
+        isvc = wait_ready(cluster, "batched")
+        url = isvc["status"]["url"]
+        codes = []
+
+        def call():
+            code, out = http_json(url, "POST", "/v1/models/batched:predict",
+                                  {"instances": [[3.0, 5.0]]})
+            codes.append((code, out["predictions"]))
+
+        threads = [threading.Thread(target=call) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(c == 200 and p == [4.0] for c, p in codes)
