@@ -1,0 +1,19 @@
+"""User-facing resource API: spec builders, YAML IO, Platform, API server.
+
+The L7 layer (SURVEY.md §7.1): CRD-shaped YAML in, running platform behind
+it. See `kubeflow_tpu.sdk` for the per-subsystem client classes and
+`kubeflow_tpu.cli` for tpukctl.
+"""
+
+from kubeflow_tpu.api.platform import Platform
+from kubeflow_tpu.api.server import ApiClient, ApiError, ApiServer
+from kubeflow_tpu.api.specs import (ValidationError, dump_yaml, experiment,
+                                    inference_service, jaxjob, load_yaml,
+                                    load_yaml_file, pipeline_run,
+                                    scheduled_run, validate)
+
+__all__ = [
+    "ApiClient", "ApiError", "ApiServer", "Platform", "ValidationError",
+    "dump_yaml", "experiment", "inference_service", "jaxjob", "load_yaml",
+    "load_yaml_file", "pipeline_run", "scheduled_run", "validate",
+]

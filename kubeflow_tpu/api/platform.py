@@ -1,0 +1,138 @@
+"""Platform — the full control plane in one object.
+
+The reference deploys its components as separate managers (training-operator,
+katib controllers, kserve controller, KFP api-server — SURVEY.md §2.7
+dependency graph); here one Platform wires them all onto a single Cluster
+(store + gang scheduler + executor), which is the single-process deployment
+model this framework targets (SURVEY.md §7.0).
+
+`apply` implements kubectl-apply semantics: validate (admission), create, or
+update spec if the object exists (status is preserved; the reconciler reacts
+to the MODIFIED event).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable
+
+from kubeflow_tpu import hpo
+from kubeflow_tpu.api.specs import ValidationError, load_yaml_file, validate
+from kubeflow_tpu.control import Cluster, JAXJobController
+from kubeflow_tpu.control.conditions import is_finished
+from kubeflow_tpu.control.store import NotFoundError
+from kubeflow_tpu.pipelines.controllers import (PipelineRunController,
+                                                ScheduledRunController)
+from kubeflow_tpu.serving.controller import InferenceServiceController
+
+
+class Platform:
+    """All controllers on one cluster.
+
+    Usage:
+        with Platform() as p:
+            p.apply_file("examples/mnist-jaxjob.yaml")
+            job = p.wait("JAXJob", "mnist")
+    """
+
+    def __init__(self, n_devices: int | None = None,
+                 root: str | None = None):
+        self.root = root or tempfile.mkdtemp(prefix="kubeflow-tpu-")
+        self.cluster = Cluster(n_devices=n_devices)
+        self.cluster.executor.log_dir = os.path.join(self.root, "logs")
+        os.makedirs(self.cluster.executor.log_dir, exist_ok=True)
+        self.cluster.add(JAXJobController)
+        self.hpo_db = hpo.add_hpo_controllers(
+            self.cluster, metrics_dir=os.path.join(self.root, "metrics"))
+        self.pipelines = self.cluster.add(
+            PipelineRunController, root=os.path.join(self.root, "pipelines"))
+        self.cluster.add(ScheduledRunController)
+        self.serving = self.cluster.add(InferenceServiceController)
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Platform":
+        if not self._started:
+            self.cluster.start()
+            self._started = True
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            self.cluster.stop()
+            self._started = False
+        hpo.set_default_db(None)
+
+    def __enter__(self) -> "Platform":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- resource API --------------------------------------------------------
+
+    @property
+    def store(self):
+        return self.cluster.store
+
+    def apply(self, obj: dict[str, Any]) -> dict[str, Any]:
+        """Create-or-update with admission validation."""
+        errs = validate(obj)
+        if errs:
+            raise ValidationError(obj.get("kind", "?"),
+                                  obj.get("metadata", {}).get("name", "?"),
+                                  errs)
+        ns = obj["metadata"].get("namespace", "default")
+        cur = self.store.try_get(obj["kind"], obj["metadata"]["name"], ns)
+        if cur is None:
+            return self.store.create(obj)
+        return self.store.mutate(
+            obj["kind"], obj["metadata"]["name"],
+            lambda o: (o.__setitem__("spec", obj.get("spec", {})),
+                       o["metadata"].__setitem__(
+                           "labels", obj["metadata"].get("labels", {}))),
+            ns)
+
+    def apply_file(self, path: str) -> list[dict[str, Any]]:
+        return [self.apply(o) for o in load_yaml_file(path)]
+
+    def get(self, kind: str, name: str,
+            namespace: str = "default") -> dict[str, Any]:
+        return self.store.get(kind, name, namespace)
+
+    def list(self, kind: str, namespace: str | None = "default",
+             labels: dict[str, str] | None = None) -> list[dict[str, Any]]:
+        return self.store.list(kind, namespace, labels)
+
+    def delete(self, kind: str, name: str,
+               namespace: str = "default") -> None:
+        obj = self.store.get(kind, name, namespace)
+        self.store.delete_owned_by(obj)
+        self.store.delete(kind, name, namespace)
+
+    def logs(self, pod_name: str, namespace: str = "default") -> str:
+        return self.cluster.executor.logs(pod_name, namespace)
+
+    def job_logs(self, name: str, namespace: str = "default") -> str:
+        """Concatenated logs of a job's pods (TrainingClient.get_job_logs
+        analog)."""
+        from kubeflow_tpu.control.jobs import JOB_NAME_LABEL
+        pods = self.store.list("Pod", namespace,
+                               labels={JOB_NAME_LABEL: name})
+        parts = []
+        for p in sorted(pods, key=lambda p: p["metadata"]["name"]):
+            parts.append(f"==> {p['metadata']['name']} <==")
+            parts.append(self.logs(p["metadata"]["name"], namespace))
+        if not parts:  # pods already GC'd — fall back to any log file on disk
+            parts.append(self.logs(name, namespace))
+        return "\n".join(parts)
+
+    def wait(self, kind: str, name: str,
+             predicate: Callable[[dict[str, Any]], bool] | None = None,
+             namespace: str = "default",
+             timeout: float = 300.0) -> dict[str, Any]:
+        """Wait until predicate (default: job-style finished condition)."""
+        pred = predicate or (lambda o: is_finished(o.get("status", {})))
+        return self.cluster.wait_for(kind, name, pred, namespace, timeout)

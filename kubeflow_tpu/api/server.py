@@ -1,0 +1,225 @@
+"""HTTP API server + client — the kube-apiserver / KFP api-server analog.
+
+The reference's control planes are all HTTP/gRPC services (kube-apiserver
+for CRDs, ⊘ kubeflow/pipelines `backend/src/apiserver` REST, katib
+db-manager gRPC). This server exposes the Platform's resource store over a
+small REST surface so `tpukctl --server` and remote SDK clients get a real
+client/server split:
+
+    GET    /healthz
+    GET    /version
+    GET    /apis/{kind}?namespace=NS|_all&labelSelector=k=v,k2=v2
+    GET    /apis/{kind}/{ns}/{name}
+    POST   /apis                       body = resource JSON (apply semantics)
+    DELETE /apis/{kind}/{ns}/{name}
+    GET    /logs/{ns}/{pod}
+    GET    /joblogs/{ns}/{job}
+
+JSON in/out; errors: {"error": ..., "reason": NotFound|Invalid|...}.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from kubeflow_tpu.api.platform import Platform
+from kubeflow_tpu.api.specs import ValidationError
+from kubeflow_tpu.control.conditions import is_finished
+from kubeflow_tpu.control.store import NotFoundError, StoreError
+from kubeflow_tpu.version import __version__
+
+
+class ApiServer:
+    def __init__(self, platform: Platform, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.platform = platform
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, reason: str, msg: str) -> None:
+                self._send(code, {"error": msg, "reason": reason})
+
+            def do_GET(self):
+                outer._route(self, "GET")
+
+            def do_POST(self):
+                outer._route(self, "POST")
+
+            def do_DELETE(self):
+                outer._route(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="api-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, h, method: str) -> None:
+        parsed = urllib.parse.urlparse(h.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        q = urllib.parse.parse_qs(parsed.query)
+        try:
+            if method == "GET" and parts == ["healthz"]:
+                h._send(200, {"ok": True})
+            elif method == "GET" and parts == ["version"]:
+                h._send(200, {"version": __version__})
+            elif parts[:1] == ["apis"]:
+                self._apis(h, method, parts[1:], q)
+            elif method == "GET" and parts[:1] == ["logs"] and len(parts) == 3:
+                h._send(200, {"logs": self.platform.logs(parts[2], parts[1])})
+            elif (method == "GET" and parts[:1] == ["joblogs"]
+                  and len(parts) == 3):
+                h._send(200,
+                        {"logs": self.platform.job_logs(parts[2], parts[1])})
+            else:
+                h._error(404, "NotFound", f"no route {method} {h.path}")
+        except NotFoundError as e:
+            h._error(404, "NotFound", str(e))
+        except ValidationError as e:
+            h._error(422, "Invalid", str(e))
+        except StoreError as e:
+            h._error(409, "Conflict", str(e))
+        except Exception as e:  # pragma: no cover - defensive
+            h._error(500, "InternalError", f"{type(e).__name__}: {e}")
+
+    def _apis(self, h, method: str, parts: list[str],
+              q: dict[str, list[str]]) -> None:
+        if method == "POST" and not parts:
+            length = int(h.headers.get("Content-Length", 0))
+            obj = json.loads(h.rfile.read(length))
+            h._send(200, self.platform.apply(obj))
+        elif method == "GET" and len(parts) == 1:
+            ns: str | None = q.get("namespace", ["default"])[0]
+            if ns == "_all":
+                ns = None
+            labels = None
+            if "labelSelector" in q:
+                labels = dict(kv.split("=", 1)
+                              for kv in q["labelSelector"][0].split(","))
+            h._send(200, {"items": self.platform.list(parts[0], ns, labels)})
+        elif method == "GET" and len(parts) == 3:
+            h._send(200, self.platform.get(parts[0], parts[2], parts[1]))
+        elif method == "DELETE" and len(parts) == 3:
+            self.platform.delete(parts[0], parts[2], parts[1])
+            h._send(200, {"deleted": True})
+        else:
+            h._error(404, "NotFound", f"no route {method} /apis/{parts}")
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        self.code, self.reason = code, reason
+        super().__init__(message)
+
+
+class ApiClient:
+    """HTTP client mirroring the Platform resource API — what `tpukctl
+    --server` and out-of-process SDKs use."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str,
+                 body: dict | None = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = {"error": str(e), "reason": "Unknown"}
+            raise ApiError(e.code, payload.get("reason", "Unknown"),
+                           payload.get("error", str(e))) from None
+
+    def healthy(self) -> bool:
+        try:
+            return bool(self._request("GET", "/healthz").get("ok"))
+        except Exception:
+            return False
+
+    def apply(self, obj: dict[str, Any]) -> dict[str, Any]:
+        return self._request("POST", "/apis", obj)
+
+    def get(self, kind: str, name: str,
+            namespace: str = "default") -> dict[str, Any]:
+        return self._request("GET", f"/apis/{kind}/{namespace}/{name}")
+
+    def list(self, kind: str, namespace: str | None = "default",
+             labels: dict[str, str] | None = None) -> list[dict[str, Any]]:
+        qs = {"namespace": namespace if namespace is not None else "_all"}
+        if labels:
+            qs["labelSelector"] = ",".join(f"{k}={v}"
+                                           for k, v in labels.items())
+        return self._request(
+            "GET", f"/apis/{kind}?" + urllib.parse.urlencode(qs))["items"]
+
+    def delete(self, kind: str, name: str,
+               namespace: str = "default") -> None:
+        self._request("DELETE", f"/apis/{kind}/{namespace}/{name}")
+
+    def logs(self, pod_name: str, namespace: str = "default") -> str:
+        return self._request("GET", f"/logs/{namespace}/{pod_name}")["logs"]
+
+    def job_logs(self, name: str, namespace: str = "default") -> str:
+        return self._request("GET", f"/joblogs/{namespace}/{name}")["logs"]
+
+    def wait(self, kind: str, name: str,
+             predicate: Callable[[dict[str, Any]], bool] | None = None,
+             namespace: str = "default", timeout: float = 300.0,
+             poll: float = 0.2) -> dict[str, Any]:
+        pred = predicate or (lambda o: is_finished(o.get("status", {})))
+        deadline = time.monotonic() + timeout
+        obj = None
+        while time.monotonic() < deadline:
+            try:
+                obj = self.get(kind, name, namespace)
+                if pred(obj):
+                    return obj
+            except ApiError as e:
+                if e.reason != "NotFound":
+                    raise
+            time.sleep(poll)
+        raise TimeoutError(
+            f"{kind}/{name}: predicate not met in {timeout}s; "
+            f"last status={None if obj is None else obj.get('status')}")

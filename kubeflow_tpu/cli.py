@@ -1,0 +1,241 @@
+"""tpukctl — the kubectl/kfctl-shaped CLI (SURVEY.md §7.1 L7).
+
+Two deployment modes, mirroring how the reference is driven:
+
+- **Local run** (`tpukctl run -f specs.yaml`): boots the whole Platform in
+  this process, applies every document, waits for the waitable ones to
+  finish, prints status + logs. The single-process analog of
+  `kubectl apply && kubectl wait` against a throwaway cluster.
+- **Client/server** (`tpukctl daemon` + `tpukctl apply|get|... --server`):
+  the daemon hosts Platform + ApiServer; other invocations are thin HTTP
+  clients, like kubectl against kube-apiserver. `--server` defaults from
+  env `KTPU_SERVER`.
+
+Commands: run, daemon, apply, get, describe, delete, logs, wait, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+import yaml
+
+from kubeflow_tpu.api.server import ApiClient
+from kubeflow_tpu.api.specs import load_yaml_file
+from kubeflow_tpu.control.conditions import is_finished
+from kubeflow_tpu.version import __version__
+
+# kinds whose status reaches a terminal Succeeded/Failed condition
+WAITABLE_KINDS = ("JAXJob", "Experiment", "PipelineRun", "Trial")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpukctl",
+        description="TPU-native ML platform CLI (kubectl analog)")
+    p.add_argument("--server", default=os.environ.get("KTPU_SERVER"),
+                   help="API server URL (or env KTPU_SERVER); required for "
+                        "everything except run/daemon/version")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="apply specs on an in-process platform "
+                                     "and wait for completion")
+    run.add_argument("-f", "--filename", required=True, action="append")
+    run.add_argument("--timeout", type=float, default=600.0)
+    run.add_argument("--logs", action="store_true",
+                     help="print job logs after completion")
+    run.add_argument("--devices", type=int, default=None)
+
+    daemon = sub.add_parser("daemon", help="host the platform + API server")
+    daemon.add_argument("--host", default="127.0.0.1")
+    daemon.add_argument("--port", type=int, default=8443)
+    daemon.add_argument("--devices", type=int, default=None)
+
+    apply = sub.add_parser("apply", help="apply -f file.yaml to the server")
+    apply.add_argument("-f", "--filename", required=True, action="append")
+
+    get = sub.add_parser("get", help="list/get resources")
+    get.add_argument("kind")
+    get.add_argument("name", nargs="?")
+    get.add_argument("-n", "--namespace", default="default")
+    get.add_argument("-A", "--all-namespaces", action="store_true")
+    get.add_argument("-o", "--output", choices=("wide", "yaml", "json",
+                                                "name"), default="wide")
+    get.add_argument("-l", "--selector", default=None,
+                     help="label selector k=v[,k2=v2]")
+
+    desc = sub.add_parser("describe", help="full YAML of one resource")
+    desc.add_argument("kind")
+    desc.add_argument("name")
+    desc.add_argument("-n", "--namespace", default="default")
+
+    dele = sub.add_parser("delete", help="delete a resource (+ its children)")
+    dele.add_argument("kind")
+    dele.add_argument("name")
+    dele.add_argument("-n", "--namespace", default="default")
+
+    logs = sub.add_parser("logs", help="pod logs (or all pods of a job)")
+    logs.add_argument("name")
+    logs.add_argument("-n", "--namespace", default="default")
+    logs.add_argument("--job", action="store_true",
+                      help="treat NAME as a job and aggregate its pods")
+
+    wait = sub.add_parser("wait", help="wait for terminal condition")
+    wait.add_argument("kind")
+    wait.add_argument("name")
+    wait.add_argument("-n", "--namespace", default="default")
+    wait.add_argument("--timeout", type=float, default=600.0)
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def _client(args, out) -> ApiClient | None:
+    if not args.server:
+        print("error: --server (or KTPU_SERVER) is required for this "
+              "command; use `tpukctl run` for local one-shot execution",
+              file=out)
+        return None
+    return ApiClient(args.server)
+
+
+def _phase_of(obj: dict[str, Any]) -> str:
+    conds = obj.get("status", {}).get("conditions", [])
+    for c in reversed(conds):
+        if c.get("status", "True") == "True":
+            return c["type"]
+    return obj.get("status", {}).get("phase", "Pending")
+
+
+def _print_table(objs: list[dict[str, Any]], out) -> None:
+    rows = [("NAMESPACE", "NAME", "KIND", "STATUS", "AGE")]
+    now = time.time()
+    for o in objs:
+        age = now - o["metadata"].get("creationTimestamp", now)
+        rows.append((o["metadata"].get("namespace", "default"),
+                     o["metadata"]["name"], o["kind"], _phase_of(o),
+                     f"{int(age)}s"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip(),
+              file=out)
+
+
+def _cmd_run(args, out) -> int:
+    from kubeflow_tpu.api.platform import Platform
+    docs: list[dict[str, Any]] = []
+    for fn in args.filename:
+        docs.extend(load_yaml_file(fn))
+    rc = 0
+    with Platform(n_devices=args.devices) as p:
+        for d in docs:
+            applied = p.apply(d)
+            print(f"{applied['kind']}/{applied['metadata']['name']} created",
+                  file=out)
+        for d in docs:
+            if d["kind"] not in WAITABLE_KINDS:
+                continue
+            kind, name = d["kind"], d["metadata"]["name"]
+            ns = d["metadata"].get("namespace", "default")
+            try:
+                obj = p.wait(kind, name, namespace=ns, timeout=args.timeout)
+                phase = _phase_of(obj)
+                print(f"{kind}/{name} {phase}", file=out)
+                if phase != "Succeeded":
+                    rc = 1
+            except TimeoutError as e:
+                print(f"{kind}/{name} timeout: {e}", file=out)
+                rc = 1
+            if args.logs and kind == "JAXJob":
+                print(p.job_logs(name, ns), file=out)
+    return rc
+
+
+def _cmd_daemon(args, out) -> int:
+    from kubeflow_tpu.api.platform import Platform
+    from kubeflow_tpu.api.server import ApiServer
+    with Platform(n_devices=args.devices) as p:
+        server = ApiServer(p, host=args.host, port=args.port).start()
+        print(f"API server listening on {server.url}", file=out)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None, out=None) -> int:
+    out = out or sys.stdout
+    args = _build_parser().parse_args(argv)
+
+    if args.cmd == "version":
+        print(f"tpukctl {__version__}", file=out)
+        return 0
+    if args.cmd == "run":
+        return _cmd_run(args, out)
+    if args.cmd == "daemon":
+        return _cmd_daemon(args, out)
+
+    client = _client(args, out)
+    if client is None:
+        return 2
+    try:
+        if args.cmd == "apply":
+            for fn in args.filename:
+                for d in load_yaml_file(fn):
+                    applied = client.apply(d)
+                    print(f"{applied['kind']}/"
+                          f"{applied['metadata']['name']} applied", file=out)
+        elif args.cmd == "get":
+            ns = None if args.all_namespaces else args.namespace
+            if args.name:
+                objs = [client.get(args.kind, args.name, args.namespace)]
+            else:
+                labels = (dict(kv.split("=", 1)
+                               for kv in args.selector.split(","))
+                          if args.selector else None)
+                objs = client.list(args.kind, ns, labels)
+            if args.output == "json":
+                print(json.dumps(objs if not args.name else objs[0],
+                                 indent=2, default=str), file=out)
+            elif args.output == "yaml":
+                print(yaml.safe_dump_all(objs, sort_keys=False), file=out)
+            elif args.output == "name":
+                for o in objs:
+                    print(f"{o['kind'].lower()}/{o['metadata']['name']}",
+                          file=out)
+            else:
+                _print_table(objs, out)
+        elif args.cmd == "describe":
+            obj = client.get(args.kind, args.name, args.namespace)
+            print(yaml.safe_dump(obj, sort_keys=False), file=out)
+        elif args.cmd == "delete":
+            client.delete(args.kind, args.name, args.namespace)
+            print(f"{args.kind}/{args.name} deleted", file=out)
+        elif args.cmd == "logs":
+            if args.job:
+                print(client.job_logs(args.name, args.namespace), file=out)
+            else:
+                print(client.logs(args.name, args.namespace), file=out)
+        elif args.cmd == "wait":
+            obj = client.wait(args.kind, args.name, namespace=args.namespace,
+                              timeout=args.timeout)
+            phase = _phase_of(obj)
+            print(f"{args.kind}/{args.name} {phase}", file=out)
+            return 0 if phase == "Succeeded" else 1
+    except Exception as e:
+        print(f"error: {e}", file=out)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

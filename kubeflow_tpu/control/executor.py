@@ -44,6 +44,17 @@ def worker_target(name: str | None = None):
 
 
 def get_target(name: str) -> Callable[..., Any]:
+    """Resolve a pod target: registered name, built-in (lazily imported so
+    the executor doesn't pull in jax), or dotted "pkg.mod:fn" path — the
+    image-reference analog."""
+    if name not in _TARGETS and ":" in name:
+        import importlib
+        mod, _, attr = name.partition(":")
+        return getattr(importlib.import_module(mod), attr)
+    if name not in _TARGETS:
+        import importlib
+        for builtin in ("kubeflow_tpu.training.job",):
+            importlib.import_module(builtin)
     return _TARGETS[name]
 
 
@@ -53,6 +64,71 @@ class _RunningPod:
         self.proc: subprocess.Popen | None = None
         self.log_path: str | None = None
         self.log_buffer: list[str] = []
+
+
+class _StdoutRouter:
+    """Per-thread stdout routing so thread-backend pods get real log capture
+    (the kubelet's container-stdout file analog). Installed lazily over
+    sys.stdout; threads registered here write to their pod log file, all
+    other threads pass through untouched.
+
+    Limitation inherent to stdout proxying: a `contextlib.redirect_stdout`
+    entered on another thread *before* a pod starts and exited *after* will
+    restore the router with the redirect target still wrapped; pass-through
+    output then goes to that target until the next install(). Closed-stream
+    writes self-heal to the real stdout."""
+
+    _installed: "_StdoutRouter | None" = None
+    _install_lock = threading.Lock()
+
+    def __init__(self, wrapped):
+        self._wrapped = wrapped
+        self._routes: dict[int, Any] = {}
+
+    @classmethod
+    def install(cls) -> "_StdoutRouter":
+        with cls._install_lock:
+            if cls._installed is None:
+                cls._installed = cls(sys.stdout)
+            # Something else (pytest capture, user code) may have replaced
+            # sys.stdout since we last installed — rewrap the current one so
+            # pass-through writes keep going to the active stdout.
+            if sys.stdout is not cls._installed:
+                cls._installed._wrapped = sys.stdout
+                sys.stdout = cls._installed
+            return cls._installed
+
+    def register(self, fileobj) -> None:
+        with self._install_lock:
+            self._routes[threading.get_ident()] = fileobj
+
+    def unregister(self) -> None:
+        with self._install_lock:
+            self._routes.pop(threading.get_ident(), None)
+
+    def write(self, s: str) -> int:
+        f = self._routes.get(threading.get_ident())
+        if f is not None:
+            f.write(s)
+            f.flush()
+            return len(s)
+        try:
+            return self._wrapped.write(s)
+        except ValueError:
+            # wrapped stream was closed underneath us (a capture/redirect
+            # that ended after we rewrapped) — fall back to the real stdout
+            self._wrapped = sys.__stdout__
+            return self._wrapped.write(s)
+
+    def flush(self) -> None:
+        f = self._routes.get(threading.get_ident())
+        try:
+            (f or self._wrapped).flush()
+        except ValueError:  # closed underlying stream
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self._wrapped, name)
 
 
 class PodExecutor:
@@ -125,7 +201,7 @@ class PodExecutor:
         backend = spec.get("backend", "thread")
         try:
             if backend == "thread":
-                exit_code = self._run_thread(spec, env, rp)
+                exit_code = self._run_thread(pod, spec, env, rp)
             elif backend == "subprocess":
                 exit_code = self._run_subprocess(pod, spec, env, rp)
             else:
@@ -144,25 +220,34 @@ class PodExecutor:
         else:
             self._set_phase(pod, "Failed", exitCode=exit_code)
 
-    def _run_thread(self, spec, env, rp: _RunningPod) -> int:
+    def _run_thread(self, pod, spec, env, rp: _RunningPod) -> int:
         fn = get_target(spec["target"])
-        try:
-            fn(env, rp.cancel)
-            return 0
-        except SystemExit as e:
-            return int(e.code or 0)
-        except Exception:
-            rp.log_buffer.append(traceback.format_exc())
-            return 1
+        rp.log_path = self._log_path(pod)
+        router = _StdoutRouter.install()
+        with open(rp.log_path, "w", errors="replace") as logf:
+            router.register(logf)
+            try:
+                fn(env, rp.cancel)
+                return 0
+            except SystemExit as e:
+                return int(e.code or 0)
+            except Exception:
+                logf.write(traceback.format_exc())
+                return 1
+            finally:
+                router.unregister()
+
+    def _log_path(self, pod) -> str:
+        return os.path.join(
+            self.log_dir,
+            f"{pod['metadata'].get('namespace', 'default')}."
+            f"{pod['metadata']['name']}.{pod['metadata']['uid'][:8]}.log")
 
     def _run_subprocess(self, pod, spec, env, rp: _RunningPod) -> int:
         argv = spec.get("argv") or [sys.executable, "-c", spec["command"]]
         full_env = dict(os.environ)
         full_env.update(env)
-        rp.log_path = os.path.join(
-            self.log_dir,
-            f"{pod['metadata'].get('namespace', 'default')}."
-            f"{pod['metadata']['name']}.{pod['metadata']['uid'][:8]}.log")
+        rp.log_path = self._log_path(pod)
         with open(rp.log_path, "wb") as logf:
             rp.proc = subprocess.Popen(
                 argv, env=full_env, stdout=logf, stderr=subprocess.STDOUT,

@@ -1,0 +1,12 @@
+"""SDK clients — TrainingClient / KatibClient / ServingClient /
+PipelineClient analogs (SURVEY.md §2.2/§2.3/§2.4/§2.5 "Python SDK" rows).
+
+Each client works against either an in-process `Platform` or a remote
+`ApiClient` backend.
+"""
+
+from kubeflow_tpu.sdk.clients import (KatibClient, PipelineClient,
+                                      ServingClient, TrainingClient)
+
+__all__ = ["KatibClient", "PipelineClient", "ServingClient",
+           "TrainingClient"]

@@ -1,0 +1,203 @@
+"""Python SDK clients — the reference's per-subsystem SDK surface.
+
+Mirrors (⊘ kubeflow/training `sdk/python/kubeflow/training/api/
+training_client.py`, katib `sdk/python/v1beta1/kubeflow/katib/api/
+katib_client.py`, `kfp.Client`, kserve `python/kserve/kserve/api/`):
+the same verbs, re-hosted on this framework's resource API.
+
+Every client takes a `backend` that is either an in-process
+`Platform` or an HTTP `ApiClient` (both expose apply/get/list/delete/
+wait/job_logs) — the SDK code is identical either way, exactly how the
+reference SDKs speak to kube-apiserver whether in- or out-of-cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Callable
+
+from kubeflow_tpu.api import specs
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.control.jobs import JOB_KIND
+from kubeflow_tpu.hpo.experiment import EXPERIMENT_KIND
+from kubeflow_tpu.hpo.trial import EXPERIMENT_LABEL, TRIAL_KIND
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.pipelines.controllers import RUN_KIND, SCHEDULED_KIND
+from kubeflow_tpu.serving.controller import ISVC_KIND
+
+
+class _ClientBase:
+    def __init__(self, backend, namespace: str = "default"):
+        self.backend = backend
+        self.namespace = namespace
+
+
+class TrainingClient(_ClientBase):
+    """TrainingClient analog: create/inspect/wait/delete JAXJobs."""
+
+    def create_job(self, job: dict[str, Any] | None = None, *,
+                   name: str | None = None, **kwargs) -> dict[str, Any]:
+        """Pass a full JAXJob resource, or builder kwargs (see
+        `specs.jaxjob`)."""
+        if job is None:
+            if name is None:
+                raise ValueError("name is required when building from kwargs")
+            job = specs.jaxjob(name, namespace=self.namespace, **kwargs)
+        return self.backend.apply(job)
+
+    def get_job(self, name: str) -> dict[str, Any]:
+        return self.backend.get(JOB_KIND, name, self.namespace)
+
+    def list_jobs(self) -> list[dict[str, Any]]:
+        return self.backend.list(JOB_KIND, self.namespace)
+
+    def get_job_logs(self, name: str) -> str:
+        return self.backend.job_logs(name, self.namespace)
+
+    def wait_for_job_conditions(
+            self, name: str,
+            expected: tuple[str, ...] = (JobConditionType.SUCCEEDED,),
+            timeout: float = 300.0) -> dict[str, Any]:
+        """Wait until the job reaches any of `expected` (or any terminal
+        state — a job that Failed while we wait for Succeeded raises)."""
+        job = self.backend.wait(
+            JOB_KIND, name,
+            lambda o: (any(has_condition(o.get("status", {}), c)
+                           for c in expected)
+                       or is_finished(o.get("status", {}))),
+            self.namespace, timeout)
+        if not any(has_condition(job["status"], c) for c in expected):
+            conds = [c["type"] for c in job["status"].get("conditions", [])]
+            raise RuntimeError(
+                f"JAXJob {name} reached {conds}, expected one of {expected}")
+        return job
+
+    def delete_job(self, name: str) -> None:
+        self.backend.delete(JOB_KIND, name, self.namespace)
+
+
+class KatibClient(_ClientBase):
+    """KatibClient analog: experiments, trials, optimal hyperparameters."""
+
+    def create_experiment(self, exp: dict[str, Any] | None = None, *,
+                          name: str | None = None,
+                          **kwargs) -> dict[str, Any]:
+        if exp is None:
+            if name is None:
+                raise ValueError("name is required when building from kwargs")
+            exp = specs.experiment(name, namespace=self.namespace, **kwargs)
+        return self.backend.apply(exp)
+
+    def get_experiment(self, name: str) -> dict[str, Any]:
+        return self.backend.get(EXPERIMENT_KIND, name, self.namespace)
+
+    def list_trials(self, experiment_name: str) -> list[dict[str, Any]]:
+        return self.backend.list(
+            TRIAL_KIND, self.namespace,
+            labels={EXPERIMENT_LABEL: experiment_name})
+
+    def wait_for_experiment_condition(
+            self, name: str, timeout: float = 600.0) -> dict[str, Any]:
+        return self.backend.wait(EXPERIMENT_KIND, name, None, self.namespace,
+                                 timeout)
+
+    def get_optimal_hyperparameters(self, name: str) -> dict[str, Any]:
+        """Returns {parameterAssignments, observation} of the best trial."""
+        exp = self.get_experiment(name)
+        opt = exp.get("status", {}).get("currentOptimalTrial")
+        if not opt:
+            raise RuntimeError(f"Experiment {name} has no optimal trial yet")
+        return opt
+
+    def delete_experiment(self, name: str) -> None:
+        self.backend.delete(EXPERIMENT_KIND, name, self.namespace)
+
+
+class ServingClient(_ClientBase):
+    """KServe client analog: InferenceServices + predict."""
+
+    def create(self, isvc: dict[str, Any] | None = None, *,
+               name: str | None = None, **kwargs) -> dict[str, Any]:
+        if isvc is None:
+            if name is None:
+                raise ValueError("name is required when building from kwargs")
+            isvc = specs.inference_service(name, namespace=self.namespace,
+                                           **kwargs)
+        return self.backend.apply(isvc)
+
+    def get(self, name: str) -> dict[str, Any]:
+        return self.backend.get(ISVC_KIND, name, self.namespace)
+
+    def wait_ready(self, name: str, timeout: float = 120.0) -> dict[str, Any]:
+        return self.backend.wait(
+            ISVC_KIND, name,
+            lambda o: has_condition(o.get("status", {}), "Ready"),
+            self.namespace, timeout)
+
+    def predict(self, name: str, payload: dict[str, Any],
+                path: str | None = None,
+                timeout: float = 60.0) -> dict[str, Any]:
+        """POST a V1/V2 inference payload through the service's router URL
+        (works in- or out-of-process — the URL is in status, like kserve's
+        status.url)."""
+        isvc = self.get(name)
+        url = isvc.get("status", {}).get("url")
+        if not url:
+            raise RuntimeError(f"InferenceService {name} has no URL yet")
+        path = path or f"/v1/models/{name}:predict"
+        req = urllib.request.Request(
+            url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def delete(self, name: str) -> None:
+        self.backend.delete(ISVC_KIND, name, self.namespace)
+
+
+class PipelineClient(_ClientBase):
+    """kfp.Client analog: compile+submit runs, recurring runs, wait."""
+
+    def create_run_from_pipeline_func(
+            self, pipeline: dsl.Pipeline | Callable, *,
+            run_name: str, parameters: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        spec = dsl.compile_pipeline(
+            pipeline if isinstance(pipeline, dsl.Pipeline)
+            else dsl.pipeline()(pipeline))
+        return self.backend.apply(specs.pipeline_run(
+            run_name, spec, parameters, namespace=self.namespace))
+
+    def create_run_from_spec(self, spec: dict[str, Any], *, run_name: str,
+                             parameters: dict[str, Any] | None = None
+                             ) -> dict[str, Any]:
+        return self.backend.apply(specs.pipeline_run(
+            run_name, spec, parameters, namespace=self.namespace))
+
+    def create_recurring_run(self, pipeline: dsl.Pipeline, *, name: str,
+                             cron: str | None = None,
+                             interval_seconds: float | None = None,
+                             parameters: dict[str, Any] | None = None,
+                             max_runs: int | None = None) -> dict[str, Any]:
+        spec = dsl.compile_pipeline(pipeline)
+        return self.backend.apply(specs.scheduled_run(
+            name, spec, cron=cron, interval_seconds=interval_seconds,
+            parameters=parameters, max_runs=max_runs,
+            namespace=self.namespace))
+
+    def get_run(self, run_name: str) -> dict[str, Any]:
+        return self.backend.get(RUN_KIND, run_name, self.namespace)
+
+    def list_runs(self) -> list[dict[str, Any]]:
+        return self.backend.list(RUN_KIND, self.namespace)
+
+    def wait_for_run_completion(self, run_name: str,
+                                timeout: float = 600.0) -> dict[str, Any]:
+        run = self.backend.wait(RUN_KIND, run_name, None, self.namespace,
+                                timeout)
+        return run
+
+    def delete_recurring_run(self, name: str) -> None:
+        self.backend.delete(SCHEDULED_KIND, name, self.namespace)

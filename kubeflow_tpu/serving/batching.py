@@ -41,24 +41,40 @@ class DynamicBatcher:
         self.max_latency = max_latency_ms / 1000.0
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._stopped = False
+        self._stop_lock = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="dynamic-batcher")
         self._worker.start()
 
     def __call__(self, payload: Any) -> Any:
-        if self._stopped:
-            raise RuntimeError("batcher stopped")
         p = _Pending(payload)
-        self._q.put(p)
+        # enqueue under the stop lock so no request can slip in after the
+        # stop sentinel (it would block its caller forever)
+        with self._stop_lock:
+            if self._stopped:
+                raise RuntimeError("batcher stopped")
+            self._q.put(p)
         p.done.wait()
         if p.error is not None:
             raise p.error
         return p.result
 
     def stop(self) -> None:
-        self._stopped = True
-        self._q.put(None)
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._q.put(None)
         self._worker.join(timeout=5)
+        # fail anything enqueued before the sentinel but never processed
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item.error = RuntimeError("batcher stopped")
+                item.done.set()
 
     # -- worker ---------------------------------------------------------------
 

@@ -151,25 +151,26 @@ class InferenceServiceController(Controller):
         components = {}
         scale_to_zero = spec.get("predictor", {}).get("minReplicas", 1) == 0
         # default predictor
+        pct = spec.get("canaryTrafficPercent", 0)
+        canary = None
         try:
             default = self._reconcile_component(
                 isvc, "predictor", spec["predictor"],
                 lazy=scale_to_zero)
-        except (ModelError, storage.StorageError, ImportError) as e:
+            if pct > 0:
+                canary_spec = dict(spec["canary"])
+                canary_spec.setdefault("batching",
+                                       spec["predictor"].get("batching"))
+                canary = self._reconcile_component(isvc, "canary",
+                                                   canary_spec, lazy=False)
+        except (ModelError, storage.StorageError, ImportError,
+                AttributeError) as e:
             self.store.mutate(ISVC_KIND, name, lambda o: set_condition(
                 o["status"], JobConditionType.FAILED, "ModelLoadFailed",
                 str(e)), ns)
             return None
         components["predictor"] = default
-
-        pct = spec.get("canaryTrafficPercent", 0)
-        canary = None
-        if pct > 0:
-            canary_spec = dict(spec["canary"])
-            canary_spec.setdefault("batching",
-                                   spec["predictor"].get("batching"))
-            canary = self._reconcile_component(isvc, "canary", canary_spec,
-                                               lazy=False)
+        if canary is not None:
             components["canary"] = canary
         else:
             self._stop_instance(ns, name, "canary")

@@ -146,5 +146,4 @@ def v1_encode(result: Any) -> dict[str, Any]:
     elif isinstance(result, dict):
         result = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
                   for k, v in result.items()}
-        return {"predictions": result}
     return {"predictions": result}

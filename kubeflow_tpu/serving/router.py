@@ -93,7 +93,13 @@ class Router:
         self.last_request_time = time.time()
         port, is_canary = self._pick()
         if port is None and self.activator is not None:
-            port = self._activate()
+            try:
+                port = self._activate()
+            except Exception as e:
+                # a failing activator (model no longer loads) must surface as
+                # an HTTP error, not a dropped connection from a dead handler
+                return 503, json.dumps(
+                    {"error": f"{self.name}: activation failed: {e}"}).encode()
         if port is None:
             return 503, json.dumps(
                 {"error": f"{self.name}: no ready backend"}).encode()

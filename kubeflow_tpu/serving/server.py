@@ -156,12 +156,14 @@ class ModelServer:
         cfg = self._batch_cfg.get(model.name)
         if not cfg:
             return model.predict
-        if model.name not in self._batchers:
-            self._batchers[model.name] = DynamicBatcher(
-                model.predict,
-                max_batch_size=int(cfg.get("maxBatchSize", 16)),
-                max_latency_ms=float(cfg.get("maxLatencyMs", 5.0)))
-        return self._batchers[model.name]
+        with self._metrics_lock:  # guards _batchers too: two concurrent
+            # first requests must not each spawn a batcher worker thread
+            if model.name not in self._batchers:
+                self._batchers[model.name] = DynamicBatcher(
+                    model.predict,
+                    max_batch_size=int(cfg.get("maxBatchSize", 16)),
+                    max_latency_ms=float(cfg.get("maxLatencyMs", 5.0)))
+            return self._batchers[model.name]
 
     def _observe(self, model: str, verb: str, dt: float) -> None:
         with self._metrics_lock:

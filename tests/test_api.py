@@ -1,0 +1,297 @@
+"""L7 API layer tests: spec builders/YAML, Platform, HTTP API server,
+SDK clients, tpukctl CLI.
+
+Mirrors the reference's SDK test style (SURVEY.md §4.3): clients exercised
+against a real (in-process) control plane rather than mocks, plus golden
+validation tables for the admission path.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+
+import pytest
+
+from kubeflow_tpu import api, cli, serving
+from kubeflow_tpu.api import specs
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition)
+from kubeflow_tpu.control.executor import worker_target
+from kubeflow_tpu.hpo.observations import report_metric
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.sdk import (KatibClient, PipelineClient, ServingClient,
+                              TrainingClient)
+
+
+@worker_target("api_ok")
+def _api_ok(env, cancel):
+    print(f"hello from rank {env.get('KTPU_PROCESS_ID')}")
+
+
+@worker_target("api_metric")
+def _api_metric(env, cancel):
+    x = float(env.get("X", "1.0"))
+    report_metric(env["KTPU_TRIAL_NAME"], "loss", (x - 2.0) ** 2)
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with api.Platform(n_devices=8, root=str(tmp_path)) as p:
+        yield p
+
+
+@pytest.fixture()
+def server(platform):
+    s = api.ApiServer(platform).start()
+    yield s
+    s.stop()
+
+
+# -- specs --------------------------------------------------------------------
+
+
+class TestSpecs:
+    def test_builders_pass_validation(self):
+        for obj in [
+            specs.jaxjob("j", target="api_ok"),
+            specs.experiment(
+                "e", objective_metric="loss",
+                parameters=[{"name": "x", "parameterType": "double",
+                             "feasibleSpace": {"min": 0.0, "max": 4.0}}],
+                trial_spec=specs.jaxjob("t", target="api_metric")["spec"]),
+            specs.inference_service("s", model_format="mean"),
+            specs.pipeline_run("r", {"tasks": {}}),
+        ]:
+            assert specs.validate(obj) == [], obj["kind"]
+
+    def test_yaml_roundtrip(self):
+        job = specs.jaxjob("roundtrip", target="api_ok", replicas=2)
+        docs = specs.load_yaml(specs.dump_yaml(job))
+        assert len(docs) == 1
+        assert docs[0]["spec"] == job["spec"]
+
+    def test_multi_doc_and_invalid(self):
+        good = specs.dump_yaml(specs.jaxjob("a", target="api_ok"),
+                               specs.inference_service("b",
+                                                       model_format="echo"))
+        assert len(specs.load_yaml(good)) == 2
+        with pytest.raises(api.ValidationError, match="replicaSpecs"):
+            specs.load_yaml(
+                "kind: JAXJob\nmetadata: {name: bad}\nspec: {}\n")
+        with pytest.raises(api.ValidationError, match="metadata.name"):
+            specs.load_yaml("kind: JAXJob\nmetadata: {}\n")
+
+
+# -- Platform + SDK -----------------------------------------------------------
+
+
+class TestPlatformSDK:
+    def test_training_client_e2e(self, platform):
+        tc = TrainingClient(platform)
+        tc.create_job(name="sdk-job", target="api_ok", replicas=2)
+        job = tc.wait_for_job_conditions("sdk-job", timeout=30)
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+        logs = tc.get_job_logs("sdk-job")
+        assert "hello from rank 0" in logs and "hello from rank 1" in logs
+        tc.delete_job("sdk-job")
+        assert tc.list_jobs() == []
+
+    def test_apply_updates_spec(self, platform):
+        job = specs.jaxjob("upd", target="api_ok")
+        platform.apply(job)
+        job2 = specs.jaxjob("upd", target="api_ok",
+                            active_deadline_seconds=99)
+        platform.apply(job2)
+        got = platform.get("JAXJob", "upd")
+        assert got["spec"]["runPolicy"]["activeDeadlineSeconds"] == 99
+
+    def test_katib_client_e2e(self, platform):
+        kc = KatibClient(platform)
+        kc.create_experiment(
+            name="sdk-exp", objective_metric="loss",
+            algorithm="random", max_trials=4, parallel_trials=2,
+            parameters=[{"name": "x", "parameterType": "double",
+                         "feasibleSpace": {"min": 0.0, "max": 4.0}}],
+            trial_spec={
+                "replicaSpecs": {"worker": {"replicas": 1,
+                                 "template": {
+                                     "backend": "thread",
+                                     "target": "api_metric",
+                                     "env": {"X": "${trialParameters.x}"}}}}},
+            trial_parameters=[{"name": "x", "reference": "x"}])
+        exp = kc.wait_for_experiment_condition("sdk-exp", timeout=90)
+        assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
+        best = kc.get_optimal_hyperparameters("sdk-exp")
+        assert "x" in best["parameterAssignments"]
+        assert len(kc.list_trials("sdk-exp")) >= 4
+
+    def test_serving_client_e2e(self, platform):
+        sc = ServingClient(platform)
+        sc.create(name="sdk-isvc", model_format="mean")
+        sc.wait_ready("sdk-isvc", timeout=30)
+        out = sc.predict("sdk-isvc", {"instances": [[1.0, 2.0, 3.0]]})
+        assert out["predictions"] == [2.0]
+        sc.delete("sdk-isvc")
+
+    def test_scheduled_run_builder_matches_controller(self):
+        sr = specs.scheduled_run("s", {"tasks": {}}, interval_seconds=1)
+        assert specs.validate(sr) == []
+        assert sr["spec"]["schedule"] == {"intervalSeconds": 1}
+        assert sr["spec"]["runSpec"]["pipelineSpec"] == {"tasks": {}}
+        bad = specs.scheduled_run("s2", {"tasks": {}})  # no trigger
+        assert any("schedule" in e for e in specs.validate(bad))
+
+    def test_recurring_run_fires(self, platform):
+        @dsl.component
+        def tick() -> int:
+            return 1
+
+        @dsl.pipeline(name="tick-p")
+        def p():
+            return tick()
+
+        pc = PipelineClient(platform)
+        pc.create_recurring_run(dsl.pipeline()(p.fn)
+                                if not isinstance(p, dsl.Pipeline) else p,
+                                name="rec", interval_seconds=0.2, max_runs=2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            runs = pc.list_runs()
+            if len(runs) >= 2:
+                break
+            time.sleep(0.1)
+        assert len(pc.list_runs()) >= 2
+        pc.delete_recurring_run("rec")
+
+    def test_pipeline_client_e2e(self, platform):
+        @dsl.component
+        def double(n: int) -> int:
+            return n * 2
+
+        @dsl.pipeline(name="p")
+        def p(n: int = 3):
+            return double(n=n)
+
+        pc = PipelineClient(platform)
+        pc.create_run_from_pipeline_func(p, run_name="sdk-run",
+                                         parameters={"n": 5})
+        run = pc.wait_for_run_completion("sdk-run", timeout=60)
+        assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+
+
+# -- HTTP API server ----------------------------------------------------------
+
+
+class TestApiServer:
+    def test_healthz_version(self, server):
+        c = api.ApiClient(server.url)
+        assert c.healthy()
+
+    def test_crud_over_http(self, server):
+        c = api.ApiClient(server.url)
+        c.apply(specs.jaxjob("http-job", target="api_ok"))
+        job = c.wait("JAXJob", "http-job", timeout=30)
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+        assert "hello from rank 0" in c.job_logs("http-job")
+        assert len(c.list("JAXJob")) == 1
+        c.delete("JAXJob", "http-job")
+        with pytest.raises(api.ApiError) as ei:
+            c.get("JAXJob", "http-job")
+        assert ei.value.reason == "NotFound"
+
+    def test_invalid_spec_rejected_422(self, server):
+        c = api.ApiClient(server.url)
+        with pytest.raises(api.ApiError) as ei:
+            c.apply({"kind": "JAXJob", "metadata": {"name": "bad"},
+                     "spec": {}})
+        assert ei.value.code == 422 and ei.value.reason == "Invalid"
+
+    def test_sdk_over_http_backend(self, server):
+        tc = TrainingClient(api.ApiClient(server.url))
+        tc.create_job(name="http-sdk", target="api_ok")
+        job = tc.wait_for_job_conditions("http-sdk", timeout=30)
+        assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+
+    def test_label_selector_over_http(self, server):
+        c = api.ApiClient(server.url)
+        job = specs.jaxjob("lbl", target="api_ok")
+        job["metadata"]["labels"]["team"] = "ml"
+        c.apply(job)
+        assert [o["metadata"]["name"]
+                for o in c.list("JAXJob", labels={"team": "ml"})] == ["lbl"]
+        assert c.list("JAXJob", labels={"team": "nope"}) == []
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestCli:
+    def test_version(self):
+        out = io.StringIO()
+        assert cli.main(["version"], out) == 0
+        assert "tpukctl" in out.getvalue()
+
+    def test_run_local(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(specs.dump_yaml(
+            specs.jaxjob("cli-job", target="api_ok")))
+        out = io.StringIO()
+        rc = cli.main(["run", "-f", str(f), "--devices", "8", "--logs",
+                       "--timeout", "60"], out)
+        text = out.getvalue()
+        assert rc == 0, text
+        assert "JAXJob/cli-job created" in text
+        assert "JAXJob/cli-job Succeeded" in text
+        assert "hello from rank 0" in text
+
+    def test_run_local_failure_rc(self, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(specs.dump_yaml(specs.jaxjob(
+            "cli-fail", target="no_such_target", restart_policy="Never",
+            backoff_limit=0)))
+        out = io.StringIO()
+        assert cli.main(["run", "-f", str(f), "--devices", "8",
+                         "--timeout", "60"], out) == 1
+
+    def test_server_commands(self, server, tmp_path):
+        f = tmp_path / "job.yaml"
+        f.write_text(specs.dump_yaml(
+            specs.jaxjob("cli-srv", target="api_ok")))
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "apply", "-f",
+                         str(f)], out) == 0
+        assert "JAXJob/cli-srv applied" in out.getvalue()
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "wait", "JAXJob", "cli-srv",
+                         "--timeout", "30"], out) == 0
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "get", "JAXJob"], out) == 0
+        assert "cli-srv" in out.getvalue()
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "get", "JAXJob", "cli-srv",
+                         "-o", "json"], out) == 0
+        obj = json.loads(out.getvalue())
+        assert obj["metadata"]["name"] == "cli-srv"
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "logs", "cli-srv",
+                         "--job"], out) == 0
+        assert "hello from rank 0" in out.getvalue()
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "delete", "JAXJob",
+                         "cli-srv"], out) == 0
+
+        out = io.StringIO()
+        assert cli.main(["--server", server.url, "get", "JAXJob",
+                         "missing"], out) == 1
+
+    def test_missing_server_is_error(self, monkeypatch):
+        monkeypatch.delenv("KTPU_SERVER", raising=False)
+        out = io.StringIO()
+        assert cli.main(["get", "JAXJob"], out) == 2
+        assert "tpukctl run" in out.getvalue()
