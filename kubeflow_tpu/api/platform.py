@@ -63,7 +63,10 @@ class Platform:
         if self._started:
             self.cluster.stop()
             self._started = False
-        hpo.set_default_db(None)
+        # release only our own DB — another live Platform in this process may
+        # have installed its own default since
+        from kubeflow_tpu.hpo.observations import clear_default_db
+        clear_default_db(self.hpo_db)
 
     def __enter__(self) -> "Platform":
         return self.start()
@@ -121,12 +124,16 @@ class Platform:
         from kubeflow_tpu.control.jobs import JOB_NAME_LABEL
         pods = self.store.list("Pod", namespace,
                                labels={JOB_NAME_LABEL: name})
+        # pods are GC'd individually, so a list() can catch a partial view —
+        # merge live pod logs with on-disk files of already-reaped pods
+        by_pod = self.cluster.executor.job_log_files(name, namespace)
+        for p in pods:
+            pn = p["metadata"]["name"]
+            by_pod[pn] = self.logs(pn, namespace)
         parts = []
-        for p in sorted(pods, key=lambda p: p["metadata"]["name"]):
-            parts.append(f"==> {p['metadata']['name']} <==")
-            parts.append(self.logs(p["metadata"]["name"], namespace))
-        if not parts:  # pods already GC'd — fall back to any log file on disk
-            parts.append(self.logs(name, namespace))
+        for pn in sorted(by_pod):
+            parts.append(f"==> {pn} <==")
+            parts.append(by_pod[pn])
         return "\n".join(parts)
 
     def wait(self, kind: str, name: str,

@@ -179,10 +179,14 @@ def main(argv: list[str] | None = None, out=None) -> int:
     if args.cmd == "version":
         print(f"tpukctl {__version__}", file=out)
         return 0
-    if args.cmd == "run":
-        return _cmd_run(args, out)
-    if args.cmd == "daemon":
-        return _cmd_daemon(args, out)
+    if args.cmd in ("run", "daemon"):
+        try:
+            if args.cmd == "run":
+                return _cmd_run(args, out)
+            return _cmd_daemon(args, out)
+        except Exception as e:
+            print(f"error: {e}", file=out)
+            return 1
 
     client = _client(args, out)
     if client is None:

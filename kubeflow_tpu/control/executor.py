@@ -207,7 +207,14 @@ class PodExecutor:
             else:
                 raise ValueError(f"unknown pod backend {backend!r}")
         except Exception:
-            rp.log_buffer.append(traceback.format_exc())
+            tb = traceback.format_exc()
+            rp.log_buffer.append(tb)
+            # failures before the log file opened (bad target, bad backend)
+            # must still be on disk or they vanish once the pod is reaped
+            if not rp.log_path:
+                rp.log_path = self._log_path(pod)
+                with open(rp.log_path, "a", errors="replace") as f:
+                    f.write(tb)
             exit_code = 1
         finally:
             with self._lock:
@@ -281,10 +288,27 @@ class PodExecutor:
                     with open(rp.log_path, "rb") as f:
                         parts.append(f.read().decode(errors="replace"))
                 return "\n".join(parts)
-        # finished/deleted: scan log dir by name prefix
-        prefix = f"{namespace}.{name}."
-        for fn in sorted(os.listdir(self.log_dir)):
-            if fn.startswith(prefix):
-                with open(os.path.join(self.log_dir, fn), "rb") as f:
-                    parts.append(f.read().decode(errors="replace"))
+        # finished/deleted: scan log dir by exact pod-name prefix; if nothing
+        # matches, treat `name` as a job name and match its pods' files
+        # ("{ns}.{job}-{role}-{idx}.{uid}.log")
+        for prefix in (f"{namespace}.{name}.", f"{namespace}.{name}-"):
+            for fn in sorted(os.listdir(self.log_dir)):
+                if fn.startswith(prefix):
+                    with open(os.path.join(self.log_dir, fn), "rb") as f:
+                        parts.append(f.read().decode(errors="replace"))
+            if parts:
+                break
         return "\n".join(parts)
+
+    def job_log_files(self, job_name: str,
+                      namespace: str = "default") -> dict[str, str]:
+        """On-disk logs of a job's pods, keyed by pod name (files are named
+        "{ns}.{pod}.{uid8}.log" and job pods are "{job}-{role}-{idx}")."""
+        out: dict[str, str] = {}
+        prefix = f"{namespace}.{job_name}-"
+        for fn in sorted(os.listdir(self.log_dir)):
+            if fn.startswith(prefix) and fn.endswith(".log"):
+                pod_name = fn[len(f"{namespace}."):].rsplit(".", 2)[0]
+                with open(os.path.join(self.log_dir, fn), "rb") as f:
+                    out[pod_name] = f.read().decode(errors="replace")
+        return out

@@ -118,6 +118,15 @@ def set_default_db(db: ObservationDB | None) -> None:
         _default = db
 
 
+def clear_default_db(db: ObservationDB) -> None:
+    """Unset the process default only if it is still `db` — lets an owner
+    (e.g. Platform.stop) release it without clobbering another live owner."""
+    global _default
+    with _default_lock:
+        if _default is db:
+            _default = None
+
+
 def report_metric(trial: str, metric: str, value: float, step: int = 0) -> None:
     """Convenience for worker code: `report_metric(env['KTPU_TRIAL'], ...)`."""
     default_db().report(trial, metric, value, step)

@@ -45,7 +45,8 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "xla"  # xla | flash | ring
+    attention_impl: str = "flash"  # flash | xla | ring (flash auto-selects
+    # the Pallas TPU kernel and falls back to blockwise-XLA off-TPU)
     remat: bool = True
     # remat policy: "none" | "minimal" (checkpoint_dots) | "full"
     remat_policy: str = "minimal"

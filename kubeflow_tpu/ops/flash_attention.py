@@ -118,7 +118,8 @@ def flash_attention(
             from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
 
             return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
-                                          q_offset=q_offset)
+                                          q_offset=q_offset,
+                                          block_kv=max(block_kv, 128))
         except (ImportError, NotImplementedError):
             if impl == "pallas":
                 raise

@@ -1,0 +1,389 @@
+"""Pallas (Mosaic) TPU flash-attention kernels — the framework's native-compute
+hot path for the attention op (SURVEY.md §5.7, §7.3: the "C++-equivalent"
+compiled component; the reference delegates attention to user containers, L7).
+
+Forward + backward are hand-written kernels wired through `jax.custom_vjp`:
+  - fwd: online-softmax over KV blocks; grid (B*H, n_q, n_kv) with the KV axis
+    sequential ("arbitrary") so (acc, m, l) carry across KV blocks in VMEM
+    scratch. Emits logsumexp for the backward pass.
+  - bwd: two kernels — dq (grid over q blocks, KV sequential) and dk/dv (grid
+    over KV blocks, q sequential) — the standard flash-attention backward
+    decomposition with delta = rowsum(dO ⊙ O) precomputed in XLA.
+
+Layout contract: BSHD in, GQA already expanded (flash_attention.py repeats KV
+heads before calling). Sequences are padded here to block multiples; padded
+keys are masked via `k_pos < sk`, padded query rows are sliced off (their
+dk/dv contributions vanish because dO rows are zero-padded).
+
+On non-TPU backends the kernels run only in interpreter mode (tests set
+FORCE_INTERPRET); otherwise NotImplementedError lets flash_attention.py fall
+back to its blockwise-XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# Tests on the CPU backend set this to exercise the kernels via the Pallas
+# interpreter (numerics identical to the compiled Mosaic path).
+FORCE_INTERPRET = False
+
+
+def _compiler_params(dimension_semantics):
+    try:
+        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
+    except TypeError:  # older/newer field name drift — let Mosaic autodetect
+        return pltpu.CompilerParams()
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_kv,
+                sk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = pl.program_id(1) * block_q + qoff_ref[0]
+    k_start = ki * block_kv
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = k_pos < sk
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                         # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        # guard: a fully-masked row keeps m_new == NEG_INF; exp(s - m_new)
+        # would be exp(0)=1 there, so zero masked entries explicitly.
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # whole KV block is in the future of every query row → skip
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, 0, :] = m_ref[:, 0] + jnp.log(l[:, 0])
+
+
+def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
+    """q,k,v: [BH, S, D] (already padded to block multiples except S)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_kv)
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
+    n_q, n_k = sq_p // block_q, sk_p // block_kv
+
+    qoff = jnp.asarray([q_offset], jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            # lse is (BH, n_q, 1, block_q): the singleton sublane dim makes
+            # the (1, block_q) block tail legal under the TPU tiling rule.
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j, *_: (b, i, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, sk=sk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n_q, 1, block_q), jnp.float32),
+        ],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq_p * sk_p * d,
+            bytes_accessed=2 * bh * (sq_p + 2 * sk_p) * d * q.dtype.itemsize,
+            transcendentals=bh * sq_p * sk_p,
+        ),
+        interpret=interpret,
+    )(qoff, q, k, v)
+    return o[:, :sq], lse.reshape(bh, sq_p)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_kv, sk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_kv
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = k_pos < sk
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            valid = valid & (q_pos >= k_pos)
+        lse = lse_ref[0, 0, 0, :][:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, 0, :][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_kv, sk):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = pl.program_id(1) * block_kv
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = k_pos < sk
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            valid = valid & (q_pos >= k_pos)
+        lse = lse_ref[0, 0, 0, :][:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)      # [bq, bk]
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta_ref[0, 0, 0, :][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+
+    if causal:
+        # KV block entirely after the last query row of this q block → no grad
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, interpret, block_q, block_kv):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_kv)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    if sq_p != sq:
+        pad = ((0, 0), (0, sq_p - sq), (0, 0))
+        q, do = jnp.pad(q, pad), jnp.pad(do, pad)
+        delta = jnp.pad(delta, ((0, 0), (0, sq_p - sq)))
+    if sk_p != sk:
+        pad = ((0, 0), (0, sk_p - sk), (0, 0))
+        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+    n_q, n_k = sq_p // block_q, sk_p // block_kv
+    # lse comes from _fwd already padded to sq_p; reshape rows into 3D blocks
+    # to satisfy the TPU (sublane, lane) tiling rule.
+    lse3 = lse.reshape(bh, n_q, 1, block_q)
+    delta3 = delta.reshape(bh, n_q, 1, block_q)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_dq = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, 1, 1, block_q),
+                           lambda b, i, j: (b, i, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, sk=sk),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0))
+    row_spec_kv = pl.BlockSpec((1, 1, 1, block_q),
+                              lambda b, j, i: (b, i, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, sk=sk),
+        grid=(bh, n_k, n_q),
+        in_specs=[q_spec_kv, kv_spec, kv_spec, q_spec_kv, row_spec_kv,
+                  row_spec_kv],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, interpret, block_q, block_kv):
+    o, _ = _fwd(q, k, v, causal, scale, 0, interpret, block_q, block_kv)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret, block_q, block_kv):
+    o, lse = _fwd(q, k, v, causal, scale, 0, interpret, block_q, block_kv)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, interpret, block_q, block_kv, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal, scale, interpret,
+                block_q, block_kv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def pallas_flash_attention(q, k, v, *, causal=True, scale=None,
+                           q_offset=0, block_q=256, block_kv=512,
+                           interpret=None):
+    """Flash attention via Pallas TPU kernels. BSHD layout, full heads.
+
+    Differentiable when `q_offset == 0` (training/prefill-from-zero); the
+    decode/prefill-with-offset path is forward-only. Falls back (raises
+    NotImplementedError) for tiny query lengths — flash_attention.py routes
+    those to the blockwise-XLA path.
+    """
+    if interpret is None:
+        interpret = FORCE_INTERPRET or jax.default_backend() != "tpu"
+    if interpret and not FORCE_INTERPRET and jax.default_backend() != "tpu":
+        raise NotImplementedError("pallas flash kernel: no TPU backend")
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq < 128 or sk < 128:
+        raise NotImplementedError("pallas flash kernel needs seq >= 128")
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, _round_up(sq, 128))
+    block_kv = min(block_kv, _round_up(sk, 128))
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    static_offset = isinstance(q_offset, int)
+    if static_offset and q_offset == 0:
+        of = _flash(qf, kf, vf, causal, scale, interpret, block_q, block_kv)
+    else:  # decode/continuation prefill: forward-only
+        of, _ = _fwd(qf, kf, vf, causal, scale, q_offset, interpret,
+                     block_q, block_kv)
+        of = jax.lax.stop_gradient(of)
+    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -342,7 +342,15 @@ class ScheduledRunController(Controller):
         # effect immediately instead of waiting out a stale persisted time
         base = status.get("lastScheduleTime",
                           sched["metadata"].get("creationTimestamp", now))
-        next_at = self._next(spec, base)
+        try:
+            next_at = self._next(spec, base)
+        except ValueError as e:
+            # objects written straight to the store bypass api.specs admission
+            # validation — surface the bad schedule instead of hot-looping
+            if status.get("phase") != "Invalid":
+                self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]
+                                  .update(phase="Invalid", message=str(e)), ns)
+            return None
         if now < next_at:
             if status.get("nextScheduleTime") != next_at:
                 self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]

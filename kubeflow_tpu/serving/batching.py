@@ -67,14 +67,21 @@ class DynamicBatcher:
             self._q.put(None)
         self._worker.join(timeout=5)
         # fail anything enqueued before the sentinel but never processed
+        saw_sentinel = False
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not None:
-                item.error = RuntimeError("batcher stopped")
-                item.done.set()
+            if item is None:
+                saw_sentinel = True
+                continue
+            item.error = RuntimeError("batcher stopped")
+            item.done.set()
+        if saw_sentinel and self._worker.is_alive():
+            # join timed out mid-batch and the drain ate the sentinel — put it
+            # back so the worker exits instead of blocking on get() forever
+            self._q.put(None)
 
     # -- worker ---------------------------------------------------------------
 

@@ -51,7 +51,9 @@ def config_from_env(env: dict[str, str]) -> tuple[TrainerConfig, int]:
     cfg = TrainerConfig(**raw)
     cfg.optimizer = OptimizerConfig(**opt)
     cfg.mesh = MeshConfig(**mesh)
-    if cfg.optimizer.total_steps == 1000 and num_steps != 1000:
+    # LR schedule spans the run unless the spec pinned total_steps itself
+    # (e.g. chunked training resuming against a longer schedule)
+    if "total_steps" not in opt:
         cfg.optimizer.total_steps = num_steps
     return cfg, num_steps
 
