@@ -18,6 +18,7 @@ subprocess (SIGTERM→SIGKILL) and sets a cancel event for threads.
 from __future__ import annotations
 
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -28,6 +29,21 @@ from typing import Any, Callable
 from kubeflow_tpu.control.store import NotFoundError, ResourceStore
 
 _TARGETS: dict[str, Callable[..., Any]] = {}
+
+
+def _pod_log_re(namespace: str, pod: str) -> re.Pattern[str]:
+    """Log files of exactly this pod: "{ns}.{pod}.{uid8}.log"."""
+    return re.compile(
+        rf"^{re.escape(namespace)}\.{re.escape(pod)}\.[0-9a-f]+\.log$")
+
+
+def _job_log_re(namespace: str, job: str) -> re.Pattern[str]:
+    """Log files of exactly this job's pods ("{ns}.{job}-{role}-{idx}.{uid8}
+    .log"). The role-index tail is anchored so job "train" never matches
+    files of job "train-v2"."""
+    return re.compile(
+        rf"^{re.escape(namespace)}\.{re.escape(job)}-[A-Za-z0-9]+-\d+\."
+        rf"[0-9a-f]+\.log$")
 
 
 def worker_target(name: str | None = None):
@@ -209,12 +225,13 @@ class PodExecutor:
         except Exception:
             tb = traceback.format_exc()
             rp.log_buffer.append(tb)
-            # failures before the log file opened (bad target, bad backend)
-            # must still be on disk or they vanish once the pod is reaped
+            # the traceback must land on disk or it vanishes once the pod is
+            # reaped — even when the log file was already opened (e.g. Popen
+            # raised on a bad argv after _run_subprocess created the file)
             if not rp.log_path:
                 rp.log_path = self._log_path(pod)
-                with open(rp.log_path, "a", errors="replace") as f:
-                    f.write(tb)
+            with open(rp.log_path, "a", errors="replace") as f:
+                f.write(tb)
             exit_code = 1
         finally:
             with self._lock:
@@ -288,12 +305,13 @@ class PodExecutor:
                     with open(rp.log_path, "rb") as f:
                         parts.append(f.read().decode(errors="replace"))
                 return "\n".join(parts)
-        # finished/deleted: scan log dir by exact pod-name prefix; if nothing
-        # matches, treat `name` as a job name and match its pods' files
-        # ("{ns}.{job}-{role}-{idx}.{uid}.log")
-        for prefix in (f"{namespace}.{name}.", f"{namespace}.{name}-"):
+        # finished/deleted: scan log dir for this exact pod's files; if
+        # nothing matches, treat `name` as a job name and match its pods'
+        # files ("{ns}.{job}-{role}-{idx}.{uid8}.log"). Anchored regexes —
+        # a bare prefix would bleed job "train" into "train-v2" files.
+        for pat in (_pod_log_re(namespace, name), _job_log_re(namespace, name)):
             for fn in sorted(os.listdir(self.log_dir)):
-                if fn.startswith(prefix):
+                if pat.match(fn):
                     with open(os.path.join(self.log_dir, fn), "rb") as f:
                         parts.append(f.read().decode(errors="replace"))
             if parts:
@@ -305,9 +323,9 @@ class PodExecutor:
         """On-disk logs of a job's pods, keyed by pod name (files are named
         "{ns}.{pod}.{uid8}.log" and job pods are "{job}-{role}-{idx}")."""
         out: dict[str, str] = {}
-        prefix = f"{namespace}.{job_name}-"
+        pat = _job_log_re(namespace, job_name)
         for fn in sorted(os.listdir(self.log_dir)):
-            if fn.startswith(prefix) and fn.endswith(".log"):
+            if pat.match(fn):
                 pod_name = fn[len(f"{namespace}."):].rsplit(".", 2)[0]
                 with open(os.path.join(self.log_dir, fn), "rb") as f:
                     out[pod_name] = f.read().decode(errors="replace")

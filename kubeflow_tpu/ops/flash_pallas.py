@@ -364,9 +364,14 @@ def pallas_flash_attention(q, k, v, *, causal=True, scale=None,
     those to the blockwise-XLA path.
     """
     if interpret is None:
-        interpret = FORCE_INTERPRET or jax.default_backend() != "tpu"
-    if interpret and not FORCE_INTERPRET and jax.default_backend() != "tpu":
-        raise NotImplementedError("pallas flash kernel: no TPU backend")
+        # auto mode: compiled on TPU; off-TPU only when the interpreter was
+        # opted into globally, else fall back to the blockwise-XLA path
+        if FORCE_INTERPRET:
+            interpret = True
+        elif jax.default_backend() != "tpu":
+            raise NotImplementedError("pallas flash kernel: no TPU backend")
+        else:
+            interpret = False
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if sq < 128 or sk < 128:

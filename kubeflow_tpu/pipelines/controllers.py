@@ -351,6 +351,11 @@ class ScheduledRunController(Controller):
                 self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]
                                   .update(phase="Invalid", message=str(e)), ns)
             return None
+        if status.get("phase") == "Invalid":
+            # spec.schedule was fixed — clear the stale Invalid marker
+            self.store.mutate(SCHEDULED_KIND, name, lambda o: (
+                o["status"].update(phase="Active"),
+                o["status"].pop("message", None)), ns)
         if now < next_at:
             if status.get("nextScheduleTime") != next_at:
                 self.store.mutate(SCHEDULED_KIND, name, lambda o: o["status"]

@@ -1,0 +1,81 @@
+"""One-off MFU sweep to pick bench.py's config. Not part of the framework."""
+from __future__ import annotations
+
+import itertools
+import time
+
+import jax
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.parallel import MeshConfig
+from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+from kubeflow_tpu.training import data as data_lib
+from kubeflow_tpu.training.mfu import mfu
+
+SEQ = 2048
+MEASURE = 8
+
+
+def run(overrides, batch, label):
+    trainer = Trainer(TrainerConfig(
+        model="llama", model_overrides=overrides, batch_size=batch,
+        optimizer=OptimizerConfig(warmup_steps=10, total_steps=1000),
+        mesh=MeshConfig(data=-1), log_every=1000))
+    trainer.metrics.echo = False
+    data = data_lib.for_model("llama", trainer.model_cfg, batch, seq_len=SEQ)
+    state = trainer.init_state()
+    b0 = trainer.shard_batch(next(data))
+    step = trainer.compiled_step(state, b0)
+    batches = [trainer.shard_batch(next(data)) for _ in range(MEASURE)]
+    for _ in range(3):
+        state, m = step(state, batches[0])
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(MEASURE):
+        state, m = step(state, batches[i])
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / MEASURE
+    flops = llama.flops_per_token(trainer.model_cfg, SEQ) * batch * SEQ
+    print(f"{label}: mfu={mfu(flops, dt, 1):.4f} step={dt*1e3:.1f}ms "
+          f"tok/s={batch*SEQ/dt:.0f}", flush=True)
+    del state, step, batches
+    return
+
+
+BASE = dict(vocab_size=32000, d_model=1024, n_layers=12, n_heads=16,
+            n_kv_heads=8, d_ff=3584, max_seq_len=SEQ)
+
+BIG = dict(vocab_size=32000, d_model=2048, n_layers=8, n_heads=16,
+           n_kv_heads=8, d_ff=7168, max_seq_len=SEQ)
+
+CONFIGS = [
+    ("baseline full-remat b4", dict(BASE, remat=True, remat_policy="full"), 4),
+    ("minimal-remat b4", dict(BASE, remat=True, remat_policy="minimal"), 4),
+    ("no-remat b4", dict(BASE, remat=False), 4),
+    ("minimal-remat b8", dict(BASE, remat=True, remat_policy="minimal"), 8),
+    ("no-remat b8", dict(BASE, remat=False), 8),
+    ("minimal-remat b16", dict(BASE, remat=True, remat_policy="minimal"), 16),
+    ("xla-attn no-remat b4", dict(BASE, remat=False, attention_impl="xla"), 4),
+    ("big-d2048 no-remat b4", dict(BIG, remat=False), 4),
+    ("big-d2048 minimal b4", dict(BIG, remat=True, remat_policy="minimal"), 4),
+    ("big-d2048 minimal b8", dict(BIG, remat=True, remat_policy="minimal"), 8),
+    ("d2560-L6 minimal b4", dict(vocab_size=32000, d_model=2560, n_layers=6,
+                                 n_heads=20, n_kv_heads=10, d_ff=8960,
+                                 max_seq_len=SEQ, remat=True,
+                                 remat_policy="minimal"), 4),
+    ("big-d2048 full b8", dict(BIG, remat=True, remat_policy="full"), 8),
+    ("big-d2048-L12 minimal b4", dict(BIG, n_layers=12, remat=True,
+                                      remat_policy="minimal"), 4),
+]
+
+if __name__ == "__main__":
+    import sys
+    sel = sys.argv[1:] or None
+    for label, ov, b in CONFIGS:
+        if sel and not any(s in label for s in sel):
+            continue
+        try:
+            run(ov, b, label)
+        except Exception as e:  # OOM etc: report and continue
+            print(f"{label}: FAILED {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
