@@ -45,14 +45,16 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "flash"  # flash | xla | ring (flash auto-selects
-    # the Pallas TPU kernel and falls back to blockwise-XLA off-TPU)
+    attention_impl: str = "flash"  # flash | xla | ring | ulysses (flash
+    # auto-selects the Pallas TPU kernel, blockwise-XLA off-TPU; ring/ulysses
+    # are the sequence-parallel paths — shard_map islands over the ambient
+    # mesh's `sequence` axis, §5.7)
     remat: bool = True
     # remat policy: "none" | "minimal" (checkpoint_dots) | "full"
     remat_policy: str = "minimal"
 
     def __post_init__(self):
-        if self.attention_impl not in ("xla", "flash", "ring"):
+        if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
     @property
@@ -137,14 +139,31 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
         from kubeflow_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
-    elif cfg.attention_impl == "ring":
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "ring attention does not support packed-sequence segment_ids; "
-                "use attention_impl='xla' or 'flash' for packed batches")
-        from kubeflow_tpu.ops.ring_attention import ring_attention
+    elif cfg.attention_impl in ("ring", "ulysses"):
+        # sequence-parallel islands: the surrounding model runs under
+        # GSPMD jit with seq-sharded activations; the attention op alone
+        # drops to shard_map for its manual collectives (ppermute ring /
+        # all-to-all reshard). Mesh comes from parallel.active_mesh —
+        # degrade to plain attention when there's no seq axis to ride.
+        from kubeflow_tpu.parallel.mesh import get_active_mesh, mesh_shape
 
-        out = ring_attention(q, k, v, axis_name="sequence")
+        mesh = get_active_mesh()
+        seq_n = mesh_shape(mesh).get("sequence", 1) if mesh is not None else 1
+        if seq_n == 1:
+            out = mha(q, k, v, causal=True, segment_ids=segment_ids)
+        elif cfg.attention_impl == "ring":
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "ring attention does not support packed-sequence "
+                    "segment_ids; use attention_impl='ulysses' or 'flash'")
+            from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
+
+            out = ring_attention_sharded(q, k, v, mesh, causal=True)
+        else:
+            from kubeflow_tpu.ops.ulysses import ulysses_attention_sharded
+
+            out = ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                            segment_ids=segment_ids)
     else:
         out = mha(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.reshape(b, s, nh * hd)
@@ -199,9 +218,12 @@ def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
     """Next-token cross-entropy with optional loss mask. batch: tokens [B,S],
     optionally loss_mask [B,S] (1.0 where the target counts)."""
     tokens = batch["tokens"]
-    logits = apply(params, tokens[:, :-1], cfg,
-                   positions=jnp.arange(tokens.shape[1] - 1),
-                   segment_ids=batch.get("segment_ids"))
+    # Forward on the FULL sequence, shift logits afterwards: S-1 wouldn't
+    # divide a `sequence` mesh axis, and the slice lives in GSPMD-land where
+    # resharding is legal (the shard_map attention islands only ever see S).
+    logits = apply(params, tokens, cfg,
+                   positions=jnp.arange(tokens.shape[1]),
+                   segment_ids=batch.get("segment_ids"))[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     token_loss = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -107,8 +107,9 @@ def ring_attention_sharded(
     scale: float | None = None,
     axis_name: str = "sequence",
 ) -> jax.Array:
-    """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq->ring)."""
-    spec = P(("data", "fsdp"), axis_name, None, None)
+    """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq->ring,
+    heads->tensor); composes with tensor parallelism (axis dropped at size 1)."""
+    spec = P(("data", "fsdp"), axis_name, "tensor", None)
 
     def body(ql, kl, vl):
         return ring_attention(ql, kl, vl, axis_name=axis_name, causal=causal,

@@ -1,0 +1,105 @@
+"""Ulysses-style sequence parallelism: all-to-all reshard seq<->heads
+(SURVEY.md §5.7 — absent in the reference platform, whose operators never see
+a sequence dimension; DeepSpeed-Ulysses is L7 user code there).
+
+Each device holds a sequence shard [B, S/N, H, D]. Before attention, one
+``jax.lax.all_to_all`` scatters heads and gathers sequence, giving every
+device the FULL sequence for H/N heads — attention is then exact (ordinary
+causal MHA, no online-softmax recurrence needed, unlike ring attention). A
+second all-to-all transposes back so the MLP runs seq-sharded. Two
+collectives per layer, each moving B*S*H*D/N elements over ICI.
+
+Tradeoff vs ring attention (ops/ring_attention.py): Ulysses parallelizes
+attention over heads (needs n_heads % N == 0, no per-step masking subtleties,
+plain kernels); ring keeps heads whole and rotates KV (unbounded N, but a
+scan of N partial-softmax steps). Both are exposed as `attention_impl`
+choices on the model configs.
+
+``ulysses_attention`` runs *inside* ``jax.shard_map`` with the sequence axis
+named; ``ulysses_attention_sharded`` wraps it for standalone use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import mha, repeat_kv
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Per-device body (call inside shard_map).
+
+    q: local [B, S_loc, H, D]; k/v: local [B, S_loc, Hkv, D] (GQA expanded
+    to a multiple of the axis size when needed). segment_ids: local
+    [B, S_loc] (packed-sequence masking; all-gathered for the full-seq view).
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return mha(q, k, v, causal=causal, scale=scale,
+                   segment_ids=segment_ids)
+    h = q.shape[2]
+    hkv = k.shape[2]
+    if h % n:
+        raise ValueError(f"ulysses: n_heads={h} not divisible by axis size {n}")
+    if hkv % n:
+        # grouped KV heads don't scatter evenly — expand to full heads (mha
+        # then sees plain MHA); when hkv % n == 0 the GQA ratio survives the
+        # reshard and mha() expands per-device as usual
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+
+    # seq-sharded/full-heads -> full-seq/head-sharded: [B,S,H/N,D]
+    a2a = lambda x: jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    seg = None
+    if segment_ids is not None:
+        seg = jax.lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+    out = mha(qg, kg, vg, causal=causal, scale=scale, segment_ids=seg)
+    # back: full-seq/head-sharded -> seq-sharded/full-heads
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    axis_name: str = "sequence",
+) -> jax.Array:
+    """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq,
+    heads->tensor); composes with tensor parallelism (axis dropped at size 1)."""
+    spec = P(("data", "fsdp"), axis_name, "tensor", None)
+    seg_spec = P(("data", "fsdp"), axis_name)
+
+    if segment_ids is None:
+        def body(ql, kl, vl):
+            return ulysses_attention(ql, kl, vl, axis_name=axis_name,
+                                     causal=causal, scale=scale)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+
+    def body_seg(ql, kl, vl, segl):
+        return ulysses_attention(ql, kl, vl, axis_name=axis_name,
+                                 causal=causal, scale=scale, segment_ids=segl)
+
+    return jax.shard_map(body_seg, mesh=mesh,
+                         in_specs=(spec, spec, spec, seg_spec),
+                         out_specs=spec)(q, k, v, segment_ids)

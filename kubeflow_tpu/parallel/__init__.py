@@ -1,7 +1,9 @@
 from kubeflow_tpu.parallel.mesh import (
     AXIS_ORDER,
     MeshConfig,
+    active_mesh,
     batch_sharding,
+    get_active_mesh,
     make_mesh,
     mesh_shape,
     num_data_shards,
@@ -22,6 +24,8 @@ __all__ = [
     "make_mesh",
     "mesh_shape",
     "single_device_mesh",
+    "active_mesh",
+    "get_active_mesh",
     "batch_sharding",
     "replicated",
     "num_data_shards",

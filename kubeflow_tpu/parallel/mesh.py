@@ -25,9 +25,11 @@ PartitionSpecs so the same sharding rules work for any mesh shape.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
-from typing import Sequence
+import threading
+from typing import Iterator, Sequence
 
 import jax
 import numpy as np
@@ -124,6 +126,30 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
 
 def mesh_shape(mesh: Mesh) -> dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+# Ambient mesh: models dispatch manual-collective islands (ring/Ulysses
+# attention, MoE all-to-all — shard_map needs a concrete Mesh at trace time)
+# without threading a Mesh through every config. The trainer sets this around
+# step tracing; plain jit/GSPMD paths never read it.
+_ACTIVE = threading.local()
+
+
+@contextlib.contextmanager
+def active_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    stack = getattr(_ACTIVE, "stack", None)
+    if stack is None:
+        stack = _ACTIVE.stack = []
+    stack.append(mesh)
+    try:
+        yield mesh
+    finally:
+        stack.pop()
+
+
+def get_active_mesh() -> Mesh | None:
+    stack = getattr(_ACTIVE, "stack", None)
+    return stack[-1] if stack else None
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

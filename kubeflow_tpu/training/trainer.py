@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from kubeflow_tpu.models import registry
 from kubeflow_tpu.parallel import (
     MeshConfig,
+    active_mesh,
     make_mesh,
     logical_to_spec,
     tree_logical_to_sharding,
@@ -95,6 +96,11 @@ class Trainer:
                                                        self.rules)
         self.batch_spec = logical_to_spec(("batch",), self.rules)
         self.batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+        # rank>=2 batch leaves ([B, S, ...] tokens/masks) additionally shard
+        # dim 1 over the sequence axis (dropped at size 1 — a no-op off the
+        # long-context path)
+        self.batch_seq_spec = logical_to_spec(("batch", "seq"), self.rules)
+        self.batch_seq_sharding = NamedSharding(self.mesh, self.batch_seq_spec)
         self.repl = NamedSharding(self.mesh, PartitionSpec())
 
         self._jit_init = None
@@ -170,22 +176,34 @@ class Trainer:
             return new_state, metrics
 
         # state keeps the sharding it was initialized with (in_shardings=None
-        # = "as given"); batch is forced onto the data axes.
-        batch_sh = jax.tree.map(lambda _: self.batch_sharding, example_batch)
-        return jax.jit(
+        # = "as given"); batch is forced onto the data (+sequence) axes.
+        batch_sh = jax.tree.map(self._leaf_sharding, example_batch)
+        jitted = jax.jit(
             train_step,
             in_shardings=(None, batch_sh),
             donate_argnums=(0,),
         )
+
+        def step(state, batch):
+            # ambient mesh for shard_map islands (ring/Ulysses attention,
+            # MoE all-to-all) traced inside the jitted step
+            with active_mesh(self.mesh):
+                return jitted(state, batch)
+
+        return step
 
     def compiled_step(self, state, example_batch):
         if self._jit_step is None:
             self._jit_step = self._build_step(example_batch)
         return self._jit_step
 
+    def _leaf_sharding(self, x) -> NamedSharding:
+        return (self.batch_seq_sharding if getattr(x, "ndim", 0) >= 2
+                else self.batch_sharding)
+
     def shard_batch(self, batch: dict[str, Any]) -> dict[str, Any]:
         return jax.tree.map(
-            lambda x: jax.device_put(x, self.batch_sharding), batch)
+            lambda x: jax.device_put(x, self._leaf_sharding(x)), batch)
 
     # -- loop ----------------------------------------------------------------
 
