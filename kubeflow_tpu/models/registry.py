@@ -53,10 +53,13 @@ def _populate() -> None:
     if _populated:
         return
     _populated = True
-    from kubeflow_tpu.models import bert, llama, mnist_cnn, resnet
+    from kubeflow_tpu.models import bert, llama, mnist_cnn, moe_llama, resnet
 
     register("llama", ModelDef(llama.LlamaConfig, llama.init, llama.apply,
                                llama.loss_fn, llama.logical_axes))
+    register("mixtral", ModelDef(moe_llama.MoELlamaConfig, moe_llama.init,
+                                 moe_llama.apply, moe_llama.loss_fn,
+                                 moe_llama.logical_axes))
     register("mnist_cnn", ModelDef(mnist_cnn.MnistConfig, mnist_cnn.init,
                                    mnist_cnn.apply, mnist_cnn.loss_fn,
                                    mnist_cnn.logical_axes))

@@ -1,0 +1,118 @@
+"""Mixture-of-Experts routing + expert-parallel dispatch, TPU-first.
+
+The reference platform has no MoE of its own — expert parallelism is L7 user
+code there (SURVEY.md §2.2 parallelism table: "mesh `expert` axis + ragged
+all-to-all" is the TPU-native equivalent to build). This module is that
+equivalent, in the GShard/Switch formulation that XLA shards well:
+
+  - static expert capacity (TPU = static shapes): each expert processes at
+    most C = ceil(top_k * T / E * capacity_factor) tokens; overflow tokens
+    are dropped from that expert (their combine weight is 0) — the standard
+    trade that keeps every shape static;
+  - dispatch/combine are one-hot einsums, NOT gathers: `[T,E,C]` masks
+    contracted on the MXU. When the stacked expert weights are sharded over
+    the `expert` mesh axis and tokens over `data/fsdp`, GSPMD lowers the
+    dispatch einsum to exactly the all-to-all the ragged formulation would
+    hand-write — no manual collectives needed;
+  - auxiliary load-balance loss (Switch §2.2): E * Σ_e f_e · p_e, and router
+    z-loss for logit stability.
+
+Everything is jit/scan/remat-safe (pure functions, static shapes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEArgs:
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    router_z_coef: float = 1e-3
+
+
+def expert_capacity(tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    cap = int(tokens * top_k * capacity_factor / n_experts)
+    return max(cap, top_k)  # never below top_k so tiny test shapes route
+
+
+def route(gate_logits: jax.Array, args: MoEArgs):
+    """Top-k routing with static capacity.
+
+    gate_logits: [T, E] fp32. Returns (dispatch [T,E,C] bool-ish fp32,
+    combine [T,E,C] fp32, aux_loss scalar).
+    """
+    t, e = gate_logits.shape
+    cap = expert_capacity(t, e, args.top_k, args.capacity_factor)
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, E]
+
+    # iterative top-k (k is small and static): mask out chosen experts
+    remaining = probs
+    dispatch = jnp.zeros((t, e, cap), jnp.float32)
+    combine = jnp.zeros((t, e, cap), jnp.float32)
+    # per-expert running fill count, advanced after each of the k rounds
+    fill = jnp.zeros((e,), jnp.int32)
+    gates = []
+    for _ in range(args.top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        gate = jnp.take_along_axis(remaining, idx[:, None], axis=1)[:, 0]
+        remaining = remaining * (1.0 - jax.nn.one_hot(idx, e))
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [T, E]
+        # position of each token within its chosen expert's buffer this round
+        pos_in_e = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]  # [T, E]
+        fill = fill + jnp.sum(onehot, axis=0)
+        pos = jnp.sum(pos_in_e * onehot, axis=-1)  # [T]
+        keep = pos < cap  # overflow tokens dropped for this expert
+        slot = jax.nn.one_hot(pos, cap) * keep[:, None]  # [T, C]
+        d = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        gates.append(gate)
+
+    # renormalize combine weights over the experts that actually kept the token
+    denom = jnp.maximum(jnp.sum(combine, axis=(1, 2), keepdims=True), 1e-9)
+    combine = combine / denom
+
+    # load-balance aux loss over the FIRST choice (Switch): fraction of
+    # tokens routed to e  ·  mean router prob of e
+    first_idx = jnp.argmax(probs, axis=-1)
+    f_e = jnp.mean(jax.nn.one_hot(first_idx, e), axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = args.aux_loss_coef * e * jnp.sum(f_e * p_e)
+    z = args.router_z_coef * jnp.mean(
+        jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
+    return dispatch, combine, aux + z
+
+
+def moe_mlp(x: jax.Array, router_w: jax.Array, w_gate: jax.Array,
+            w_up: jax.Array, w_down: jax.Array, args: MoEArgs,
+            dtype: Any = jnp.bfloat16):
+    """SwiGLU expert MLP with top-k routing.
+
+    x: [B, S, D]; router_w: [D, E]; w_gate/w_up: [E, D, F]; w_down: [E, F, D]
+    (stack sharded over the `expert` mesh axis via logical rules).
+    Returns (out [B, S, D], aux_loss scalar).
+    """
+    b, s, d = x.shape
+    xt = x.reshape(b * s, d)
+    gate_logits = (xt @ router_w.astype(jnp.float32)).astype(jnp.float32)
+    dispatch, combine, aux = route(gate_logits, args)
+
+    dispatch = dispatch.astype(dtype)
+    # [T,E,C] x [T,D] -> [E,C,D]: the expert-parallel all-to-all lives here
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    g = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dtype))
+    u = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dtype))
+    h = jax.nn.silu(g) * u
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(dtype))
+    # combine back: [T,E,C] x [E,C,D] -> [T,D]
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+    return out.reshape(b, s, d), aux

@@ -23,8 +23,11 @@ def synthetic_tokens(batch_size: int, seq_len: int, vocab_size: int,
     base = rng.integers(0, vocab_size, size=(64,))
     while True:
         starts = rng.integers(0, 64, size=(batch_size,))
+        # exactly seq_len tokens: the model forwards the full sequence and
+        # shifts logits internally (loss over seq_len-1 targets), keeping S a
+        # clean power of two for attention blocks and the sequence mesh axis
         tokens = np.stack([
-            np.resize(np.roll(base, -s), seq_len + 1) for s in starts
+            np.resize(np.roll(base, -s), seq_len) for s in starts
         ])
         noise = rng.random(tokens.shape) < 0.02
         tokens = np.where(noise, rng.integers(0, vocab_size, tokens.shape), tokens)
@@ -76,7 +79,7 @@ def array_dataset(arrays: dict[str, np.ndarray], batch_size: int,
 def for_model(model: str, model_cfg, batch_size: int, seq_len: int = 128,
               seed: int = 0) -> Iterator[dict[str, Any]]:
     """Default synthetic stream for a registered model (bench/HPO/test path)."""
-    if model == "llama":
+    if model in ("llama", "mixtral"):
         return synthetic_tokens(batch_size, seq_len, model_cfg.vocab_size, seed)
     if model == "bert":
         return synthetic_classification_text(
