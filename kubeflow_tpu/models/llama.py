@@ -52,6 +52,9 @@ class LlamaConfig:
     remat: bool = True
     # remat policy: "none" | "minimal" (checkpoint_dots) | "full"
     remat_policy: str = "minimal"
+    # microbatches for the GPipe schedule when the mesh has a `stage` axis;
+    # 0 = one microbatch per stage (minimum that fills the pipe)
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
@@ -216,7 +219,18 @@ def apply(
 
 def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
     """Next-token cross-entropy with optional loss mask. batch: tokens [B,S],
-    optionally loss_mask [B,S] (1.0 where the target counts)."""
+    optionally loss_mask [B,S] (1.0 where the target counts).
+
+    On a mesh with a `stage` axis the whole forward+loss runs as a GPipe
+    schedule instead (parallel.pipeline) — same math, pipelined execution."""
+    from kubeflow_tpu.parallel.mesh import get_active_mesh, mesh_shape
+
+    mesh = get_active_mesh()
+    if mesh is not None and mesh_shape(mesh).get("stage", 1) > 1:
+        from kubeflow_tpu.parallel.pipeline import pipelined_llama_loss
+
+        return pipelined_llama_loss(params, batch, cfg, mesh,
+                                    cfg.pipeline_microbatches or None)
     tokens = batch["tokens"]
     # Forward on the FULL sequence, shift logits afterwards: S-1 wouldn't
     # divide a `sequence` mesh axis, and the slice lives in GSPMD-land where

@@ -79,10 +79,14 @@ def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
             "bhqk,bhkd->bhqd", p, v_blk)
         return (new_acc, new_m, new_s), None
 
+    # carries derived from q (not fresh zeros) so they inherit q's varying
+    # manual axes — required when this runs inside a shard_map body (e.g.
+    # a pipeline stage), harmless under plain jit
+    bhqd = jnp.zeros_like(qf, jnp.float32)  # [B,H,Sq,D]
     init = (
-        jnp.zeros((b, h, sq, d), jnp.float32),
-        jnp.full((b, h, sq), NEG_INF, jnp.float32),
-        jnp.zeros((b, h, sq), jnp.float32),
+        bhqd,
+        jnp.full_like(bhqd[..., 0], NEG_INF),
+        jnp.zeros_like(bhqd[..., 0]),
     )
     (acc, m, s), _ = jax.lax.scan(
         body, init,

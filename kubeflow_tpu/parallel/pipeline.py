@@ -1,0 +1,173 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the `stage`
+mesh axis (SURVEY.md §2.2 — the reference only ever launches DeepSpeed/
+Megatron containers for PP; here it is a framework primitive).
+
+TPU-first shape: the model's stacked-layer tensors ([L, ...], the lax.scan
+axis) are sharded over `stage`, so each stage device holds a contiguous
+L/n_stages slab. Inside one ``jax.shard_map`` the classic GPipe schedule
+runs as a ``lax.scan`` over M + S - 1 ticks:
+
+  tick t: stage 0 ingests microbatch t; every stage applies its layer slab
+  to its current activation; ``ppermute`` rotates activations one stage down
+  the ICI ring; the last stage banks finished microbatches.
+
+All control flow is static (clipped dynamic slices + where-masks instead of
+data-dependent branches), so XLA compiles ONE tick body and the schedule is
+a rolled loop — compile time is O(1) in both depth and microbatch count.
+Warmup/drain bubbles execute with garbage inputs and are masked out, the
+standard SPMD trade (bubble fraction (S-1)/(M+S-1)).
+
+Gradients: plain autodiff through the scan + ppermute — the backward pass
+is automatically the reverse pipeline (activations rotate back up the ring).
+Replicated leaves (embed, lm_head, norms) get their gradient psum from
+shard_map's transpose; per-stage layer slabs keep per-stage gradients,
+which is exactly the sharding the optimizer state carries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "stage"
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x_mb: jax.Array,
+    *,
+    axis_name: str = AXIS,
+) -> jax.Array:
+    """Run the GPipe schedule *inside* shard_map.
+
+    stage_fn(stage_params, x) -> y applies one stage's layer slab.
+    x_mb: [M, ...] microbatches (replicated across stage devices).
+    Returns [M, ...] outputs, valid on the LAST stage (zeros elsewhere —
+    callers mask by stage index and psum).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    ticks = m + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf, out = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        cur = jnp.where(stage == 0, feed, buf)
+        y = stage_fn(stage_params, cur)
+        mb_idx = t - (n_stages - 1)
+        done = jax.lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(mb_idx, 0, m - 1), axis=0)
+        out = jnp.where((mb_idx >= 0) & (stage == n_stages - 1), done, out)
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return (buf, out), None
+
+    # zeros are stage-invariant but the tick outputs vary per stage — mark
+    # the carry as varying over the stage axis or scan rejects the types
+    init = jax.tree.map(
+        lambda z: jax.lax.pcast(z, (axis_name,), to="varying"),
+        (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb)))
+    (_, out), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+    return out
+
+
+def microbatch(x: jax.Array, n: int) -> jax.Array:
+    """[B, ...] -> [n, B/n, ...]."""
+    if x.shape[0] % n:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"{n} microbatches")
+    return x.reshape(n, x.shape[0] // n, *x.shape[1:])
+
+
+def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
+                         n_microbatches: int | None = None):
+    """Pipelined forward+loss for llama-family params on a `stage` mesh.
+
+    Numerically identical to llama.loss_fn (same layer math, same shift);
+    only the execution schedule differs. segment_ids and the seq-parallel
+    attention islands are not composed with PP yet — validated upstream.
+    """
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.ops.norms import rms_norm
+    from kubeflow_tpu.parallel.mesh import mesh_shape
+    from kubeflow_tpu.parallel.sharding import logical_to_spec
+
+    shape = mesh_shape(mesh)
+    n_stages = shape.get(AXIS, 1)
+    if batch.get("segment_ids") is not None or \
+            batch.get("loss_mask") is not None:
+        raise NotImplementedError(
+            "pipeline parallelism with segment_ids/loss_mask")
+    if cfg.attention_impl in ("ring", "ulysses") and \
+            shape.get("sequence", 1) > 1:
+        raise NotImplementedError(
+            "pipeline + sequence-parallel attention not composed yet; "
+            "use attention_impl='flash' or 'xla' with stage>1")
+    if shape.get("tensor", 1) > 1 or shape.get("fsdp", 1) > 1:
+        raise NotImplementedError(
+            "pipeline composes with `data` only for now; tensor/fsdp "
+            "sharding inside a stage slab needs manual-collective matmuls")
+    m = n_microbatches or n_stages
+    tokens = batch["tokens"]
+    positions = jnp.arange(tokens.shape[1])
+
+    def body(params, tokens):
+        # embed redundantly on every stage device (tiny vs layer compute);
+        # only stage 0's result actually feeds the pipe
+        x = params["embed"].astype(cfg.dtype)[tokens]  # [M, Bm, S, D]
+
+        def stage_fn(layers, h):
+            def layer_body(carry, layer):
+                return llama._layer_body(cfg, carry, layer, positions, None)
+
+            fn = layer_body
+            if cfg.remat:
+                policy = {
+                    "minimal":
+                        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                    "full": jax.checkpoint_policies.nothing_saveable,
+                    "none": jax.checkpoint_policies.everything_saveable,
+                }[cfg.remat_policy]
+                fn = jax.checkpoint(fn, policy=policy)
+            h, _ = jax.lax.scan(fn, h, layers)
+            return h
+
+        out = gpipe(stage_fn, params["layers"], x)
+        # out: [M, Bm, S, D], valid on last stage only
+        h = rms_norm(out, params["final_norm"], cfg.norm_eps)
+        logits = jnp.einsum("mbsd,dv->mbsv", h,
+                            params["lm_head"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)[:, :, :-1]
+        targets = tokens[:, :, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_loss = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        stage = jax.lax.axis_index(AXIS)
+        n = jax.lax.axis_size(AXIS)
+        is_last = (stage == n - 1).astype(jnp.float32)
+        # non-last stages contribute zeros; psum over stage picks the real
+        # values and over data/fsdp averages the DP shards
+        total = jnp.sum(token_loss) * is_last
+        count = jnp.sum(jnp.ones_like(token_loss)) * is_last
+        total = jax.lax.psum(total, (AXIS, "data", "fsdp"))
+        count = jax.lax.psum(count, (AXIS, "data", "fsdp"))
+        loss = total / jnp.maximum(count, 1.0)
+        return loss, {"loss": loss, "tokens": count}
+
+    # layer slabs per stage; small params replicated; microbatched tokens
+    # [M, Bm, S] keep their DP sharding on the Bm axis
+    layer_spec = jax.tree.map(lambda _: P(AXIS), params["layers"])
+    in_specs = ({"embed": P(), "layers": layer_spec, "final_norm": P(),
+                 "lm_head": P()},
+                P(None, ("data", "fsdp")))
+    mb_tokens = microbatch(tokens, m)
+    loss, metrics = jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+    )(params, mb_tokens)
+    return loss, metrics

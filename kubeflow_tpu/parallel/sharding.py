@@ -43,7 +43,10 @@ DEFAULT_RULES: dict[str, Any] = {
     "qkv": "tensor",
     "kv": None,
     "vocab": "tensor",
-    "layers": None,
+    # scanned-layer axis shards over pipeline stages (dropped at stage=1);
+    # each stage device then holds a contiguous L/stages slab of every layer
+    # tensor — exactly what the GPipe shard_map runner needs locally
+    "layers": "stage",
     "expert": "expert",
     "conv_in": None,
     "conv_out": "fsdp",
