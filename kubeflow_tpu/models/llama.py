@@ -25,7 +25,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from kubeflow_tpu.ops.attention import mha
+from kubeflow_tpu.ops.attention import mha, repeat_kv
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rope import apply_rope
 
@@ -246,6 +246,99 @@ def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
     total = jnp.sum(token_loss * mask)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     return total / denom, {"loss": total / denom, "tokens": jnp.sum(mask)}
+
+
+# ---------------------------------------------------------------------------
+# Serving path: KV-cache prefill + decode (the in-framework replacement for
+# the reference's Triton/torchserve runtime containers, SURVEY.md §2.4/§2.6).
+# Static shapes throughout: prompt lengths are bucketed by the serving
+# scheduler; the cache is a fixed [L, slots, max_len, kv, hd] ring of slots.
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: LlamaConfig, n_slots: int, max_len: int) -> Params:
+    shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _project_qkv(cfg: LlamaConfig, layer, x, positions):
+    b, s, _ = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, nh, hd)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    return (apply_rope(q, positions, theta=cfg.rope_theta),
+            apply_rope(k, positions, theta=cfg.rope_theta), v)
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig):
+    """Forward a (right-padded) prompt, returning logits and per-layer KV.
+
+    tokens: [B, S] → (logits [B, S, vocab] fp32, k, v [L, B, S, kv, hd]).
+    Pad positions produce garbage KV past the true length — callers track
+    lengths and decode masks them out.
+    """
+    b, s = tokens.shape
+    positions = jnp.arange(s)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer):
+        x = carry
+        q, k, v = _project_qkv(cfg, layer, x, positions)
+        out = mha(q, k, v, causal=True)
+        x = x + out.reshape(b, s, -1) @ layer["wo"].astype(cfg.dtype)
+        x = _mlp(cfg, x, layer)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
+                lengths: jax.Array, cfg: LlamaConfig):
+    """One continuous-batching decode step over all cache slots.
+
+    last_tokens: [B] token per slot; lengths: [B] current KV lengths
+    (position where this step's KV is written). Returns
+    (logits [B, vocab] fp32, updated cache). Inactive slots just produce
+    garbage logits the engine ignores — shapes stay static.
+    """
+    b = last_tokens.shape[0]
+    max_len = cache["k"].shape[2]
+    x = params["embed"].astype(cfg.dtype)[last_tokens][:, None]  # [B,1,D]
+    rows = jnp.arange(b)
+    k_pos = jnp.arange(max_len)
+
+    def body(carry, inp):
+        x = carry
+        layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
+        q, k_new, v_new = _project_qkv(cfg, layer, x, lengths[:, None])
+        ck = ck.at[rows, lengths].set(k_new[:, 0])
+        cv = cv.at[rows, lengths].set(v_new[:, 0])
+        nh, nkv = cfg.n_heads, cfg.n_kv_heads
+        kf = repeat_kv(ck, nh // nkv)
+        vf = repeat_kv(cv, nh // nkv)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32)
+        logits *= 1.0 / (cfg.head_dim ** 0.5)
+        mask = (k_pos[None, :] <= lengths[:, None])[:, None, None, :]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        x = x + out.reshape(b, 1, -1) @ layer["wo"].astype(cfg.dtype)
+        x = _mlp(cfg, x, layer)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                         cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {"k": ks, "v": vs}
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:

@@ -1,0 +1,76 @@
+"""Loader for the framework's native (C++) components.
+
+The reference platform leans on native dependencies for its hot paths —
+Triton's C++ serving core, MLMD's C++ metadata store, NCCL/MPI rendezvous
+(SURVEY.md §2.6). This package provides the TPU-native equivalents as small
+C++ libraries with flat C ABIs, bound via ctypes (no pybind11 in the image).
+
+Libraries are compiled on demand from ``native/src/*.cpp`` with the system
+g++ into ``native/build/`` and cached by source mtime; environments without
+a toolchain raise ``NativeUnavailable`` and callers fall back to their pure-
+Python implementations (same contract, slower queue/scheduling paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
+BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL] = {}
+
+
+class NativeUnavailable(RuntimeError):
+    """No toolchain / source for the requested native library."""
+
+
+def _compiler() -> str | None:
+    return shutil.which("g++") or shutil.which("c++")
+
+
+def build(name: str, force: bool = False) -> str:
+    """Compile native/src/<name>.cpp → native/build/lib<name>.so; returns path."""
+    src = os.path.join(SRC_DIR, f"{name}.cpp")
+    if not os.path.exists(src):
+        raise NativeUnavailable(f"no native source {src}")
+    out = os.path.join(BUILD_DIR, f"lib{name}.so")
+    if not force and os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cxx = _compiler()
+    if cxx is None:
+        raise NativeUnavailable("no C++ compiler on PATH")
+    os.makedirs(BUILD_DIR, exist_ok=True)
+    tmp = out + ".tmp"
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeUnavailable(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr[-2000:]}")
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def library(name: str) -> ctypes.CDLL:
+    """Load (building if needed) a native library by source name."""
+    with _lock:
+        if name not in _cache:
+            _cache[name] = ctypes.CDLL(build(name))
+        return _cache[name]
+
+
+def available(name: str) -> bool:
+    try:
+        library(name)
+        return True
+    except NativeUnavailable:
+        return False

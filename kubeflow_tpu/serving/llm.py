@@ -1,0 +1,176 @@
+"""Continuous-batching LLM engine — the KServe/Triton-GPU serving runtime
+replaced by a TPU-native design (SURVEY.md §2.6, BASELINE config #5: the
+Llama InferenceService TTFT metric runs through this engine).
+
+Split into the two halves the hardware wants:
+
+  - **Scheduling** (C++ core, serving/scheduler.py): request queue, decode
+    slots, prefill-bucket choice. Decisions only — never touches tensors.
+  - **Execution** (this module): a fixed menu of compiled XLA programs —
+    one prefill program per bucket length plus ONE decode program over all
+    slots — so serving never recompiles. Static shapes are the TPU
+    constraint the whole design bends around: variable prompts are padded
+    up to a bucket; the decode batch always runs full-width with inactive
+    slots masked by the engine.
+
+Prefill priority keeps TTFT low; decode always re-batches every step
+(continuous batching), so finished slots refill immediately from the queue.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.scheduler import (DecodeAction, PrefillAction,
+                                            make_scheduler)
+
+
+class LLMEngine:
+    """Greedy continuous-batching generation over llama-family params."""
+
+    def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
+                 max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
+                 max_queue: int = 1024, eos_id: int | None = None,
+                 prefer_native: bool = True):
+        if max(buckets) >= max_len:
+            raise ValueError("largest bucket must leave room to decode")
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.buckets = tuple(sorted(buckets))
+        self.eos_id = eos_id
+        self.scheduler = make_scheduler(n_slots, self.buckets, max_queue,
+                                        prefer_native=prefer_native)
+        self.cache = llama.init_cache(cfg, n_slots, max_len)
+        self.lengths = jnp.zeros((n_slots,), jnp.int32)
+        self.last_tokens = jnp.zeros((n_slots,), jnp.int32)
+
+        self._prompts: dict[int, list[int]] = {}
+        self._results: dict[int, list[int]] = {}
+        self._submit_t: dict[int, float] = {}
+        self._first_token_t: dict[int, float] = {}
+        self._done: set[int] = set()
+        self._prefill_fns: dict[int, Any] = {}
+        self._decode_fn = jax.jit(self._decode, donate_argnums=(0,))
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _prefill(self, cache, tokens, slot, prompt_len):
+        """tokens [1, bucket] right-padded; writes KV into `slot`."""
+        logits, ks, vs = llama.prefill(self.params, tokens, self.cfg)
+        bucket = tokens.shape[1]
+        k = cache["k"].at[:, slot, :bucket].set(ks[:, 0])
+        v = cache["v"].at[:, slot, :bucket].set(vs[:, 0])
+        last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1,
+                                            keepdims=False)
+        return {"k": k, "v": v}, jnp.argmax(last, -1).astype(jnp.int32)
+
+    def _decode(self, cache, last_tokens, lengths):
+        logits, cache = llama.decode_step(self.params, last_tokens, cache,
+                                          lengths, self.cfg)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def _prefill_fn(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket] = jax.jit(
+                self._prefill, donate_argnums=(0,))
+        return self._prefill_fns[bucket]
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> int:
+        req_id = self.scheduler.submit(len(prompt), max_new_tokens,
+                                       time.monotonic())
+        self._prompts[req_id] = list(prompt)
+        self._results[req_id] = []
+        self._submit_t[req_id] = time.monotonic()
+        return req_id
+
+    def step(self) -> bool:
+        """One engine iteration: a prefill or a batched decode. False = idle."""
+        action = self.scheduler.next()
+        if action is None:
+            return False
+        if isinstance(action, PrefillAction):
+            self._do_prefill(action)
+        elif isinstance(action, DecodeAction):
+            self._do_decode()
+        return True
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def is_done(self, req_id: int) -> bool:
+        return req_id in self._done
+
+    def result(self, req_id: int) -> list[int]:
+        if req_id not in self._done:
+            raise KeyError(f"request {req_id} not finished")
+        return self._results[req_id]
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: int = 32) -> list[int]:
+        rid = self.submit(prompt, max_new_tokens)
+        while not self.is_done(rid):
+            if not self.step():
+                raise RuntimeError("engine idle with request outstanding")
+        return self.result(rid)
+
+    def metrics(self) -> dict[str, Any]:
+        ttfts = [self._first_token_t[r] - self._submit_t[r]
+                 for r in self._first_token_t]
+        s = self.scheduler.stats()
+        out = {"queued": s.queued, "active": s.active,
+               "completed": s.completed, "rejected": s.rejected}
+        if ttfts:
+            out["ttft_p50_s"] = float(np.percentile(ttfts, 50))
+            out["ttft_p99_s"] = float(np.percentile(ttfts, 99))
+        return out
+
+    # -- internals -----------------------------------------------------------
+
+    def _do_prefill(self, a: PrefillAction) -> None:
+        prompt = self._prompts[a.req_id]
+        tokens = np.zeros((1, a.bucket_len), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        self.cache, next_tok = self._prefill_fn(a.bucket_len)(
+            self.cache, jnp.asarray(tokens), a.slot, a.prompt_len)
+        self.lengths = self.lengths.at[a.slot].set(a.prompt_len)
+        self.last_tokens = self.last_tokens.at[a.slot].set(next_tok)
+        self._record_token(a.req_id, a.slot, int(next_tok),
+                           first_token=True)
+
+    def _do_decode(self) -> None:
+        slot_req = [self.scheduler.slot_request(s) for s in range(self.n_slots)]
+        self.cache, toks = self._decode_fn(self.cache, self.last_tokens,
+                                           self.lengths)
+        toks_np = np.asarray(toks)
+        new_lengths = np.array(self.lengths)  # writable host copy
+        for slot, req in enumerate(slot_req):
+            if req < 0:
+                continue
+            new_lengths[slot] += 1
+            self._record_token(req, slot, int(toks_np[slot]))
+        self.lengths = jnp.asarray(new_lengths)
+        self.last_tokens = jnp.asarray(toks_np)
+
+    def _record_token(self, req_id: int, slot: int, token: int,
+                      first_token: bool = False) -> None:
+        if first_token:
+            self._first_token_t[req_id] = time.monotonic()
+        self._results[req_id].append(token)
+        hit_eos = self.eos_id is not None and token == self.eos_id
+        # cache exhaustion: the NEXT decode would write at index `lengths`,
+        # which must stay < max_len
+        out_of_room = int(np.asarray(self.lengths)[slot]) + 1 >= self.max_len
+        freed = self.scheduler.token_done(slot, finished=hit_eos or out_of_room)
+        if freed:
+            self._done.add(req_id)

@@ -1,0 +1,210 @@
+"""Continuous-batching scheduler bindings.
+
+`NativeScheduler` drives the C++ core (native/src/cb_scheduler.cpp) via
+ctypes; `PyScheduler` is the pure-Python fallback with identical semantics
+(used when no toolchain is available, and as the differential-testing oracle
+for the native one). Both expose the same small API the LLM engine loop
+consumes: submit / next / token_done / slot_request / stats.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import threading
+from collections import deque
+from typing import Sequence
+
+IDLE, PREFILL, DECODE = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefillAction:
+    req_id: int
+    slot: int
+    bucket_len: int
+    prompt_len: int
+    max_new_tokens: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeAction:
+    active: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Stats:
+    queued: int
+    active: int
+    completed: int
+    rejected: int
+
+
+class QueueFull(RuntimeError):
+    pass
+
+
+class PromptTooLong(ValueError):
+    pass
+
+
+class NativeScheduler:
+    """ctypes binding over the C++ continuous-batching scheduler."""
+
+    def __init__(self, max_slots: int, buckets: Sequence[int],
+                 max_queue: int = 1024):
+        from kubeflow_tpu.native import library
+
+        self._lib = library("cb_scheduler")
+        self._lib.cbs_create.restype = ctypes.c_void_p
+        self._lib.cbs_create.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        self._lib.cbs_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.cbs_submit.restype = ctypes.c_int64
+        self._lib.cbs_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_double]
+        self._lib.cbs_next.restype = ctypes.c_int32
+        self._lib.cbs_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        self._lib.cbs_token_done.restype = ctypes.c_int32
+        self._lib.cbs_token_done.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        self._lib.cbs_slot_request.restype = ctypes.c_int64
+        self._lib.cbs_slot_request.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        self._lib.cbs_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_int64)] * 4
+
+        arr = (ctypes.c_int32 * len(buckets))(*sorted(buckets))
+        self._h = self._lib.cbs_create(max_slots, max_queue, arr, len(buckets))
+        if not self._h:
+            raise ValueError("bad scheduler config (slots/buckets)")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.cbs_destroy(h)
+            self._h = None
+
+    def submit(self, prompt_len: int, max_new_tokens: int,
+               now: float = 0.0) -> int:
+        rid = self._lib.cbs_submit(self._h, prompt_len, max_new_tokens, now)
+        if rid == -1:
+            raise QueueFull("scheduler queue full")
+        if rid == -2:
+            raise PromptTooLong(f"prompt_len {prompt_len} exceeds buckets")
+        return rid
+
+    def next(self) -> PrefillAction | DecodeAction | None:
+        out = (ctypes.c_int64 * 5)()
+        code = self._lib.cbs_next(self._h, out)
+        if code == PREFILL:
+            return PrefillAction(out[0], int(out[1]), int(out[2]),
+                                 int(out[3]), int(out[4]))
+        if code == DECODE:
+            return DecodeAction(int(out[1]))
+        return None
+
+    def token_done(self, slot: int, finished: bool = False) -> bool:
+        r = self._lib.cbs_token_done(self._h, slot, 1 if finished else 0)
+        if r < 0:
+            raise ValueError(f"token_done on inactive slot {slot}")
+        return bool(r)
+
+    def slot_request(self, slot: int) -> int:
+        return int(self._lib.cbs_slot_request(self._h, slot))
+
+    def stats(self) -> Stats:
+        vals = [ctypes.c_int64() for _ in range(4)]
+        self._lib.cbs_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return Stats(*[int(v.value) for v in vals])
+
+
+@dataclasses.dataclass
+class _PySlot:
+    req_id: int = -1
+    generated: int = 0
+    max_new: int = 0
+    active: bool = False
+
+
+class PyScheduler:
+    """Pure-Python twin of the C++ scheduler (same policy, same API)."""
+
+    def __init__(self, max_slots: int, buckets: Sequence[int],
+                 max_queue: int = 1024):
+        self._buckets = sorted(buckets)
+        self._queue: deque = deque()
+        self._slots = [_PySlot() for _ in range(max_slots)]
+        self._max_queue = max_queue
+        self._next_id = 1
+        self._completed = 0
+        self._rejected = 0
+        self._mu = threading.Lock()
+
+    def submit(self, prompt_len: int, max_new_tokens: int,
+               now: float = 0.0) -> int:
+        with self._mu:
+            if prompt_len <= 0 or prompt_len > self._buckets[-1]:
+                self._rejected += 1
+                raise PromptTooLong(
+                    f"prompt_len {prompt_len} exceeds buckets")
+            if len(self._queue) >= self._max_queue:
+                self._rejected += 1
+                raise QueueFull("scheduler queue full")
+            rid = self._next_id
+            self._next_id += 1
+            self._queue.append((rid, prompt_len, max_new_tokens))
+            return rid
+
+    def next(self) -> PrefillAction | DecodeAction | None:
+        with self._mu:
+            free = next((i for i, s in enumerate(self._slots)
+                         if not s.active), -1)
+            if free >= 0 and self._queue:
+                rid, plen, max_new = self._queue.popleft()
+                sl = self._slots[free]
+                sl.req_id, sl.generated, sl.max_new, sl.active = \
+                    rid, 0, max_new, True
+                bucket = next((b for b in self._buckets if b >= plen),
+                              self._buckets[-1])
+                return PrefillAction(rid, free, bucket, plen, max_new)
+            active = sum(s.active for s in self._slots)
+            if active:
+                return DecodeAction(active)
+            return None
+
+    def token_done(self, slot: int, finished: bool = False) -> bool:
+        with self._mu:
+            sl = self._slots[slot]
+            if not sl.active:
+                raise ValueError(f"token_done on inactive slot {slot}")
+            sl.generated += 1
+            if finished or sl.generated >= sl.max_new:
+                sl.active = False
+                sl.req_id = -1
+                self._completed += 1
+                return True
+            return False
+
+    def slot_request(self, slot: int) -> int:
+        with self._mu:
+            sl = self._slots[slot]
+            return sl.req_id if sl.active else -1
+
+    def stats(self) -> Stats:
+        with self._mu:
+            return Stats(len(self._queue),
+                         sum(s.active for s in self._slots),
+                         self._completed, self._rejected)
+
+
+def make_scheduler(max_slots: int, buckets: Sequence[int],
+                   max_queue: int = 1024, prefer_native: bool = True):
+    """Native scheduler when the toolchain allows, Python twin otherwise."""
+    if prefer_native:
+        try:
+            return NativeScheduler(max_slots, buckets, max_queue)
+        except Exception:
+            pass
+    return PyScheduler(max_slots, buckets, max_queue)

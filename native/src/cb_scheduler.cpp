@@ -1,0 +1,175 @@
+// Continuous-batching scheduler — the Triton-dynamic-batching / vLLM-queue
+// analog (SURVEY.md §2.6: "C++ TPU serving core: request queueing +
+// continuous batching front-end feeding a compiled pjit step").
+//
+// Pure scheduling logic, no tensor work: the Python engine owns the XLA
+// prefill/decode functions and the KV cache; this module owns the request
+// queue, decode-slot lifecycle, and prefill-bucket choice. TPU constraint
+// baked into the design: all shapes the engine compiles are static, so the
+// scheduler only ever hands out (slot, bucket) pairs from a fixed menu —
+// "which static program to run next" is exactly the decision it makes.
+//
+// Exposed as a flat C ABI for ctypes (the environment has no pybind11).
+// Thread-safety: a single mutex guards every entry point — the engine loop
+// and submitter threads may interleave freely.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int64_t id;
+  int32_t prompt_len;
+  int32_t max_new_tokens;
+  double submit_time;
+};
+
+struct Slot {
+  bool active = false;
+  int64_t req_id = -1;
+  int32_t generated = 0;
+  int32_t max_new_tokens = 0;
+};
+
+struct Scheduler {
+  std::mutex mu;
+  std::deque<Request> queue;
+  std::vector<Slot> slots;
+  std::vector<int32_t> buckets;  // sorted ascending prefill lengths
+  size_t max_queue;
+  int64_t next_id = 1;
+  int64_t completed = 0;
+  int64_t rejected = 0;
+};
+
+int find_free_slot(const Scheduler* s) {
+  for (size_t i = 0; i < s->slots.size(); ++i)
+    if (!s->slots[i].active) return static_cast<int>(i);
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Action codes returned by cbs_next.
+enum { CBS_IDLE = 0, CBS_PREFILL = 1, CBS_DECODE = 2 };
+
+void* cbs_create(int32_t max_slots, int32_t max_queue,
+                 const int32_t* bucket_lens, int32_t n_buckets) {
+  if (max_slots <= 0 || n_buckets <= 0) return nullptr;
+  auto* s = new Scheduler();
+  s->slots.resize(max_slots);
+  s->max_queue = max_queue > 0 ? max_queue : 1024;
+  s->buckets.assign(bucket_lens, bucket_lens + n_buckets);
+  for (size_t i = 1; i < s->buckets.size(); ++i)
+    if (s->buckets[i] < s->buckets[i - 1]) {  // enforce sorted menu
+      delete s;
+      return nullptr;
+    }
+  return s;
+}
+
+void cbs_destroy(void* h) { delete static_cast<Scheduler*>(h); }
+
+// Enqueue; returns request id, -1 if queue full, -2 if prompt exceeds the
+// largest prefill bucket (caller should reject with a client error).
+int64_t cbs_submit(void* h, int32_t prompt_len, int32_t max_new_tokens,
+                   double now) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (prompt_len <= 0 || prompt_len > s->buckets.back()) {
+    s->rejected++;
+    return -2;
+  }
+  if (s->queue.size() >= s->max_queue) {
+    s->rejected++;
+    return -1;
+  }
+  int64_t id = s->next_id++;
+  s->queue.push_back({id, prompt_len, max_new_tokens, now});
+  return id;
+}
+
+// Decide the next engine action. Prefill-priority policy: an empty decode
+// slot plus a waiting request always prefills first (minimizes TTFT; decode
+// throughput follows because the decode batch refills quickly).
+// On CBS_PREFILL: out[0]=req_id, out[1]=slot, out[2]=bucket_len,
+//                 out[3]=prompt_len, out[4]=max_new_tokens.
+// On CBS_DECODE:  out[1]=number of active slots.
+int32_t cbs_next(void* h, int64_t* out) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  int free_slot = find_free_slot(s);
+  if (free_slot >= 0 && !s->queue.empty()) {
+    Request r = s->queue.front();
+    s->queue.pop_front();
+    Slot& sl = s->slots[free_slot];
+    sl.active = true;
+    sl.req_id = r.id;
+    sl.generated = 0;
+    sl.max_new_tokens = r.max_new_tokens;
+    int32_t bucket = s->buckets.back();
+    for (int32_t b : s->buckets)
+      if (b >= r.prompt_len) { bucket = b; break; }
+    out[0] = r.id;
+    out[1] = free_slot;
+    out[2] = bucket;
+    out[3] = r.prompt_len;
+    out[4] = r.max_new_tokens;
+    return CBS_PREFILL;
+  }
+  int64_t active = 0;
+  for (const Slot& sl : s->slots) active += sl.active ? 1 : 0;
+  if (active > 0) {
+    out[1] = active;
+    return CBS_DECODE;
+  }
+  return CBS_IDLE;
+}
+
+// Record one generated token for a slot. finished != 0 forces completion
+// (EOS); hitting max_new_tokens completes implicitly. Returns 1 if the slot
+// was freed, 0 if it stays active, -1 on bad slot.
+int32_t cbs_token_done(void* h, int32_t slot, int32_t finished) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (slot < 0 || slot >= static_cast<int32_t>(s->slots.size())) return -1;
+  Slot& sl = s->slots[slot];
+  if (!sl.active) return -1;
+  sl.generated++;
+  if (finished || sl.generated >= sl.max_new_tokens) {
+    sl.active = false;
+    sl.req_id = -1;
+    s->completed++;
+    return 1;
+  }
+  return 0;
+}
+
+// Which request occupies a slot (-1 if empty) — lets the engine map decode
+// outputs back to requests without mirroring slot state in Python.
+int64_t cbs_slot_request(void* h, int32_t slot) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (slot < 0 || slot >= static_cast<int32_t>(s->slots.size())) return -1;
+  return s->slots[slot].active ? s->slots[slot].req_id : -1;
+}
+
+void cbs_stats(void* h, int64_t* queued, int64_t* active, int64_t* completed,
+               int64_t* rejected) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  *queued = static_cast<int64_t>(s->queue.size());
+  int64_t a = 0;
+  for (const Slot& sl : s->slots) a += sl.active ? 1 : 0;
+  *active = a;
+  *completed = s->completed;
+  *rejected = s->rejected;
+}
+
+}  // extern "C"
