@@ -88,8 +88,12 @@ class PipelineRunController(Controller):
             os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-pipelines")
         os.makedirs(self.root, exist_ok=True)
         self.artifacts = ArtifactStore(os.path.join(self.root, "artifacts"))
-        self.metadata = metadata or MetadataStore(
-            os.path.join(self.root, "metadata.sqlite"))
+        # C++ WAL-backed store when buildable, sqlite twin otherwise —
+        # identical API/semantics (differential-tested in test_native.py)
+        from kubeflow_tpu.pipelines.metadata import make_store
+
+        self.metadata = metadata or make_store(
+            os.path.join(self.root, "metadata.wal"))
 
     # -- reconcile ------------------------------------------------------------
 

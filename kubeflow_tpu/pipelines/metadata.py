@@ -177,3 +177,117 @@ class MetadataStore:
     def close(self) -> None:
         with self._lock:
             self._db.close()
+
+
+class NativeMetadataStore:
+    """ctypes binding over the C++ WAL-backed store (native/src/
+    metadata_store.cpp) — same API as MetadataStore, interchangeable.
+
+    The C++ side owns persistence (append-only log, replayed at open) and
+    all indexes; results cross the ABI as JSON."""
+
+    def __init__(self, path: str = ":memory:"):
+        import ctypes
+        import json as _json
+
+        from kubeflow_tpu.native import library
+
+        self._json = _json
+        lib = library("metadata_store")
+        lib.mds_create.restype = ctypes.c_void_p
+        lib.mds_create.argtypes = [ctypes.c_char_p]
+        lib.mds_destroy.argtypes = [ctypes.c_void_p]
+        lib.mds_free.argtypes = [ctypes.c_void_p]
+        lib.mds_get_or_create_context.restype = ctypes.c_int64
+        lib.mds_get_or_create_context.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_char_p] * 2
+        lib.mds_create_execution.restype = ctypes.c_int64
+        lib.mds_create_execution.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_char_p] * 4 + [ctypes.c_double]
+        lib.mds_record_io.restype = ctypes.c_int64
+        lib.mds_record_io.argtypes = [ctypes.c_void_p, ctypes.c_int64] + \
+            [ctypes.c_char_p] * 5
+        lib.mds_finish_execution.restype = ctypes.c_int32
+        lib.mds_finish_execution.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_double]
+        for fn in ("mds_cached_outputs", "mds_executions_for_run",
+                   "mds_lineage"):
+            getattr(lib, fn).restype = ctypes.c_void_p
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        self._lib = lib
+        self._ctypes = ctypes
+        cpath = b"" if path == ":memory:" else path.encode()
+        self._h = lib.mds_create(cpath)
+        if not self._h:
+            raise RuntimeError(f"cannot open native metadata store at {path}")
+
+    def _take_json(self, ptr):
+        if not ptr:
+            return None
+        try:
+            raw = self._ctypes.cast(
+                ptr, self._ctypes.c_char_p).value.decode()
+        finally:
+            self._lib.mds_free(ptr)
+        return self._json.loads(raw)
+
+    def get_or_create_context(self, name: str,
+                              ctype: str = "PipelineRun") -> int:
+        return int(self._lib.mds_get_or_create_context(
+            self._h, name.encode(), ctype.encode()))
+
+    def create_execution(self, run: str, task: str, component: str,
+                         cache_key: str | None = None) -> int:
+        return int(self._lib.mds_create_execution(
+            self._h, run.encode(), task.encode(), component.encode(),
+            (cache_key or "").encode(), time.time()))
+
+    def record_io(self, execution_id: int, name: str, art: Artifact,
+                  direction: str, atype: str = "Json") -> None:
+        self._lib.mds_record_io(
+            self._h, execution_id, name.encode(), art.uri.encode(),
+            art.digest.encode(), direction.encode(), atype.encode())
+
+    def finish_execution(self, execution_id: int, state: str,
+                         outputs: dict[str, Artifact] | None = None) -> None:
+        for name, art in (outputs or {}).items():
+            self.record_io(execution_id, name, art, "OUTPUT")
+        self._lib.mds_finish_execution(self._h, execution_id, state.encode(),
+                                       time.time())
+
+    def cached_outputs(self, cache_key: str) -> dict[str, Artifact] | None:
+        obj = self._take_json(
+            self._lib.mds_cached_outputs(self._h, cache_key.encode()))
+        if obj is None:
+            return None
+        return {name: Artifact(uri=v["uri"], digest=v["digest"])
+                for name, v in obj.items()}
+
+    def executions_for_run(self, run: str) -> list[dict[str, Any]]:
+        rows = self._take_json(
+            self._lib.mds_executions_for_run(self._h, run.encode())) or []
+        for r in rows:
+            if r.get("cache_key") == "":
+                r["cache_key"] = None
+            if r.get("end") == 0.0:
+                r["end"] = None
+        return rows
+
+    def lineage(self, digest: str) -> dict[str, Any] | None:
+        return self._take_json(self._lib.mds_lineage(self._h,
+                                                     digest.encode()))
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.mds_destroy(h)
+
+
+def make_store(path: str = ":memory:", prefer_native: bool = True):
+    """Native C++ store when the toolchain allows, sqlite twin otherwise."""
+    if prefer_native:
+        try:
+            return NativeMetadataStore(path)
+        except Exception:
+            pass
+    return MetadataStore(path)

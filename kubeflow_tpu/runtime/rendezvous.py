@@ -1,0 +1,209 @@
+"""Rendezvous/heartbeat coordinator — server manager + worker client.
+
+The control-plane side of SURVEY.md §5.8: the JAXJob controller runs one
+coordinator per job gang; worker processes REGISTER (barrier until the full
+world is present, learning rank 0's address for jax.distributed), then
+HEARTBEAT; the controller polls STATUS to spot dead ranks and trigger the
+§5.3 checkpoint-restore restart path.
+
+`CoordinatorServer` prefers the C++ poll-loop service (native/src/
+rendezvous.cpp); `PyCoordinatorServer` is the pure-Python twin speaking the
+same wire protocol (fallback + differential oracle).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+# -- servers -----------------------------------------------------------------
+
+class CoordinatorServer:
+    """C++ coordinator lifecycle (start/port/stop) via ctypes."""
+
+    def __init__(self, port: int = 0, hb_ttl_s: float = 10.0):
+        import ctypes
+
+        from kubeflow_tpu.native import library
+
+        self._lib = library("rendezvous")
+        self._lib.rdv_start.restype = ctypes.c_void_p
+        self._lib.rdv_start.argtypes = [ctypes.c_int, ctypes.c_double]
+        self._lib.rdv_port.restype = ctypes.c_int
+        self._lib.rdv_port.argtypes = [ctypes.c_void_p]
+        self._lib.rdv_stop.argtypes = [ctypes.c_void_p]
+        self._h = self._lib.rdv_start(port, hb_ttl_s * 1000.0)
+        if not self._h:
+            raise OSError(f"rendezvous bind failed on port {port}")
+        self.port = int(self._lib.rdv_port(self._h))
+        self.address = f"127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.rdv_stop(h)
+
+    def __del__(self):
+        self.stop()
+
+
+@dataclass
+class _PyWorker:
+    addr: str
+    last_seen: float
+    done: bool = False
+
+
+@dataclass
+class _PyJob:
+    world: int = 0
+    workers: dict[int, _PyWorker] = field(default_factory=dict)
+    barrier: threading.Condition = field(
+        default_factory=lambda: threading.Condition())
+
+
+class PyCoordinatorServer:
+    """Pure-Python twin of the C++ coordinator (same wire protocol)."""
+
+    def __init__(self, port: int = 0, hb_ttl_s: float = 10.0):
+        self._jobs: dict[str, _PyJob] = {}
+        self._lock = threading.Lock()
+        self._ttl = hb_ttl_s
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    reply = outer._handle(raw.decode().strip())
+                    if reply is not None:
+                        self.wfile.write((reply + "\n").encode())
+                        self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", port), Handler)
+        self.port = self._srv.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def _handle(self, line: str) -> str | None:
+        parts = line.split()
+        if not parts:
+            return None
+        cmd = parts[0]
+        if cmd == "REGISTER" and len(parts) >= 5:
+            jname, world, rank, addr = (parts[1], int(parts[2]),
+                                        int(parts[3]), parts[4])
+            with self._lock:
+                job = self._jobs.setdefault(jname, _PyJob())
+                if job.world == 0:
+                    job.world = world
+                bad = (world != job.world or rank < 0 or rank >= job.world or
+                       (rank in job.workers and not job.workers[rank].done))
+                if bad:
+                    return "CONFLICT"
+                job.workers[rank] = _PyWorker(addr, time.monotonic())
+            with job.barrier:
+                job.barrier.notify_all()
+                while len(job.workers) < job.world:
+                    job.barrier.wait(timeout=0.5)
+            return "OK " + job.workers[min(job.workers)].addr
+        if cmd == "HEARTBEAT" and len(parts) >= 3:
+            with self._lock:
+                job = self._jobs.get(parts[1])
+                rank = int(parts[2])
+                if job is None or rank not in job.workers:
+                    return "UNKNOWN"
+                job.workers[rank].last_seen = time.monotonic()
+                return "OK"
+        if cmd == "STATUS" and len(parts) >= 2:
+            with self._lock:
+                job = self._jobs.get(parts[1])
+                if job is None:
+                    return "STATUS 0/0 "
+                cutoff = time.monotonic() - self._ttl
+                live = {r: w for r, w in job.workers.items() if not w.done}
+                dead = ",".join(str(r) for r, w in sorted(live.items())
+                                if w.last_seen < cutoff)
+                return f"STATUS {len(live)}/{job.world} {dead}"
+        if cmd == "DONE" and len(parts) >= 3:
+            with self._lock:
+                job = self._jobs.get(parts[1])
+                rank = int(parts[2])
+                if job and rank in job.workers:
+                    job.workers[rank].done = True
+            return "OK"
+        return "ERR"
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def make_coordinator(port: int = 0, hb_ttl_s: float = 10.0,
+                     prefer_native: bool = True):
+    if prefer_native:
+        try:
+            return CoordinatorServer(port, hb_ttl_s)
+        except Exception:
+            pass
+    return PyCoordinatorServer(port, hb_ttl_s)
+
+
+# -- client ------------------------------------------------------------------
+
+class RendezvousClient:
+    """Worker-side client; one persistent connection per worker process."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._file = self._sock.makefile("rw")
+
+    def _rpc(self, line: str) -> str:
+        self._file.write(line + "\n")
+        self._file.flush()
+        reply = self._file.readline().strip()
+        if not reply:
+            raise ConnectionError("coordinator closed connection")
+        return reply
+
+    def register(self, job: str, world: int, rank: int,
+                 addr: str) -> str:
+        """Barrier until the gang is complete; returns rank 0's address
+        (the jax.distributed coordinator_address)."""
+        reply = self._rpc(f"REGISTER {job} {world} {rank} {addr}")
+        if reply.startswith("OK "):
+            return reply[3:]
+        raise RuntimeError(f"rendezvous register failed: {reply}")
+
+    def heartbeat(self, job: str, rank: int) -> bool:
+        return self._rpc(f"HEARTBEAT {job} {rank}") == "OK"
+
+    def status(self, job: str) -> tuple[int, int, list[int]]:
+        """(present, world, dead_ranks) — the failure-detector query."""
+        reply = self._rpc(f"STATUS {job}")
+        if not reply.startswith("STATUS "):
+            raise RuntimeError(f"bad status reply: {reply}")
+        body = reply[len("STATUS "):]
+        frac, _, dead = body.partition(" ")
+        present, world = frac.split("/")
+        dead_ranks = [int(d) for d in dead.split(",") if d]
+        return int(present), int(world), dead_ranks
+
+    def done(self, job: str, rank: int) -> None:
+        self._rpc(f"DONE {job} {rank}")
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,364 @@
+// Native ML-Metadata store — the MLMD analog (SURVEY.md §2.6: MLMD is the
+// one C++ service Kubeflow Pipelines always deploys; this is its TPU-native
+// equivalent). Same conceptual model as pipelines/metadata.py (the sqlite
+// twin): Artifacts, Executions, Events (I/O edges), Contexts, plus the KFP
+// cache-server query (latest COMPLETE execution by cache key).
+//
+// Storage: an append-only, tab-escaped write-ahead log replayed at open —
+// the environment has no sqlite/MySQL dev libs, and a WAL + in-memory index
+// is exactly what a single-node metadata service needs (crash-safe via
+// append+flush, deterministic IDs via replay order).
+//
+// Query results cross the C ABI as malloc'd JSON (caller frees with
+// mds_free); the Python binding json.loads them.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ArtifactRec {
+  std::string uri, digest, type;
+};
+struct EventRec {
+  int64_t exec_id, artifact_id;
+  std::string dir, name;
+};
+struct ExecRec {
+  std::string run, task, component, cache_key, state;
+  double start = 0, end = 0;
+};
+struct ContextRec {
+  std::string name, type;
+};
+
+struct Store {
+  std::mutex mu;
+  std::vector<ArtifactRec> artifacts;                 // id = index + 1
+  std::unordered_map<std::string, int64_t> art_by_digest;
+  std::vector<ExecRec> execs;                         // id = index + 1
+  std::vector<EventRec> events;
+  std::vector<ContextRec> contexts;                   // id = index + 1
+  std::unordered_map<std::string, int64_t> ctx_by_name;
+  std::vector<std::pair<int64_t, int64_t>> associations;  // (ctx, exec)
+  FILE* log = nullptr;
+};
+
+std::string esc(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '\t') out += "\\t";
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+std::string unesc(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '\\' && i + 1 < s.size()) {
+      char n = s[++i];
+      out += n == 't' ? '\t' : n == 'n' ? '\n' : n;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+std::vector<std::string> split_fields(const std::string& line) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (size_t i = 0; i < line.size(); ++i) {
+    if (line[i] == '\\' && i + 1 < line.size()) {
+      cur += line[i];
+      cur += line[++i];
+    } else if (line[i] == '\t') {
+      out.push_back(unesc(cur));
+      cur.clear();
+    } else {
+      cur += line[i];
+    }
+  }
+  out.push_back(unesc(cur));
+  return out;
+}
+
+std::string jesc(const std::string& s) {
+  std::string out;
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+char* dup_cstr(const std::string& s) {
+  char* p = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(p, s.c_str(), s.size() + 1);
+  return p;
+}
+
+void append_log(Store* st, const std::string& line) {
+  if (st->log) {
+    std::fputs(line.c_str(), st->log);
+    std::fputc('\n', st->log);
+    std::fflush(st->log);
+  }
+}
+
+// Mutation appliers shared by the live path and log replay.
+int64_t apply_context(Store* st, const std::string& name,
+                      const std::string& type) {
+  auto it = st->ctx_by_name.find(name);
+  if (it != st->ctx_by_name.end()) return it->second;
+  st->contexts.push_back({name, type});
+  int64_t id = static_cast<int64_t>(st->contexts.size());
+  st->ctx_by_name[name] = id;
+  return id;
+}
+
+int64_t apply_artifact(Store* st, const std::string& uri,
+                       const std::string& digest, const std::string& type) {
+  auto it = st->art_by_digest.find(digest);
+  if (it != st->art_by_digest.end()) return it->second;
+  st->artifacts.push_back({uri, digest, type});
+  int64_t id = static_cast<int64_t>(st->artifacts.size());
+  st->art_by_digest[digest] = id;
+  return id;
+}
+
+int64_t apply_execution(Store* st, const std::string& run,
+                        const std::string& task, const std::string& comp,
+                        const std::string& cache_key, double start) {
+  st->execs.push_back({run, task, comp, cache_key, "RUNNING", start, 0});
+  int64_t id = static_cast<int64_t>(st->execs.size());
+  auto it = st->ctx_by_name.find(run);
+  if (it != st->ctx_by_name.end())
+    st->associations.emplace_back(it->second, id);
+  return id;
+}
+
+void replay(Store* st, const char* path) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) return;
+  std::string line;
+  int ch;
+  while ((ch = std::fgetc(f)) != EOF) {
+    if (ch != '\n') {
+      line += static_cast<char>(ch);
+      continue;
+    }
+    auto fields = split_fields(line);
+    line.clear();
+    if (fields.empty()) continue;
+    const std::string& op = fields[0];
+    if (op == "C" && fields.size() >= 3) {
+      apply_context(st, fields[1], fields[2]);
+    } else if (op == "A" && fields.size() >= 4) {
+      apply_artifact(st, fields[1], fields[2], fields[3]);
+    } else if (op == "X" && fields.size() >= 6) {
+      apply_execution(st, fields[1], fields[2], fields[3], fields[4],
+                      std::atof(fields[5].c_str()));
+    } else if (op == "E" && fields.size() >= 5) {
+      st->events.push_back({std::atoll(fields[1].c_str()),
+                            std::atoll(fields[2].c_str()), fields[3],
+                            fields[4]});
+    } else if (op == "F" && fields.size() >= 4) {
+      int64_t id = std::atoll(fields[1].c_str());
+      if (id >= 1 && id <= static_cast<int64_t>(st->execs.size())) {
+        st->execs[id - 1].state = fields[2];
+        st->execs[id - 1].end = std::atof(fields[3].c_str());
+      }
+    }
+  }
+  std::fclose(f);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mds_create(const char* path) {
+  auto* st = new Store();
+  if (path && *path) {
+    replay(st, path);
+    st->log = std::fopen(path, "a");
+    if (!st->log) {
+      delete st;
+      return nullptr;
+    }
+  }
+  return st;
+}
+
+void mds_destroy(void* h) {
+  auto* st = static_cast<Store*>(h);
+  if (st && st->log) std::fclose(st->log);
+  delete st;
+}
+
+void mds_free(char* p) { std::free(p); }
+
+int64_t mds_get_or_create_context(void* h, const char* name,
+                                  const char* type) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  size_t before = st->contexts.size();
+  int64_t id = apply_context(st, name, type);
+  if (st->contexts.size() != before)
+    append_log(st, "C\t" + esc(name) + "\t" + esc(type));
+  return id;
+}
+
+int64_t mds_create_execution(void* h, const char* run, const char* task,
+                             const char* component, const char* cache_key,
+                             double start) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  std::string ck = cache_key ? cache_key : "";
+  int64_t id = apply_execution(st, run, task, component, ck, start);
+  char buf[64];
+  snprintf(buf, sizeof buf, "%.6f", start);
+  append_log(st, "X\t" + esc(run) + "\t" + esc(task) + "\t" +
+                 esc(component) + "\t" + esc(ck) + "\t" + buf);
+  return id;
+}
+
+// Records an artifact (deduped by digest) and an I/O edge. dir: "INPUT" or
+// "OUTPUT". Returns the artifact id.
+int64_t mds_record_io(void* h, int64_t exec_id, const char* name,
+                      const char* uri, const char* digest, const char* dir,
+                      const char* type) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  size_t before = st->artifacts.size();
+  int64_t aid = apply_artifact(st, uri, digest, type);
+  if (st->artifacts.size() != before)
+    append_log(st, "A\t" + esc(uri) + "\t" + esc(digest) + "\t" + esc(type));
+  st->events.push_back({exec_id, aid, dir, name});
+  append_log(st, "E\t" + std::to_string(exec_id) + "\t" +
+                 std::to_string(aid) + "\t" + esc(dir) + "\t" + esc(name));
+  return aid;
+}
+
+int32_t mds_finish_execution(void* h, int64_t exec_id, const char* state,
+                             double end) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  if (exec_id < 1 || exec_id > static_cast<int64_t>(st->execs.size()))
+    return -1;
+  st->execs[exec_id - 1].state = state;
+  st->execs[exec_id - 1].end = end;
+  char buf[64];
+  snprintf(buf, sizeof buf, "%.6f", end);
+  append_log(st, "F\t" + std::to_string(exec_id) + "\t" + esc(state) + "\t" +
+                 buf);
+  return 0;
+}
+
+// JSON {"name": {"uri":..., "digest":...}} of the latest COMPLETE execution
+// with this cache key; nullptr if none.
+char* mds_cached_outputs(void* h, const char* cache_key) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  // empty key = "no cache key" (sqlite NULL semantics): never matches
+  if (!cache_key || !*cache_key) return nullptr;
+  int64_t best = -1;
+  for (int64_t i = static_cast<int64_t>(st->execs.size()); i >= 1; --i) {
+    const ExecRec& e = st->execs[i - 1];
+    if (e.cache_key == cache_key && e.state == "COMPLETE") {
+      best = i;
+      break;
+    }
+  }
+  if (best < 0) return nullptr;
+  std::string out = "{";
+  bool first = true;
+  for (const EventRec& ev : st->events) {
+    if (ev.exec_id != best || ev.dir != "OUTPUT") continue;
+    const ArtifactRec& a = st->artifacts[ev.artifact_id - 1];
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + jesc(ev.name) + "\":{\"uri\":\"" + jesc(a.uri) +
+           "\",\"digest\":\"" + jesc(a.digest) + "\"}";
+  }
+  out += "}";
+  return dup_cstr(out);
+}
+
+// JSON array of executions for a run, in id order.
+char* mds_executions_for_run(void* h, const char* run) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  std::string out = "[";
+  bool first = true;
+  for (size_t i = 0; i < st->execs.size(); ++i) {
+    const ExecRec& e = st->execs[i];
+    if (e.run != run) continue;
+    char nums[96];
+    snprintf(nums, sizeof nums, "\"start\":%.6f,\"end\":%.6f", e.start,
+             e.end);
+    if (!first) out += ",";
+    first = false;
+    out += "{\"id\":" + std::to_string(i + 1) + ",\"task\":\"" +
+           jesc(e.task) + "\",\"component\":\"" + jesc(e.component) +
+           "\",\"cache_key\":\"" + jesc(e.cache_key) + "\",\"state\":\"" +
+           jesc(e.state) + "\"," + nums + "}";
+  }
+  out += "]";
+  return dup_cstr(out);
+}
+
+// JSON {"run":..,"task":..,"inputs":{name:digest}} for the latest execution
+// that OUTPUT an artifact with this digest; nullptr if none.
+char* mds_lineage(void* h, const char* digest) {
+  auto* st = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(st->mu);
+  auto it = st->art_by_digest.find(digest);
+  if (it == st->art_by_digest.end()) return nullptr;
+  int64_t aid = it->second, best_exec = -1;
+  for (const EventRec& ev : st->events)
+    if (ev.artifact_id == aid && ev.dir == "OUTPUT" &&
+        ev.exec_id > best_exec)
+      best_exec = ev.exec_id;
+  if (best_exec < 0) return nullptr;
+  const ExecRec& e = st->execs[best_exec - 1];
+  std::string out = "{\"run\":\"" + jesc(e.run) + "\",\"task\":\"" +
+                    jesc(e.task) + "\",\"inputs\":{";
+  bool first = true;
+  for (const EventRec& ev : st->events) {
+    if (ev.exec_id != best_exec || ev.dir != "INPUT") continue;
+    const ArtifactRec& a = st->artifacts[ev.artifact_id - 1];
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + jesc(ev.name) + "\":\"" + jesc(a.digest) + "\"";
+  }
+  out += "}}";
+  return dup_cstr(out);
+}
+
+}  // extern "C"

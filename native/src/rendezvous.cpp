@@ -1,0 +1,264 @@
+// Rendezvous + heartbeat coordinator — the TPU-native replacement for the
+// reference's rendezvous machinery (SURVEY.md §5.8): where MPIJob runs
+// mpirun over an ssh hostfile and PyTorchJob points workers at a c10d
+// TCPStore, JAXJob workers hit this service to (a) barrier until all
+// processes of a gang are present, (b) learn the jax.distributed
+// coordinator address (rank 0's), and (c) heartbeat so the controller can
+// detect dead workers and trigger checkpoint-restore restarts (§5.3).
+//
+// Single poll() event loop on a background thread (the box has 1 core —
+// thread-per-connection would be waste), line-oriented TCP protocol:
+//
+//   REGISTER <job> <world> <rank> <addr>\n   -> (blocks) OK <rank0_addr>\n
+//                                            |  CONFLICT\n (rank taken /
+//                                               world mismatch)
+//   HEARTBEAT <job> <rank>\n                -> OK\n | UNKNOWN\n
+//   STATUS <job>\n          -> STATUS <present>/<world> <dead_csv>\n
+//   DONE <job> <rank>\n                     -> OK\n
+//
+// Exposed via C ABI: rdv_start/rdv_port/rdv_stop.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+double now_ms() {
+  return std::chrono::duration<double, std::milli>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Worker {
+  std::string addr;
+  double last_seen_ms = 0;
+  bool done = false;
+};
+
+struct Job {
+  int world = 0;
+  std::map<int, Worker> workers;           // rank -> worker
+  std::vector<std::pair<int, int>> waiting;  // (fd, rank) blocked REGISTERs
+};
+
+struct Conn {
+  int fd;
+  std::string inbuf;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  double hb_ttl_ms;
+  std::atomic<bool> stop{false};
+  std::thread loop;
+  std::map<std::string, Job> jobs;
+  std::vector<Conn> conns;
+};
+
+void send_line(int fd, const std::string& line) {
+  std::string out = line + "\n";
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t n = ::send(fd, out.data() + off, out.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return;
+    off += static_cast<size_t>(n);
+  }
+}
+
+std::vector<std::string> split_ws(const std::string& s) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : s) {
+    if (c == ' ' || c == '\r') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
+// Returns true if the connection should stay registered in the poll set
+// with no pending blocked reply (REGISTER may defer its reply).
+void handle_line(Server* srv, int fd, const std::string& line) {
+  auto fields = split_ws(line);
+  if (fields.empty()) return;
+  const std::string& cmd = fields[0];
+
+  if (cmd == "REGISTER" && fields.size() >= 5) {
+    const std::string& jname = fields[1];
+    int world = std::atoi(fields[2].c_str());
+    int rank = std::atoi(fields[3].c_str());
+    const std::string& addr = fields[4];
+    Job& job = srv->jobs[jname];
+    if (job.world == 0) job.world = world;
+    if (world != job.world || rank < 0 || rank >= job.world ||
+        (job.workers.count(rank) && !job.workers[rank].done)) {
+      send_line(fd, "CONFLICT");
+      return;
+    }
+    job.workers[rank] = {addr, now_ms(), false};
+    job.waiting.emplace_back(fd, rank);
+    if (static_cast<int>(job.workers.size()) >= job.world) {
+      const std::string& head = job.workers.begin()->second.addr;  // rank 0
+      for (auto& [wfd, wrank] : job.waiting)
+        send_line(wfd, "OK " + head);
+      job.waiting.clear();
+    }
+    return;
+  }
+  if (cmd == "HEARTBEAT" && fields.size() >= 3) {
+    auto it = srv->jobs.find(fields[1]);
+    int rank = std::atoi(fields[2].c_str());
+    if (it == srv->jobs.end() || !it->second.workers.count(rank)) {
+      send_line(fd, "UNKNOWN");
+    } else {
+      it->second.workers[rank].last_seen_ms = now_ms();
+      send_line(fd, "OK");
+    }
+    return;
+  }
+  if (cmd == "STATUS" && fields.size() >= 2) {
+    auto it = srv->jobs.find(fields[1]);
+    if (it == srv->jobs.end()) {
+      send_line(fd, "STATUS 0/0 ");
+      return;
+    }
+    Job& job = it->second;
+    double cutoff = now_ms() - srv->hb_ttl_ms;
+    std::string dead;
+    int present = 0;
+    for (auto& [rank, w] : job.workers) {
+      if (w.done) continue;
+      present++;
+      if (w.last_seen_ms < cutoff) {
+        if (!dead.empty()) dead += ",";
+        dead += std::to_string(rank);
+      }
+    }
+    send_line(fd, "STATUS " + std::to_string(present) + "/" +
+                      std::to_string(job.world) + " " + dead);
+    return;
+  }
+  if (cmd == "DONE" && fields.size() >= 3) {
+    auto it = srv->jobs.find(fields[1]);
+    int rank = std::atoi(fields[2].c_str());
+    if (it != srv->jobs.end() && it->second.workers.count(rank))
+      it->second.workers[rank].done = true;
+    send_line(fd, "OK");
+    return;
+  }
+  send_line(fd, "ERR");
+}
+
+void drop_fd(Server* srv, int fd) {
+  for (auto& [jname, job] : srv->jobs) {
+    auto& w = job.waiting;
+    w.erase(std::remove_if(w.begin(), w.end(),
+                           [fd](auto& p) { return p.first == fd; }),
+            w.end());
+  }
+  ::close(fd);
+}
+
+void event_loop(Server* srv) {
+  while (!srv->stop.load()) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({srv->listen_fd, POLLIN, 0});
+    for (const Conn& c : srv->conns) pfds.push_back({c.fd, POLLIN, 0});
+    int n = ::poll(pfds.data(), pfds.size(), 100);
+    if (n <= 0) continue;
+
+    if (pfds[0].revents & POLLIN) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd >= 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        srv->conns.push_back({fd, ""});
+      }
+    }
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      int fd = pfds[i].fd;
+      auto it = std::find_if(srv->conns.begin(), srv->conns.end(),
+                             [fd](const Conn& c) { return c.fd == fd; });
+      if (it == srv->conns.end()) continue;
+      char buf[4096];
+      ssize_t r = ::recv(fd, buf, sizeof buf, 0);
+      if (r <= 0) {
+        drop_fd(srv, fd);
+        srv->conns.erase(it);
+        continue;
+      }
+      it->inbuf.append(buf, static_cast<size_t>(r));
+      size_t pos;
+      while ((pos = it->inbuf.find('\n')) != std::string::npos) {
+        std::string line = it->inbuf.substr(0, pos);
+        it->inbuf.erase(0, pos + 1);
+        handle_line(srv, fd, line);
+      }
+    }
+  }
+  for (const Conn& c : srv->conns) ::close(c.fd);
+  srv->conns.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the coordinator on 127.0.0.1:<port> (0 = ephemeral). Returns a
+// handle, or nullptr on bind failure. hb_ttl_ms: heartbeat staleness cutoff
+// used by STATUS dead-rank reporting.
+void* rdv_start(int port, double hb_ttl_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof addr;
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->hb_ttl_ms = hb_ttl_ms > 0 ? hb_ttl_ms : 10000.0;
+  srv->loop = std::thread(event_loop, srv);
+  return srv;
+}
+
+int rdv_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void rdv_stop(void* h) {
+  auto* srv = static_cast<Server*>(h);
+  srv->stop.store(true);
+  if (srv->loop.joinable()) srv->loop.join();
+  ::close(srv->listen_fd);
+  delete srv;
+}
+
+}  // extern "C"

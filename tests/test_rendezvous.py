@@ -1,0 +1,99 @@
+"""Rendezvous/heartbeat coordinator: gang barrier, failure detection,
+C++ and Python servers behaving identically."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.runtime.rendezvous import (CoordinatorServer,
+                                             PyCoordinatorServer,
+                                             RendezvousClient)
+
+SERVERS = [CoordinatorServer, PyCoordinatorServer]
+
+
+@pytest.mark.parametrize("server_cls", SERVERS)
+def test_gang_barrier(server_cls):
+    srv = server_cls(hb_ttl_s=5.0)
+    results = {}
+
+    def worker(rank):
+        c = RendezvousClient(srv.address)
+        head = c.register("job-a", 3, rank, f"10.0.0.{rank}:5000")
+        results[rank] = head
+        assert c.heartbeat("job-a", rank)
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    # stagger starts: the barrier must hold early arrivals until rank 2 shows
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=10)
+    # every worker learned rank 0's address
+    assert results == {r: "10.0.0.0:5000" for r in range(3)}
+
+    c = RendezvousClient(srv.address)
+    present, world, dead = c.status("job-a")
+    assert (present, world, dead) == (3, 3, [])
+    c.close()
+    srv.stop()
+
+
+@pytest.mark.parametrize("server_cls", SERVERS)
+def test_dead_rank_detection(server_cls):
+    srv = server_cls(hb_ttl_s=0.3)
+    c0 = RendezvousClient(srv.address)
+    c1 = RendezvousClient(srv.address)
+    t = threading.Thread(
+        target=lambda: c1.register("job-b", 2, 1, "h1:1"))
+    t.start()
+    c0.register("job-b", 2, 0, "h0:1")
+    t.join(timeout=5)
+
+    # rank 0 keeps heartbeating; rank 1 goes silent
+    deadline = time.monotonic() + 0.6
+    while time.monotonic() < deadline:
+        c0.heartbeat("job-b", 0)
+        time.sleep(0.05)
+    present, world, dead = c0.status("job-b")
+    assert (present, world) == (2, 2)
+    assert dead == [1]
+
+    # DONE deregisters: rank 1 stops counting as dead
+    c0.done("job-b", 1)
+    present, _, dead = c0.status("job-b")
+    assert present == 1 and dead == []
+    c0.close()
+    c1.close()
+    srv.stop()
+
+
+@pytest.mark.parametrize("server_cls", SERVERS)
+def test_register_conflict(server_cls):
+    srv = server_cls()
+    c0 = RendezvousClient(srv.address)
+    c1 = RendezvousClient(srv.address)
+    c2 = RendezvousClient(srv.address)
+    t = threading.Thread(target=lambda: c0.register("job-c", 2, 0, "h0:1"))
+    t.start()
+    time.sleep(0.1)
+    with pytest.raises(RuntimeError, match="CONFLICT"):
+        c1.register("job-c", 2, 0, "h0b:1")  # rank 0 already taken
+    c2.register("job-c", 2, 1, "h1:1")
+    t.join(timeout=5)
+    for c in (c0, c1, c2):
+        c.close()
+    srv.stop()
+
+
+@pytest.mark.parametrize("server_cls", SERVERS)
+def test_status_unknown_job(server_cls):
+    srv = server_cls()
+    c = RendezvousClient(srv.address)
+    assert c.status("nope") == (0, 0, [])
+    assert not c.heartbeat("nope", 0)
+    c.close()
+    srv.stop()
