@@ -12,6 +12,11 @@ Spec shape (PyTorchJob-compatible skeleton):
         cleanPodPolicy: Running      # Running | All | None
         schedulingPolicy: {minAvailable: N}   # gang size, default Σreplicas
       successPolicy: Worker0         # Worker0 | AllWorkers
+      elasticPolicy:                 # PyTorch-elastic analog (§5.3)
+        minReplicas: 2               # gang shrinks toward this on worker loss
+        maxReplicas: 4
+      failureDetection:              # heartbeat liveness (rendezvous svc)
+        heartbeatTtlSeconds: 10      # silent rank -> pod Failed(HeartbeatLost)
       replicaSpecs:
         worker:
           replicas: 4
@@ -47,6 +52,7 @@ JOB_KIND = "JAXJob"
 JOB_NAME_LABEL = "kubeflow-tpu/job-name"
 REPLICA_TYPE_LABEL = "kubeflow-tpu/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow-tpu/replica-index"
+GANG_EPOCH_LABEL = "kubeflow-tpu/gang-epoch"
 
 _BASE_PORT = 47000
 
@@ -73,17 +79,49 @@ def validate_job(job: dict[str, Any]) -> list[str]:
     sp = spec.get("successPolicy", "Worker0")
     if sp not in ("Worker0", "AllWorkers"):
         errs.append(f"successPolicy invalid: {sp}")
+    elastic = spec.get("elasticPolicy")
+    if elastic is not None:
+        lo = elastic.get("minReplicas", 1)
+        hi = elastic.get("maxReplicas",
+                         replicas.get("worker", {}).get("replicas", 1))
+        if "worker" not in replicas:
+            errs.append("elasticPolicy requires a worker replica type")
+        if lo < 1 or hi < lo:
+            errs.append("elasticPolicy needs 1 <= minReplicas <= maxReplicas")
+    fd = spec.get("failureDetection")
+    if fd is not None and fd.get("heartbeatTtlSeconds", 1) <= 0:
+        errs.append("failureDetection.heartbeatTtlSeconds must be > 0")
     return errs
 
 
-def _replica_order(spec: dict[str, Any]) -> list[tuple[str, int]]:
+def _effective_replicas(job: dict[str, Any]) -> dict[str, int]:
+    """Replica counts after elastic resizing (status.elasticReplicas is the
+    current gang size the controller converged on — the PyTorch-elastic
+    min/max analog, SURVEY.md §5.3)."""
+    spec = job["spec"]
+    elastic = spec.get("elasticPolicy")
+    out: dict[str, int] = {}
+    for rtype, rspec in spec.get("replicaSpecs", {}).items():
+        n = rspec.get("replicas", 1)
+        if elastic and rtype == "worker":
+            n = min(n, elastic.get("maxReplicas", n))
+            n = job["status"].get("elasticReplicas", n)
+        out[rtype] = n
+    return out
+
+
+def _replica_order(spec: dict[str, Any],
+                   replicas: dict[str, int] | None = None
+                   ) -> list[tuple[str, int]]:
     """Deterministic global process ranking: replica types sorted (master
     first if present), then index — the genClusterSpec ordering analog."""
     order: list[tuple[str, int]] = []
     rtypes = sorted(spec.get("replicaSpecs", {}),
                     key=lambda t: (t != "master", t))
     for rtype in rtypes:
-        for i in range(spec["replicaSpecs"][rtype].get("replicas", 1)):
+        n = (replicas or {}).get(
+            rtype, spec["replicaSpecs"][rtype].get("replicas", 1))
+        for i in range(n):
             order.append((rtype, i))
     return order
 
@@ -91,6 +129,11 @@ def _replica_order(spec: dict[str, Any]) -> list[tuple[str, int]]:
 class JAXJobController(Controller):
     kind = JOB_KIND
     owned_kinds = ("Pod",)
+
+    def __init__(self, cluster):
+        super().__init__(cluster)
+        # per-job rendezvous/heartbeat coordinators (failureDetection jobs)
+        self._coordinators: dict[str, Any] = {}
 
     def reconcile(self, job: dict[str, Any]) -> float | None:
         name = job["metadata"]["name"]
@@ -124,13 +167,32 @@ class JAXJobController(Controller):
         if not self.expectations.satisfied(key):
             return 0.1  # stale view: only observe, don't create/delete
 
-        self._ensure_pod_group(job)
+        eff = _effective_replicas(job)
+        epoch = status.get("gangEpoch", 0)
+        self._ensure_pod_group(job, eff)
+        self._detect_heartbeat_failures(job, eff, epoch)
         pods = self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name})
+
+        # stale-gang cleanup: pods from a previous gang epoch (pre-resize
+        # world) or beyond the current replica count are torn down wholesale
+        # — their KTPU_NUM_PROCESSES/rank env no longer describes the gang
+        live_pods = []
+        for p in pods:
+            labels = p["metadata"]["labels"]
+            stale = (int(labels.get(GANG_EPOCH_LABEL, "0")) != epoch
+                     or int(labels[REPLICA_INDEX_LABEL])
+                     >= eff.get(labels[REPLICA_TYPE_LABEL], 0))
+            if stale:
+                self.expectations.expect_deletions(key, 1)
+                self.store.try_delete("Pod", p["metadata"]["name"], ns)
+            else:
+                live_pods.append(p)
+        pods = live_pods
         by_slot = {(p["metadata"]["labels"][REPLICA_TYPE_LABEL],
                     int(p["metadata"]["labels"][REPLICA_INDEX_LABEL])): p
                    for p in pods}
 
-        order = _replica_order(job["spec"])
+        order = _replica_order(job["spec"], eff)
         total_restarts = status.get("restartCount", 0)
         backoff_limit = run_policy.get("backoffLimit")  # unset = unlimited
         restarted = False
@@ -139,7 +201,7 @@ class JAXJobController(Controller):
         for rank, (rtype, idx) in enumerate(order):
             pod = by_slot.get((rtype, idx))
             if pod is None:
-                self._create_pod(job, rtype, idx, rank, len(order))
+                self._create_pod(job, rtype, idx, rank, len(order), epoch)
                 continue
             phase = pod["status"].get("phase")
             if phase == "Failed":
@@ -165,6 +227,23 @@ class JAXJobController(Controller):
                     return None
                 total_restarts += 1
                 restarted = True
+                elastic = job["spec"].get("elasticPolicy")
+                if (elastic and rtype == "worker"
+                        and eff["worker"] > elastic.get("minReplicas", 1)):
+                    # elastic shrink: restart the WHOLE gang one worker
+                    # smaller (checkpoint-restore carries the training state,
+                    # §5.3) instead of waiting for the lost capacity
+                    self.store.mutate(JOB_KIND, name, lambda o: (
+                        o["status"].update(
+                            elasticReplicas=eff["worker"] - 1,
+                            gangEpoch=epoch + 1,
+                            restartCount=total_restarts),
+                        set_condition(o["status"],
+                                      JobConditionType.RESTARTING,
+                                      "ElasticResize",
+                                      f"gang shrinking to "
+                                      f"{eff['worker'] - 1} workers")), ns)
+                    return 0.1  # next pass tears down the stale epoch
                 self.expectations.expect_deletions(key, 1)
                 self.store.try_delete("Pod", pod["metadata"]["name"], ns)
             elif phase == "Succeeded" and job["spec"]["replicaSpecs"][rtype].get(
@@ -211,7 +290,14 @@ class JAXJobController(Controller):
                               "JobSucceeded", "success policy satisfied")),
                 ns)
             self._clean_pods(job)
+            self._stop_coordinator(key)
             return 0.0
+        if job["spec"].get("failureDetection"):
+            # poll cadence for the heartbeat detector even when nothing else
+            # changes — dead ranks only surface via this reconcile path
+            ttl = job["spec"]["failureDetection"].get(
+                "heartbeatTtlSeconds", 10.0)
+            return min(max(ttl / 2.0, 0.1), 2.0)
         return 0.5 if restarted else None
 
     # -- helpers --------------------------------------------------------------
@@ -219,10 +305,9 @@ class JAXJobController(Controller):
     def _check_success(self, job, replica_statuses, order) -> bool:
         policy = job["spec"].get("successPolicy", "Worker0")
         if policy == "AllWorkers":
-            return all(
-                rs["succeeded"] >= job["spec"]["replicaSpecs"][rt].get(
-                    "replicas", 1)
-                for rt, rs in replica_statuses.items())
+            eff = _effective_replicas(job)
+            return all(rs["succeeded"] >= eff.get(rt, 1)
+                       for rt, rs in replica_statuses.items())
         rtype0, idx0 = order[0]
         pod = self.store.try_get(
             "Pod", self._pod_name(job, rtype0, idx0),
@@ -237,7 +322,7 @@ class JAXJobController(Controller):
         return _BASE_PORT + int(job["metadata"]["uid"][:4], 16) % 8000
 
     def _create_pod(self, job, rtype: str, idx: int, rank: int,
-                    world: int) -> None:
+                    world: int, epoch: int = 0) -> None:
         ns = job["metadata"].get("namespace", "default")
         name = job["metadata"]["name"]
         rspec = job["spec"]["replicaSpecs"][rtype]
@@ -250,16 +335,24 @@ class JAXJobController(Controller):
             "KTPU_REPLICA_INDEX": str(idx),
             "KTPU_NUM_PROCESSES": str(world),
             "KTPU_PROCESS_ID": str(rank),
+            "KTPU_GANG_EPOCH": str(epoch),
             "KTPU_COORDINATOR_ADDRESS":
                 f"127.0.0.1:{self._coordinator_port(job)}",
         })
+        rdv = self._coordinators.get(self.key_of(job))
+        if rdv is not None:
+            fd = job["spec"].get("failureDetection", {})
+            env["KTPU_RENDEZVOUS_ADDRESS"] = rdv.address
+            env["KTPU_HEARTBEAT_TTL"] = str(
+                fd.get("heartbeatTtlSeconds", 10.0))
         pod = new_resource(
             "Pod", self._pod_name(job, rtype, idx),
             spec={**{k: v for k, v in template.items() if k != "env"},
                   "env": env},
             namespace=ns,
             labels={JOB_NAME_LABEL: name, REPLICA_TYPE_LABEL: rtype,
-                    REPLICA_INDEX_LABEL: str(idx), GROUP_LABEL: name},
+                    REPLICA_INDEX_LABEL: str(idx), GROUP_LABEL: name,
+                    GANG_EPOCH_LABEL: str(epoch)},
             owner=job)
         self.expectations.expect_creations(self.key_of(job), 1)
         try:
@@ -267,15 +360,21 @@ class JAXJobController(Controller):
         except AlreadyExistsError:
             self.expectations.creation_observed(self.key_of(job))
 
-    def _ensure_pod_group(self, job) -> None:
+    def _ensure_pod_group(self, job, eff: dict[str, int] | None = None) -> None:
         ns = job["metadata"].get("namespace", "default")
         name = job["metadata"]["name"]
-        if self.store.try_get("PodGroup", name, ns) is not None:
-            return
-        total = sum(r.get("replicas", 1)
-                    for r in job["spec"]["replicaSpecs"].values())
+        total = sum((eff or _effective_replicas(job)).values())
         min_avail = (job["spec"].get("runPolicy", {})
                      .get("schedulingPolicy", {}).get("minAvailable", total))
+        existing = self.store.try_get("PodGroup", name, ns)
+        if existing is not None:
+            if existing["spec"].get("minAvailable") != min_avail:
+                # elastic resize shrank the gang — the all-or-nothing
+                # threshold must follow or the scheduler waits forever
+                self.store.mutate(
+                    "PodGroup", name,
+                    lambda o: o["spec"].update(minAvailable=min_avail), ns)
+            return
         pg = new_resource("PodGroup", name,
                           spec={"minAvailable": min_avail},
                           namespace=ns, owner=job)
@@ -284,8 +383,74 @@ class JAXJobController(Controller):
         except AlreadyExistsError:
             pass
 
+    # -- heartbeat failure detection (§5.3) -----------------------------------
+
+    def _detect_heartbeat_failures(self, job, eff: dict[str, int],
+                                   epoch: int) -> None:
+        """Run a rendezvous/heartbeat coordinator for jobs that ask for it
+        and convert dead ranks into pod failures, which then flow through
+        the ordinary restart/elastic machinery."""
+        fd = job["spec"].get("failureDetection")
+        if not fd:
+            return
+        key = self.key_of(job)
+        srv = self._coordinators.get(key)
+        if srv is None:
+            from kubeflow_tpu.runtime.rendezvous import make_coordinator
+
+            srv = make_coordinator(
+                hb_ttl_s=fd.get("heartbeatTtlSeconds", 10.0))
+            self._coordinators[key] = srv
+            return  # pods created after this pass get the address injected
+        try:
+            from kubeflow_tpu.runtime.rendezvous import RendezvousClient
+
+            client = RendezvousClient(srv.address, timeout=2.0)
+            try:
+                _, _, dead = client.status(self._gang_id(job, epoch))
+            finally:
+                client.close()
+        except OSError:
+            return
+        ns = job["metadata"].get("namespace", "default")
+        order = _replica_order(job["spec"], eff)
+        for rank in dead:
+            if rank >= len(order):
+                continue
+            rtype, idx = order[rank]
+            pod = self.store.try_get("Pod", self._pod_name(job, rtype, idx),
+                                     ns)
+            if pod is None or pod["status"].get("phase") != "Running":
+                continue
+            self.store.mutate(
+                "Pod", pod["metadata"]["name"],
+                lambda o: o["status"].update(
+                    phase="Failed", exitCode=137, reason="HeartbeatLost"),
+                ns)
+
+    @staticmethod
+    def _gang_id(job, epoch: int) -> str:
+        """Rendezvous job id: one barrier group per gang epoch, so a resized
+        gang re-rendezvouses cleanly instead of colliding with dead ranks."""
+        return f"{job['metadata']['name']}/{epoch}"
+
+    def _stop_coordinator(self, key: str) -> None:
+        srv = self._coordinators.pop(key, None)
+        if srv is not None:
+            srv.stop()
+
+    def reconcile_deleted(self, name: str, namespace: str):
+        self._stop_coordinator(f"{namespace}/{name}")
+        return None
+
+    def stop(self) -> None:
+        super().stop()
+        for key in list(self._coordinators):
+            self._stop_coordinator(key)
+
     def _fail(self, job, reason: str, message: str) -> None:
         ns = job["metadata"].get("namespace", "default")
+        self._stop_coordinator(self.key_of(job))
         try:
             self.store.mutate(JOB_KIND, job["metadata"]["name"], lambda o: (
                 o["status"].update(completionTime=time.time()),

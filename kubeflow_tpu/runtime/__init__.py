@@ -1,7 +1,17 @@
-"""L0 runtime: worker bootstrap + distributed rendezvous."""
+"""L0 runtime: worker bootstrap, rendezvous/heartbeat, failure detection."""
 
 from kubeflow_tpu.runtime.bootstrap import (  # noqa: F401
     WorkerContext,
     worker_context,
     initialize_distributed,
+)
+from kubeflow_tpu.runtime.heartbeat import (  # noqa: F401
+    HeartbeatReporter,
+    start_heartbeat,
+)
+from kubeflow_tpu.runtime.rendezvous import (  # noqa: F401
+    CoordinatorServer,
+    PyCoordinatorServer,
+    RendezvousClient,
+    make_coordinator,
 )

@@ -1,0 +1,66 @@
+"""Worker-side heartbeat reporter — the liveness half of §5.3 failure
+detection. Workers whose JAXJob sets spec.failureDetection get
+KTPU_RENDEZVOUS_ADDRESS/KTPU_HEARTBEAT_TTL injected; calling
+``start_heartbeat(env)`` registers the rank with the job-gang barrier and
+keeps a daemon thread heartbeating at ttl/3. A worker that stops (crash,
+hang, SIGKILL) goes silent and the controller converts the dead rank into a
+pod failure → restart/elastic path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class HeartbeatReporter:
+    def __init__(self, address: str, job_gang: str, world: int, rank: int,
+                 worker_addr: str, ttl_s: float):
+        from kubeflow_tpu.runtime.rendezvous import RendezvousClient
+
+        self._client = RendezvousClient(address, timeout=max(ttl_s * 4, 30.0))
+        self.job_gang = job_gang
+        self.rank = rank
+        self.head_address = self._client.register(job_gang, world, rank,
+                                                  worker_addr)
+        self._interval = max(ttl_s / 3.0, 0.02)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"heartbeat-{job_gang}-{rank}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._client.heartbeat(self.job_gang, self.rank)
+            except OSError:
+                return  # coordinator gone (job finishing) — nothing to report
+
+    def stop(self, mark_done: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        try:
+            if mark_done:
+                self._client.done(self.job_gang, self.rank)
+        except OSError:
+            pass
+        self._client.close()
+
+
+def start_heartbeat(env: dict[str, str] | None = None
+                    ) -> HeartbeatReporter | None:
+    """Start heartbeating from the injected KTPU_* env; None when the job
+    has no failureDetection configured (env key absent)."""
+    e = os.environ if env is None else env
+    address = e.get("KTPU_RENDEZVOUS_ADDRESS")
+    if not address:
+        return None
+    gang = f"{e.get('KTPU_JOB_NAME', 'local')}/{e.get('KTPU_GANG_EPOCH', '0')}"
+    return HeartbeatReporter(
+        address,
+        gang,
+        int(e.get("KTPU_NUM_PROCESSES", "1")),
+        int(e.get("KTPU_PROCESS_ID", "0")),
+        e.get("KTPU_COORDINATOR_ADDRESS", "127.0.0.1:0"),
+        float(e.get("KTPU_HEARTBEAT_TTL", "10")),
+    )

@@ -1,0 +1,178 @@
+"""Elastic recovery + failure detection (SURVEY.md §5.3): elastic gang
+resize on worker loss, heartbeat-based dead-rank detection, and the
+checkpoint-restore fault-injection e2e (kill a trainer mid-run, assert it
+resumes from the checkpoint with no training regression)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.control import (Cluster, JAXJobController, new_resource,
+                                  worker_target)
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.control.jobs import validate_job
+from kubeflow_tpu.runtime.heartbeat import start_heartbeat
+
+_lock = threading.Lock()
+_worlds_seen: dict[str, list[int]] = {}
+
+
+@worker_target("elastic_flaky")
+def _elastic_flaky(env, cancel):
+    """Rank 0 fails (retryably) whenever the gang is larger than 3."""
+    world = int(env["KTPU_NUM_PROCESSES"])
+    with _lock:
+        _worlds_seen.setdefault(env["KTPU_JOB_NAME"], []).append(world)
+    if world > 3 and env["KTPU_PROCESS_ID"] == "0":
+        raise SystemExit(137)
+
+
+@worker_target("hb_silent_rank1")
+def _hb_silent_rank1(env, cancel):
+    """Rank 1 registers then goes silent (hangs); others heartbeat and wait
+    for cancellation (they'd run forever — the detector must break the job)."""
+    hb = start_heartbeat(env)
+    assert hb is not None
+    try:
+        if env["KTPU_PROCESS_ID"] == "1":
+            hb.stop(mark_done=False)  # silent: no heartbeat, no DONE
+            cancel.wait(30)
+            raise SystemExit(1)  # killed by job teardown
+        cancel.wait(30)
+    finally:
+        if env["KTPU_PROCESS_ID"] != "1":
+            hb.stop()
+
+
+@worker_target("ckpt_trainer")
+def _ckpt_trainer(env, cancel):
+    """Trains MNIST with checkpointing; first attempt dies (SIGKILL-style)
+    after 6 steps. The restart must resume from the step-5 checkpoint."""
+    from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+    from kubeflow_tpu.training import data as data_lib
+    from kubeflow_tpu.training.checkpoint import restore_or_init
+
+    ckpt_dir = env["CKPT_DIR"]
+    marker = os.path.join(ckpt_dir, "attempt")
+    attempt = int(open(marker).read()) if os.path.exists(marker) else 0
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with open(marker, "w") as f:
+        f.write(str(attempt + 1))
+
+    trainer = Trainer(TrainerConfig(
+        model="mnist_cnn", batch_size=8,
+        optimizer=OptimizerConfig(warmup_steps=1, total_steps=10),
+        checkpoint_dir=ckpt_dir, checkpoint_every=5, log_every=100))
+    trainer.metrics.echo = False
+    state, _resumed = restore_or_init(trainer, ckpt_dir)
+    start_step = int(state["step"])
+    with open(os.path.join(ckpt_dir, f"start_step_{attempt}"), "w") as f:
+        f.write(str(start_step))
+
+    data = data_lib.for_model("mnist_cnn", trainer.model_cfg, 8)
+    if attempt == 0:
+        trainer.train(data, 6, state=state)  # saves step-5 checkpoint
+        raise SystemExit(137)                # then "the host dies"
+    trainer.train(data, 10 - start_step, state=state)
+
+
+def _job(name, *, target, replicas=1, restart="ExitCode", extra_spec=None,
+         env=None):
+    spec = {
+        "runPolicy": {"backoffLimit": 4, "cleanPodPolicy": "None"},
+        "successPolicy": "AllWorkers",
+        "replicaSpecs": {"worker": {
+            "replicas": replicas, "restartPolicy": restart,
+            "template": {"backend": "thread", "target": target,
+                         "env": env or {}, "resources": {"cpu": 1}},
+        }},
+    }
+    spec.update(extra_spec or {})
+    return new_resource("JAXJob", name, spec=spec)
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    with c:
+        yield c
+
+
+def wait_done(cluster, name, timeout=40):
+    return cluster.wait_for("JAXJob", name,
+                            lambda o: is_finished(o["status"]),
+                            timeout=timeout)
+
+
+def test_validate_elastic_and_heartbeat_specs():
+    bad = _job("v", target="ok",
+               extra_spec={"elasticPolicy": {"minReplicas": 5,
+                                             "maxReplicas": 2}})
+    assert any("minReplicas" in e for e in validate_job(bad))
+    bad2 = _job("v2", target="ok",
+                extra_spec={"failureDetection": {"heartbeatTtlSeconds": 0}})
+    assert any("heartbeatTtlSeconds" in e for e in validate_job(bad2))
+
+
+def test_elastic_shrink_to_viable_world(cluster):
+    """4-worker gang whose rank 0 dies while world > 3: the controller must
+    shrink the gang (4 -> 3) and the job completes at the smaller world."""
+    cluster.store.create(_job(
+        "elastic-1", target="elastic_flaky", replicas=4,
+        extra_spec={"elasticPolicy": {"minReplicas": 2, "maxReplicas": 4}}))
+    job = wait_done(cluster, "elastic-1")
+    assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+    assert job["status"]["elasticReplicas"] == 3
+    assert job["status"]["gangEpoch"] == 1
+    assert job["status"]["restartCount"] == 1
+    # the successful epoch ran at world 3 for every worker (first epoch was 4)
+    assert _worlds_seen["elastic-1"].count(3) == 3
+    # pods of the final epoch carry the resized world
+    pods = cluster.store.list(
+        "Pod", labels={"kubeflow-tpu/job-name": "elastic-1"})
+    assert pods and all(
+        p["spec"]["env"]["KTPU_NUM_PROCESSES"] == "3" for p in pods)
+
+
+def test_heartbeat_detects_dead_rank(cluster):
+    """Rank 1 hangs without heartbeating: the controller marks its pod
+    Failed (HeartbeatLost); restartPolicy Never then fails the job —
+    without detection this job would sit at activeDeadline forever."""
+    cluster.store.create(_job(
+        "hb-1", target="hb_silent_rank1", replicas=2, restart="Never",
+        extra_spec={"failureDetection": {"heartbeatTtlSeconds": 0.4}}))
+    job = wait_done(cluster, "hb-1", timeout=40)
+    cond = [c for c in job["status"]["conditions"]
+            if c["type"] == JobConditionType.FAILED][0]
+    assert cond["reason"] == "PodFailed"
+    pods = cluster.store.list("Pod",
+                              labels={"kubeflow-tpu/job-name": "hb-1"})
+    reasons = {p["status"].get("reason") for p in pods}
+    assert "HeartbeatLost" in reasons
+
+
+def test_fault_injection_checkpoint_resume(cluster, tmp_path):
+    """The §5.3 contract: kill the trainer mid-run, the restarted pod must
+    resume from the checkpoint (start_step == 5), finish the remaining
+    steps, and end with the full 10-step final checkpoint."""
+    ckpt = str(tmp_path / "ckpt")
+    cluster.store.create(_job("ft-1", target="ckpt_trainer",
+                              env={"CKPT_DIR": ckpt}))
+    job = wait_done(cluster, "ft-1", timeout=120)
+    assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+    assert job["status"]["restartCount"] == 1
+    # attempt 0 started fresh and died after step 6 (its final checkpoint
+    # committed before the injected kill); attempt 1 resumed from step 6
+    assert open(os.path.join(ckpt, "start_step_0")).read() == "0"
+    assert open(os.path.join(ckpt, "start_step_1")).read() == "6"
+    from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 10
+    mgr.close()
