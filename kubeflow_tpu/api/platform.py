@@ -49,6 +49,22 @@ class Platform:
             PipelineRunController, root=os.path.join(self.root, "pipelines"))
         self.cluster.add(ScheduledRunController)
         self.serving = self.cluster.add(InferenceServiceController)
+        # L2 platform glue (SURVEY.md §2.1): multi-tenancy, workspaces,
+        # PodDefault admission
+        from kubeflow_tpu.platform import (NotebookController,
+                                           ProfileController,
+                                           PVCViewerController,
+                                           TensorboardController,
+                                           VolumeController,
+                                           install_poddefault_webhook)
+
+        install_poddefault_webhook(self.cluster.store)
+        self.cluster.add(ProfileController)
+        self.cluster.add(NotebookController)
+        self.cluster.add(TensorboardController)
+        self.volumes = self.cluster.add(
+            VolumeController, data_root=os.path.join(self.root, "volumes"))
+        self.cluster.add(PVCViewerController)
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------

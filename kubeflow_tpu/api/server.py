@@ -106,6 +106,25 @@ class ApiServer:
                   and len(parts) == 3):
                 h._send(200,
                         {"logs": self.platform.job_logs(parts[2], parts[1])})
+            elif method == "GET" and parts[:1] == ["dashboard"]:
+                from kubeflow_tpu.platform import dashboard as _dash
+
+                user = q.get("user", [None])[0]
+                h._send(200, _dash(self.platform.store, user))
+            elif (method == "GET" and parts[:1] == ["tensorboards"]
+                  and len(parts) == 4 and parts[3] == "scalars"):
+                from kubeflow_tpu.platform import read_scalars
+
+                tb = self.platform.get("Tensorboard", parts[2], parts[1])
+                tag = q.get("tag", [None])[0]
+                h._send(200, {"scalars": read_scalars(
+                    tb["spec"].get("logdir", ""), tag)})
+            elif (method == "POST" and parts[:1] == ["notebooks"]
+                  and len(parts) == 4 and parts[3] == "touch"):
+                from kubeflow_tpu.platform import touch
+
+                touch(self.platform.store, parts[2], parts[1])
+                h._send(200, {"touched": True})
             else:
                 h._error(404, "NotFound", f"no route {method} {h.path}")
         except NotFoundError as e:

@@ -42,6 +42,15 @@ VALIDATORS: dict[str, Callable[[dict[str, Any]], list[str]]] = {
 }
 
 
+def _register_platform_validators() -> None:
+    from kubeflow_tpu.platform.profiles import validate_profile
+
+    VALIDATORS["Profile"] = validate_profile
+
+
+_register_platform_validators()
+
+
 def validate(obj: dict[str, Any]) -> list[str]:
     """Admission-validation for any resource; unknown kinds pass (CRDs the
     platform doesn't reconcile are storable, as on a real apiserver)."""

@@ -110,6 +110,15 @@ class ResourceStore:
         self._objects: dict[tuple[str, str, str], dict[str, Any]] = {}
         self._rv = itertools.count(1)
         self._watches: list[_Watch] = []
+        self._mutating_hooks: dict[str, list] = {}
+
+    # -- admission (mutating-webhook analog, SURVEY.md §2.1) ------------------
+
+    def add_mutating_hook(self, kind: str, fn) -> None:
+        """Register fn(store, obj) -> None, called on every create() of
+        `kind` before the object is persisted — the admission-webhook
+        injection point (PodDefaults etc.). Hooks mutate obj in place."""
+        self._mutating_hooks.setdefault(kind, []).append(fn)
 
     # -- CRUD ----------------------------------------------------------------
 
@@ -119,6 +128,8 @@ class ResourceStore:
             if key in self._objects:
                 raise AlreadyExistsError(f"{key} already exists")
             obj = copy.deepcopy(obj)
+            for hook in self._mutating_hooks.get(obj["kind"], ()):
+                hook(self, obj)
             meta = obj["metadata"]
             meta.setdefault("namespace", "default")
             meta["uid"] = uuid.uuid4().hex

@@ -1,0 +1,39 @@
+"""Multi-tenancy & platform glue — the kubeflow/kubeflow L2 components
+(SURVEY.md §2.1) rebuilt over the TPU-native control plane: Profiles/KFAM,
+PodDefault admission, notebooks, tensorboards, volumes, dashboard."""
+
+from kubeflow_tpu.platform.dashboard import (  # noqa: F401
+    dashboard,
+    namespace_summary,
+)
+from kubeflow_tpu.platform.notebooks import (  # noqa: F401
+    NOTEBOOK_KIND,
+    NotebookController,
+    touch,
+)
+from kubeflow_tpu.platform.poddefaults import (  # noqa: F401
+    PODDEFAULT_KIND,
+    apply_poddefaults_on_pod,
+    install_poddefault_webhook,
+)
+from kubeflow_tpu.platform.profiles import (  # noqa: F401
+    BINDING_KIND,
+    PROFILE_KIND,
+    ProfileController,
+    bindings_for_user,
+    can_access,
+    ensure_binding,
+    remove_binding,
+    validate_profile,
+)
+from kubeflow_tpu.platform.tensorboards import (  # noqa: F401
+    TENSORBOARD_KIND,
+    TensorboardController,
+    read_scalars,
+)
+from kubeflow_tpu.platform.volumes import (  # noqa: F401
+    VIEWER_KIND,
+    VOLUME_KIND,
+    PVCViewerController,
+    VolumeController,
+)

@@ -1,0 +1,63 @@
+"""Central-dashboard aggregation (SURVEY.md §2.1, ⊘ components/
+centraldashboard): the namespace-scoped activity summary the dashboard
+shell renders — counts + recent items for every resource family, filtered
+by the caller's KFAM bindings.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeflow_tpu.platform.profiles import bindings_for_user
+
+_FAMILIES = {
+    "jobs": "JAXJob",
+    "experiments": "Experiment",
+    "runs": "PipelineRun",
+    "inferenceServices": "InferenceService",
+    "notebooks": "Notebook",
+    "tensorboards": "Tensorboard",
+    "volumes": "Volume",
+}
+
+
+def _phase_of(obj: dict[str, Any]) -> str:
+    status = obj.get("status", {})
+    if "phase" in status:
+        return str(status["phase"])
+    conds = status.get("conditions") or []
+    return str(conds[-1]["type"]) if conds else "Pending"
+
+
+def namespace_summary(store, namespace: str) -> dict[str, Any]:
+    out: dict[str, Any] = {"namespace": namespace}
+    for family, kind in _FAMILIES.items():
+        objs = store.list(kind, namespace)
+        phases: dict[str, int] = {}
+        for o in objs:
+            p = _phase_of(o)
+            phases[p] = phases.get(p, 0) + 1
+        recent = sorted(objs, key=lambda o: o["metadata"]
+                        .get("creationTimestamp", 0), reverse=True)[:5]
+        out[family] = {
+            "total": len(objs),
+            "phases": phases,
+            "recent": [{"name": o["metadata"]["name"],
+                        "phase": _phase_of(o)} for o in recent],
+        }
+    return out
+
+
+def dashboard(store, user: str | None = None) -> dict[str, Any]:
+    """Whole-platform view: all namespaces (or just the user's, per KFAM)."""
+    if user is not None:
+        namespaces = sorted({b["metadata"]["namespace"]
+                             for b in bindings_for_user(store, user)})
+    else:
+        namespaces = sorted({o["metadata"]["name"]
+                             for o in store.list("Namespace", None)})
+        if not namespaces:
+            namespaces = ["default"]
+    return {"user": user,
+            "namespaces": [namespace_summary(store, ns)
+                           for ns in namespaces]}

@@ -1,0 +1,111 @@
+"""Notebook controller (SURVEY.md §2.1, ⊘ components/notebook-controller
+`NotebookReconciler.Reconcile` + jupyter-web-app spawner semantics).
+
+A Notebook materializes a long-running workspace pod. Upstream semantics
+kept: the `kubeflow-resource-stopped` annotation scales the workspace to
+zero without deleting the Notebook (the dashboard's stop button), removing
+it brings the pod back; idle culling sets that annotation automatically
+after `spec.idleTimeoutSeconds` of no activity (activity = the workspace
+touching its `lastActivity` status, here updated on pod restarts and
+via the API's touch endpoint).
+
+    kind: Notebook
+    spec:
+      template: {backend: thread, target: notebook_workspace, ...}
+      resources: {cpu: 1}
+      idleTimeoutSeconds: 3600       # optional auto-cull
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.executor import worker_target
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+
+NOTEBOOK_KIND = "Notebook"
+STOPPED_ANNOTATION = "kubeflow-resource-stopped"
+NOTEBOOK_LABEL = "kubeflow-tpu/notebook-name"
+
+
+@worker_target("notebook_workspace")
+def _workspace(env, cancel):
+    """Default workspace process: parks until culled/stopped (the stand-in
+    for a jupyter server; real images would use backend: subprocess)."""
+    cancel.wait()
+
+
+class NotebookController(Controller):
+    kind = NOTEBOOK_KIND
+    owned_kinds = ("Pod",)
+    resync_period = 1.0
+
+    def reconcile(self, nb: dict[str, Any]) -> float | None:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"].get("namespace", "default")
+        spec = nb.get("spec", {})
+        stopped = STOPPED_ANNOTATION in nb["metadata"].get("annotations", {})
+        pod_name = f"{name}-workspace-0"
+        pod = self.store.try_get("Pod", pod_name, ns)
+
+        # idle culling: no activity since the timeout -> set the stopped
+        # annotation (exactly what upstream's culler does)
+        idle = spec.get("idleTimeoutSeconds")
+        if idle and not stopped:
+            last = nb["status"].get("lastActivity",
+                                    nb["metadata"].get("creationTimestamp", 0))
+            if time.time() - last > idle:
+                self.store.mutate(NOTEBOOK_KIND, name, lambda o: (
+                    o["metadata"].setdefault("annotations", {}).update(
+                        {STOPPED_ANNOTATION: "true"}),
+                    o["status"].update(phase="Culled")), ns)
+                return 0.0
+
+        if stopped:
+            if pod is not None:
+                self.store.try_delete("Pod", pod_name, ns)
+            if nb["status"].get("phase") not in ("Stopped", "Culled"):
+                self.store.mutate(NOTEBOOK_KIND, name, lambda o: o["status"]
+                                  .update(phase="Stopped"), ns)
+            return None
+
+        if pod is None:
+            template = dict(spec.get("template") or
+                            {"backend": "thread",
+                             "target": "notebook_workspace"})
+            template.setdefault("resources", spec.get("resources",
+                                                      {"cpu": 1}))
+            env = dict(template.get("env", {}))
+            env["KTPU_NOTEBOOK_NAME"] = name
+            template["env"] = env
+            try:
+                self.store.create(new_resource(
+                    "Pod", pod_name, spec=template, namespace=ns,
+                    labels={NOTEBOOK_LABEL: name}, owner=nb))
+            except AlreadyExistsError:
+                pass
+            self.store.mutate(NOTEBOOK_KIND, name, lambda o: o["status"]
+                              .update(phase="Starting",
+                                      lastActivity=time.time()), ns)
+            return 0.2
+
+        phase = pod["status"].get("phase", "Pending")
+        want = {"Running": "Ready", "Pending": "Starting",
+                "Scheduled": "Starting"}.get(phase, phase)
+        if nb["status"].get("phase") != want:
+            self.store.mutate(NOTEBOOK_KIND, name, lambda o: o["status"]
+                              .update(phase=want), ns)
+        if idle:
+            return min(float(idle) / 2.0, 5.0)
+        return None
+
+
+def touch(store, name: str, namespace: str = "default") -> None:
+    """Record workspace activity (API layer calls this on user traffic) —
+    resets the idle-culling clock and restarts a culled notebook."""
+    def _update(o):
+        o["status"]["lastActivity"] = time.time()
+        o["metadata"].get("annotations", {}).pop(STOPPED_ANNOTATION, None)
+    store.mutate(NOTEBOOK_KIND, name, _update, namespace)

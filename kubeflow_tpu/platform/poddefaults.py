@@ -1,0 +1,56 @@
+"""PodDefault admission — the kubeflow admission-webhook analog (SURVEY.md
+§2.1, ⊘ components/admission-webhook `mutatePods`/`applyPodDefaultsOnPod`).
+
+A PodDefault declares env/labels/annotations to inject into pods whose
+labels match its selector, namespace-scoped:
+
+    kind: PodDefault
+    spec:
+      selector: {matchLabels: {team: vision}}
+      env: {HF_HOME: /cache/hf}
+      labels: {...}
+      annotations: {...}
+
+Where upstream runs a mutating webhook in the API-server admission chain,
+here the injection point is the ResourceStore's mutating-hook chain — same
+semantics (applied at create, before the executor ever sees the pod).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+PODDEFAULT_KIND = "PodDefault"
+
+
+def matches(selector: dict[str, Any], labels: dict[str, str]) -> bool:
+    wanted = (selector or {}).get("matchLabels", {})
+    return all(labels.get(k) == v for k, v in wanted.items())
+
+
+def apply_poddefaults_on_pod(store, pod: dict[str, Any]) -> None:
+    """The mutating hook: merge every matching PodDefault into the pod.
+    Pod-level values win over injected defaults (same as upstream, which
+    only adds what's absent)."""
+    ns = pod["metadata"].get("namespace", "default")
+    labels = pod["metadata"].get("labels", {})
+    for pd in store.list(PODDEFAULT_KIND, ns):
+        spec = pd.get("spec", {})
+        if not matches(spec.get("selector"), labels):
+            continue
+        env = pod["spec"].setdefault("env", {})
+        for k, v in spec.get("env", {}).items():
+            env.setdefault(k, v)
+        for k, v in spec.get("labels", {}).items():
+            pod["metadata"]["labels"].setdefault(k, v)
+        ann = pod["metadata"].setdefault("annotations", {})
+        for k, v in spec.get("annotations", {}).items():
+            ann.setdefault(k, v)
+        ann.setdefault("kubeflow-tpu/poddefaults", "")
+        applied = [a for a in ann["kubeflow-tpu/poddefaults"].split(",") if a]
+        applied.append(pd["metadata"]["name"])
+        ann["kubeflow-tpu/poddefaults"] = ",".join(applied)
+
+
+def install_poddefault_webhook(store) -> None:
+    store.add_mutating_hook("Pod", apply_poddefaults_on_pod)

@@ -1,0 +1,123 @@
+"""Profile controller + KFAM access management (SURVEY.md §2.1, ⊘
+components/profile-controller `ProfileReconciler.Reconcile` and
+components/access-management `CreateBinding`/`QueryClusterAdmin`).
+
+A Profile is the multi-tenancy unit: it materializes a Namespace, a
+ResourceQuota, and an owner AccessBinding. KFAM's contributor flow is the
+AccessBinding CRUD + `can_access` query the dashboard/API layer consults.
+
+    kind: Profile
+    spec:
+      owner: alice@example.com
+      resourceQuota: {tpu: 8, cpu: 16}    # optional hard caps
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+
+PROFILE_KIND = "Profile"
+BINDING_KIND = "AccessBinding"
+ROLE_OWNER = "owner"
+ROLE_CONTRIBUTOR = "contributor"
+
+
+def validate_profile(profile: dict[str, Any]) -> list[str]:
+    errs = []
+    if not profile.get("spec", {}).get("owner"):
+        errs.append("spec.owner is required")
+    quota = profile.get("spec", {}).get("resourceQuota", {})
+    for k, v in quota.items():
+        if not isinstance(v, (int, float)) or v < 0:
+            errs.append(f"resourceQuota.{k} must be a non-negative number")
+    return errs
+
+
+class ProfileController(Controller):
+    kind = PROFILE_KIND
+    owned_kinds = ()
+
+    def reconcile(self, profile: dict[str, Any]) -> float | None:
+        name = profile["metadata"]["name"]
+        errs = validate_profile(profile)
+        if errs:
+            self.store.mutate(PROFILE_KIND, name, lambda o: o["status"].update(
+                phase="Invalid", message="; ".join(errs)),
+                profile["metadata"].get("namespace", "default"))
+            return None
+
+        # Profiles are cluster-scoped objects living in "default"; the
+        # namespace they materialize carries the profile's name.
+        if self.store.try_get("Namespace", name, "default") is None:
+            try:
+                self.store.create(new_resource(
+                    "Namespace", name, spec={}, namespace="default",
+                    owner=profile))
+            except AlreadyExistsError:
+                pass
+        quota = profile["spec"].get("resourceQuota")
+        if quota and self.store.try_get("ResourceQuota", name, name) is None:
+            try:
+                self.store.create(new_resource(
+                    "ResourceQuota", name, spec={"hard": dict(quota)},
+                    namespace=name, owner=profile))
+            except AlreadyExistsError:
+                pass
+        ensure_binding(self.store, profile["spec"]["owner"], name, ROLE_OWNER,
+                       owner=profile)
+        if profile["status"].get("phase") != "Ready":
+            self.store.mutate(
+                PROFILE_KIND, name,
+                lambda o: o["status"].update(phase="Ready"),
+                profile["metadata"].get("namespace", "default"))
+        return None
+
+
+# -- KFAM (access management) -------------------------------------------------
+
+def _binding_name(user: str, namespace: str) -> str:
+    return f"{user.replace('@', '-').replace('.', '-')}-{namespace}"
+
+
+def ensure_binding(store, user: str, namespace: str,
+                   role: str = ROLE_CONTRIBUTOR, owner=None) -> dict[str, Any]:
+    """CreateBinding analog: grant `user` access to a profile namespace.
+    Bindings are stored in the profile's namespace, like upstream's
+    RoleBindings."""
+    name = _binding_name(user, namespace)
+    existing = store.try_get(BINDING_KIND, name, namespace)
+    if existing is not None:
+        return existing
+    try:
+        return store.create(new_resource(
+            BINDING_KIND, name,
+            spec={"user": user, "role": role}, namespace=namespace,
+            owner=owner))
+    except AlreadyExistsError:
+        return store.get(BINDING_KIND, name, namespace)
+
+
+def remove_binding(store, user: str, namespace: str) -> bool:
+    name = _binding_name(user, namespace)
+    try:
+        store.delete(BINDING_KIND, name, namespace)
+        return True
+    except Exception:
+        return False
+
+
+def bindings_for_user(store, user: str) -> list[dict[str, Any]]:
+    """QueryClusterAdmin-style: every namespace binding a user holds."""
+    return [b for b in store.list(BINDING_KIND, None)
+            if b["spec"].get("user") == user]
+
+
+def can_access(store, user: str, namespace: str,
+               require_owner: bool = False) -> bool:
+    b = store.try_get(BINDING_KIND, _binding_name(user, namespace), namespace)
+    if b is None:
+        return False
+    return (not require_owner) or b["spec"].get("role") == ROLE_OWNER

@@ -1,0 +1,81 @@
+"""Tensorboard controller (SURVEY.md §2.1, ⊘ components/tensorboard-
+controller `tensorboard_controller.go`): a Tensorboard CR points at a
+training logdir and gets a scalar-serving endpoint.
+
+The TPU-native twist: trainers write structured JSONL metrics
+(training/metrics_writer.py) instead of tfevents, so "serving a logdir" is
+parsing that stream — `read_scalars` is the data source the dashboard/API
+exposes at /tensorboards/{name}/scalars, and the controller's job is
+lifecycle/status (logdir exists -> Ready), not process babysitting.
+
+    kind: Tensorboard
+    spec: {logdir: /path/to/run}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from kubeflow_tpu.control.controller import Controller
+
+TENSORBOARD_KIND = "Tensorboard"
+
+
+def read_scalars(logdir: str, tag: str | None = None
+                 ) -> dict[str, list[tuple[int, float]]]:
+    """Parse JSONL metric streams under logdir into {tag: [(step, value)]}.
+    Accepts both a directory of *.jsonl files and a single file path."""
+    paths: list[str] = []
+    if os.path.isdir(logdir):
+        for fn in sorted(os.listdir(logdir)):
+            if fn.endswith(".jsonl"):
+                paths.append(os.path.join(logdir, fn))
+    elif os.path.exists(logdir):
+        paths.append(logdir)
+    out: dict[str, list[tuple[int, float]]] = {}
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                step = int(rec.get("step", 0))
+                for key, val in rec.items():
+                    if key == "step" or not isinstance(val, (int, float)):
+                        continue
+                    if tag is not None and key != tag:
+                        continue
+                    out.setdefault(key, []).append((step, float(val)))
+    for series in out.values():
+        series.sort(key=lambda p: p[0])
+    return out
+
+
+class TensorboardController(Controller):
+    kind = TENSORBOARD_KIND
+    resync_period = 2.0
+
+    def reconcile(self, tb: dict[str, Any]) -> float | None:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"].get("namespace", "default")
+        logdir = tb.get("spec", {}).get("logdir")
+        if not logdir:
+            self.store.mutate(TENSORBOARD_KIND, name, lambda o: o["status"]
+                              .update(phase="Invalid",
+                                      message="spec.logdir is required"), ns)
+            return None
+        exists = os.path.exists(logdir)
+        scalars = read_scalars(logdir) if exists else {}
+        phase = "Ready" if exists else "WaitingForLogdir"
+        tags = sorted(scalars)
+        points = sum(len(v) for v in scalars.values())
+
+        def write(o):
+            o["status"].update(phase=phase, tags=tags, points=points)
+        if (tb["status"].get("phase") != phase
+                or tb["status"].get("points") != points):
+            self.store.mutate(TENSORBOARD_KIND, name, write, ns)
+        return 2.0 if not exists else 5.0

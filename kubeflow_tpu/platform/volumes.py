@@ -1,0 +1,78 @@
+"""Volumes + PVC viewer (SURVEY.md §2.1, ⊘ crud-web-apps/volumes and
+components/pvcviewer-controller): a Volume is the PVC analog (a managed
+directory under the cluster's data root with a size cap recorded in spec),
+and a PVCViewer exposes a file listing of one volume — the filebrowser-pod
+analog, served from status instead of a per-PVC pod.
+
+    kind: Volume
+    spec: {sizeGi: 10}
+
+    kind: PVCViewer
+    spec: {volume: my-vol}
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from kubeflow_tpu.control.controller import Controller
+
+VOLUME_KIND = "Volume"
+VIEWER_KIND = "PVCViewer"
+
+
+class VolumeController(Controller):
+    kind = VOLUME_KIND
+
+    def __init__(self, cluster, data_root: str | None = None):
+        super().__init__(cluster)
+        self.data_root = data_root or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-volumes")
+
+    def volume_path(self, ns: str, name: str) -> str:
+        return os.path.join(self.data_root, ns, name)
+
+    def reconcile(self, vol: dict[str, Any]) -> float | None:
+        name = vol["metadata"]["name"]
+        ns = vol["metadata"].get("namespace", "default")
+        path = self.volume_path(ns, name)
+        os.makedirs(path, exist_ok=True)
+        if vol["status"].get("phase") != "Bound":
+            self.store.mutate(VOLUME_KIND, name, lambda o: o["status"].update(
+                phase="Bound", path=path), ns)
+        return None
+
+
+class PVCViewerController(Controller):
+    kind = VIEWER_KIND
+    resync_period = 2.0
+
+    def reconcile(self, viewer: dict[str, Any]) -> float | None:
+        name = viewer["metadata"]["name"]
+        ns = viewer["metadata"].get("namespace", "default")
+        vol_name = viewer.get("spec", {}).get("volume")
+        vol = self.store.try_get(VOLUME_KIND, vol_name, ns) if vol_name \
+            else None
+        if vol is None or vol["status"].get("phase") != "Bound":
+            self.store.mutate(VIEWER_KIND, name, lambda o: o["status"].update(
+                phase="WaitingForVolume"), ns)
+            return 1.0
+        root = vol["status"]["path"]
+        files = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            rel = os.path.relpath(dirpath, root)
+            for fn in sorted(filenames):
+                p = os.path.join(dirpath, fn)
+                files.append({
+                    "path": fn if rel == "." else os.path.join(rel, fn),
+                    "sizeBytes": os.path.getsize(p),
+                })
+        files.sort(key=lambda f: f["path"])
+
+        def write(o):
+            o["status"].update(phase="Ready", files=files)
+        if viewer["status"].get("files") != files or \
+                viewer["status"].get("phase") != "Ready":
+            self.store.mutate(VIEWER_KIND, name, write, ns)
+        return 2.0

@@ -295,3 +295,38 @@ class TestCli:
         out = io.StringIO()
         assert cli.main(["get", "JAXJob"], out) == 2
         assert "tpukctl run" in out.getvalue()
+
+
+class TestPlatformRoutes:
+    def test_dashboard_and_tensorboard_routes(self, server, tmp_path):
+        import json as _json
+        import urllib.request
+
+        logdir = tmp_path / "tblogs"
+        logdir.mkdir()
+        with open(logdir / "m.jsonl", "w") as f:
+            f.write(_json.dumps({"step": 1, "loss": 0.5}) + "\n")
+        c = api.ApiClient(server.url)
+        c.apply({"apiVersion": "kubeflow-tpu/v1", "kind": "Tensorboard",
+                 "metadata": {"name": "tb-api"},
+                 "spec": {"logdir": str(logdir)}})
+        c.apply({"apiVersion": "kubeflow-tpu/v1", "kind": "Notebook",
+                 "metadata": {"name": "nb-api"},
+                 "spec": {"resources": {"cpu": 1}}})
+
+        with urllib.request.urlopen(server.url + "/dashboard") as r:
+            dash = _json.loads(r.read())
+        ns = {n["namespace"]: n for n in dash["namespaces"]}
+        assert ns["default"]["tensorboards"]["total"] == 1
+        assert ns["default"]["notebooks"]["total"] == 1
+
+        with urllib.request.urlopen(
+                server.url + "/tensorboards/default/tb-api/scalars") as r:
+            scalars = _json.loads(r.read())["scalars"]
+        assert scalars["loss"] == [[1, 0.5]]
+
+        req = urllib.request.Request(
+            server.url + "/notebooks/default/nb-api/touch", data=b"",
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert _json.loads(r.read())["touched"] is True

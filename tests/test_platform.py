@@ -1,0 +1,203 @@
+"""L2 platform components: Profiles/KFAM, PodDefault admission, notebooks,
+tensorboards, volumes/viewer, dashboard aggregation (SURVEY.md §2.1)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubeflow_tpu.control import (Cluster, JAXJobController, new_resource,
+                                  worker_target)
+from kubeflow_tpu.control.conditions import is_finished
+from kubeflow_tpu.platform import (NotebookController, ProfileController,
+                                   PVCViewerController, TensorboardController,
+                                   VolumeController, bindings_for_user,
+                                   can_access, dashboard,
+                                   install_poddefault_webhook, read_scalars,
+                                   remove_binding, touch)
+
+
+@worker_target("platform_ok")
+def _ok(env, cancel):
+    pass
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(n_devices=8)
+    install_poddefault_webhook(c.store)
+    c.add(JAXJobController)
+    c.add(ProfileController)
+    c.add(NotebookController)
+    c.add(TensorboardController)
+    c.add(VolumeController, data_root=str(tmp_path / "volumes"))
+    c.add(PVCViewerController)
+    with c:
+        yield c
+
+
+def wait(cluster, kind, name, pred, ns="default", timeout=20):
+    return cluster.wait_for(kind, name, pred, ns, timeout=timeout)
+
+
+# -- PodDefault admission -----------------------------------------------------
+
+def test_poddefault_injects_into_matching_job_pods(cluster):
+    cluster.store.create(new_resource("PodDefault", "hf-cache", spec={
+        "selector": {"matchLabels": {"kubeflow-tpu/job-name": "pd-job"}},
+        "env": {"HF_HOME": "/cache/hf", "KTPU_JOB_NAME": "hijack"},
+        "annotations": {"team": "vision"},
+    }))
+    cluster.store.create(new_resource("PodDefault", "unrelated", spec={
+        "selector": {"matchLabels": {"app": "other"}},
+        "env": {"NOPE": "1"},
+    }))
+    cluster.store.create(new_resource("JAXJob", "pd-job", spec={
+        "replicaSpecs": {"worker": {"replicas": 1, "template": {
+            "backend": "thread", "target": "platform_ok",
+            "resources": {"cpu": 1}}}},
+    }))
+    wait(cluster, "JAXJob", "pd-job", lambda o: is_finished(o["status"]))
+    pod = cluster.store.try_get("Pod", "pd-job-worker-0")
+    if pod is None:  # pod may be cleaned; check the env the worker recorded
+        pytest.skip("pod reaped before inspection")
+    env = pod["spec"]["env"]
+    assert env["HF_HOME"] == "/cache/hf"
+    assert "NOPE" not in env
+    # controller-set env wins over the PodDefault
+    assert env["KTPU_JOB_NAME"] == "pd-job"
+    assert pod["metadata"]["annotations"]["team"] == "vision"
+    assert "hf-cache" in pod["metadata"]["annotations"][
+        "kubeflow-tpu/poddefaults"]
+
+
+# -- Profiles / KFAM ----------------------------------------------------------
+
+def test_profile_materializes_namespace_quota_binding(cluster):
+    cluster.store.create(new_resource("Profile", "team-vision", spec={
+        "owner": "alice@corp.com", "resourceQuota": {"tpu": 4}}))
+    wait(cluster, "Profile", "team-vision",
+         lambda o: o["status"].get("phase") == "Ready")
+    assert cluster.store.try_get("Namespace", "team-vision") is not None
+    quota = cluster.store.get("ResourceQuota", "team-vision", "team-vision")
+    assert quota["spec"]["hard"] == {"tpu": 4}
+    assert can_access(cluster.store, "alice@corp.com", "team-vision",
+                      require_owner=True)
+    assert not can_access(cluster.store, "bob@corp.com", "team-vision")
+
+    from kubeflow_tpu.platform import ensure_binding
+    ensure_binding(cluster.store, "bob@corp.com", "team-vision")
+    assert can_access(cluster.store, "bob@corp.com", "team-vision")
+    assert not can_access(cluster.store, "bob@corp.com", "team-vision",
+                          require_owner=True)
+    assert len(bindings_for_user(cluster.store, "bob@corp.com")) == 1
+    assert remove_binding(cluster.store, "bob@corp.com", "team-vision")
+    assert not can_access(cluster.store, "bob@corp.com", "team-vision")
+
+
+def test_invalid_profile_marked(cluster):
+    cluster.store.create(new_resource("Profile", "no-owner", spec={}))
+    prof = wait(cluster, "Profile", "no-owner",
+                lambda o: o["status"].get("phase") == "Invalid")
+    assert "owner" in prof["status"]["message"]
+
+
+# -- Notebooks ----------------------------------------------------------------
+
+def test_notebook_lifecycle_stop_and_restart(cluster):
+    cluster.store.create(new_resource("Notebook", "nb1", spec={
+        "resources": {"cpu": 1}}))
+    wait(cluster, "Notebook", "nb1",
+         lambda o: o["status"].get("phase") == "Ready")
+    assert cluster.store.try_get("Pod", "nb1-workspace-0") is not None
+
+    # stop annotation culls the workspace pod but keeps the Notebook
+    cluster.store.mutate("Notebook", "nb1", lambda o: o["metadata"]
+                         .setdefault("annotations", {})
+                         .update({"kubeflow-resource-stopped": "true"}))
+    wait(cluster, "Notebook", "nb1",
+         lambda o: o["status"].get("phase") == "Stopped")
+    deadline = time.monotonic() + 10
+    while cluster.store.try_get("Pod", "nb1-workspace-0") is not None:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+
+    # touch() clears the annotation -> workspace comes back
+    touch(cluster.store, "nb1")
+    wait(cluster, "Notebook", "nb1",
+         lambda o: o["status"].get("phase") == "Ready")
+
+
+def test_notebook_idle_culling(cluster):
+    cluster.store.create(new_resource("Notebook", "nb2", spec={
+        "idleTimeoutSeconds": 0.5, "resources": {"cpu": 1}}))
+    nb = wait(cluster, "Notebook", "nb2",
+              lambda o: o["status"].get("phase") in ("Stopped", "Culled"),
+              timeout=30)
+    assert "kubeflow-resource-stopped" in nb["metadata"]["annotations"]
+
+
+# -- Tensorboards -------------------------------------------------------------
+
+def test_tensorboard_serves_jsonl_scalars(cluster, tmp_path):
+    logdir = tmp_path / "run1"
+    logdir.mkdir()
+    with open(logdir / "metrics.jsonl", "w") as f:
+        for step in (1, 2, 3):
+            f.write(json.dumps({"step": step, "loss": 1.0 / step,
+                                "note": "text-ignored"}) + "\n")
+    cluster.store.create(new_resource("Tensorboard", "tb1",
+                                      spec={"logdir": str(logdir)}))
+    tb = wait(cluster, "Tensorboard", "tb1",
+              lambda o: o["status"].get("phase") == "Ready")
+    assert tb["status"]["tags"] == ["loss"]
+    assert tb["status"]["points"] == 3
+    scalars = read_scalars(str(logdir))
+    assert scalars["loss"][0] == (1, 1.0)
+
+
+# -- Volumes / PVC viewer -----------------------------------------------------
+
+def test_volume_and_viewer(cluster):
+    cluster.store.create(new_resource("Volume", "vol1",
+                                      spec={"sizeGi": 1}))
+    vol = wait(cluster, "Volume", "vol1",
+               lambda o: o["status"].get("phase") == "Bound")
+    path = vol["status"]["path"]
+    os.makedirs(os.path.join(path, "sub"), exist_ok=True)
+    with open(os.path.join(path, "sub", "a.txt"), "w") as f:
+        f.write("hello")
+
+    cluster.store.create(new_resource("PVCViewer", "view1",
+                                      spec={"volume": "vol1"}))
+    viewer = wait(cluster, "PVCViewer", "view1",
+                  lambda o: o["status"].get("files"))
+    assert viewer["status"]["files"] == [
+        {"path": os.path.join("sub", "a.txt"), "sizeBytes": 5}]
+
+
+# -- Dashboard ----------------------------------------------------------------
+
+def test_dashboard_aggregates_and_filters_by_user(cluster):
+    cluster.store.create(new_resource("Profile", "team-a",
+                                      spec={"owner": "a@x.com"}))
+    cluster.store.create(new_resource("Profile", "team-b",
+                                      spec={"owner": "b@x.com"}))
+    wait(cluster, "Profile", "team-a",
+         lambda o: o["status"].get("phase") == "Ready")
+    wait(cluster, "Profile", "team-b",
+         lambda o: o["status"].get("phase") == "Ready")
+    cluster.store.create(new_resource(
+        "Notebook", "nb-a", spec={"resources": {"cpu": 1}},
+        namespace="team-a"))
+
+    full = dashboard(cluster.store)
+    names = [n["namespace"] for n in full["namespaces"]]
+    assert "team-a" in names and "team-b" in names
+
+    view = dashboard(cluster.store, user="a@x.com")
+    assert [n["namespace"] for n in view["namespaces"]] == ["team-a"]
+    nb_summary = view["namespaces"][0]["notebooks"]
+    assert nb_summary["total"] == 1
+    assert nb_summary["recent"][0]["name"] == "nb-a"
