@@ -19,6 +19,8 @@ Prefill priority keeps TTFT low; decode always re-batches every step
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 from typing import Any, Sequence
 
@@ -37,7 +39,7 @@ class LLMEngine:
     def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
                  max_queue: int = 1024, eos_id: int | None = None,
-                 prefer_native: bool = True):
+                 prefer_native: bool = True, decode_chunk: int = 8):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         self.params = params
@@ -51,51 +53,77 @@ class LLMEngine:
         self.cache = llama.init_cache(cfg, n_slots, max_len)
         self.lengths = jnp.zeros((n_slots,), jnp.int32)
         self.last_tokens = jnp.zeros((n_slots,), jnp.int32)
+        self._host_lengths = np.zeros((n_slots,), np.int64)
+        self.decode_chunk = max(1, decode_chunk)
+        self._max_new: dict[int, int] = {}
 
         self._prompts: dict[int, list[int]] = {}
         self._results: dict[int, list[int]] = {}
         self._submit_t: dict[int, float] = {}
         self._first_token_t: dict[int, float] = {}
         self._done: set[int] = set()
+        self._ttft_window: collections.deque[float] = collections.deque(
+            maxlen=1024)
+        # Guards submit vs. the engine-loop thread: held across
+        # scheduler.submit + request-dict population so scheduler.next()
+        # (also taken under it) can never hand out a prefill whose request
+        # dicts aren't populated yet.
+        self._submit_lock = threading.Lock()
         self._prefill_fns: dict[int, Any] = {}
-        self._decode_fn = jax.jit(self._decode, donate_argnums=(0,))
+        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2, 3))
 
     # -- compiled programs ---------------------------------------------------
+    # params are an explicit argument, never a closure: a closed-over pytree
+    # would be inlined into the HLO as constants (hundreds of MB shipped to
+    # the compiler and frozen into the executable). All slot state (cache,
+    # lengths, last_tokens) lives on device and is updated inside the jitted
+    # programs — the host loop does exactly ONE device->host fetch per
+    # iteration (the new tokens), which is what keeps per-step latency at
+    # dispatch cost instead of several tunnel round-trips.
 
-    def _prefill(self, cache, tokens, slot, prompt_len):
+    def _prefill(self, params, cache, lengths, last_tokens, tokens, slot,
+                 prompt_len):
         """tokens [1, bucket] right-padded; writes KV into `slot`."""
-        logits, ks, vs = llama.prefill(self.params, tokens, self.cfg)
+        logits, ks, vs = llama.prefill(params, tokens, self.cfg)
         bucket = tokens.shape[1]
         k = cache["k"].at[:, slot, :bucket].set(ks[:, 0])
         v = cache["v"].at[:, slot, :bucket].set(vs[:, 0])
         last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1,
                                             keepdims=False)
-        return {"k": k, "v": v}, jnp.argmax(last, -1).astype(jnp.int32)
+        tok = jnp.argmax(last, -1).astype(jnp.int32)
+        return ({"k": k, "v": v}, lengths.at[slot].set(prompt_len),
+                last_tokens.at[slot].set(tok), tok)
 
-    def _decode(self, cache, last_tokens, lengths):
-        logits, cache = llama.decode_step(self.params, last_tokens, cache,
+    def _decode(self, params, cache, lengths, last_tokens, active):
+        logits, cache = llama.decode_step(params, last_tokens, cache,
                                           lengths, self.cfg)
-        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+        toks = jnp.argmax(logits, -1).astype(jnp.int32)
+        lengths = lengths + active.astype(jnp.int32)
+        last_tokens = jnp.where(active, toks, last_tokens)
+        return cache, lengths, last_tokens, toks
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = jax.jit(
-                self._prefill, donate_argnums=(0,))
+                self._prefill, donate_argnums=(1, 2, 3))
         return self._prefill_fns[bucket]
 
     # -- public API ----------------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> int:
-        req_id = self.scheduler.submit(len(prompt), max_new_tokens,
-                                       time.monotonic())
-        self._prompts[req_id] = list(prompt)
-        self._results[req_id] = []
-        self._submit_t[req_id] = time.monotonic()
+        with self._submit_lock:
+            req_id = self.scheduler.submit(len(prompt), max_new_tokens,
+                                           time.monotonic())
+            self._prompts[req_id] = list(prompt)
+            self._results[req_id] = []
+            self._max_new[req_id] = max_new_tokens
+            self._submit_t[req_id] = time.monotonic()
         return req_id
 
     def step(self) -> bool:
         """One engine iteration: a prefill or a batched decode. False = idle."""
-        action = self.scheduler.next()
+        with self._submit_lock:
+            action = self.scheduler.next()
         if action is None:
             return False
         if isinstance(action, PrefillAction):
@@ -116,6 +144,14 @@ class LLMEngine:
             raise KeyError(f"request {req_id} not finished")
         return self._results[req_id]
 
+    def release(self, req_id: int) -> None:
+        """Drop all per-request state. Long-lived servers MUST call this
+        after reading result(), or per-request dicts grow without bound."""
+        self._done.discard(req_id)
+        self._results.pop(req_id, None)
+        self._submit_t.pop(req_id, None)
+        self._first_token_t.pop(req_id, None)
+
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32) -> list[int]:
         rid = self.submit(prompt, max_new_tokens)
@@ -124,9 +160,14 @@ class LLMEngine:
                 raise RuntimeError("engine idle with request outstanding")
         return self.result(rid)
 
+    def ttft_seconds(self, req_id: int) -> float | None:
+        """Submit→first-token latency for one request (None until then)."""
+        if req_id not in self._first_token_t:
+            return None
+        return self._first_token_t[req_id] - self._submit_t[req_id]
+
     def metrics(self) -> dict[str, Any]:
-        ttfts = [self._first_token_t[r] - self._submit_t[r]
-                 for r in self._first_token_t]
+        ttfts = list(self._ttft_window)  # survives release() of old requests
         s = self.scheduler.stats()
         out = {"queued": s.queued, "active": s.active,
                "completed": s.completed, "rejected": s.rejected}
@@ -141,36 +182,73 @@ class LLMEngine:
         prompt = self._prompts[a.req_id]
         tokens = np.zeros((1, a.bucket_len), np.int32)
         tokens[0, :len(prompt)] = prompt
-        self.cache, next_tok = self._prefill_fn(a.bucket_len)(
-            self.cache, jnp.asarray(tokens), a.slot, a.prompt_len)
-        self.lengths = self.lengths.at[a.slot].set(a.prompt_len)
-        self.last_tokens = self.last_tokens.at[a.slot].set(next_tok)
+        self.cache, self.lengths, self.last_tokens, next_tok = \
+            self._prefill_fn(a.bucket_len)(
+                self.params, self.cache, self.lengths, self.last_tokens,
+                jnp.asarray(tokens), a.slot, a.prompt_len)
+        self._host_lengths[a.slot] = a.prompt_len
         self._record_token(a.req_id, a.slot, int(next_tok),
                            first_token=True)
 
     def _do_decode(self) -> None:
+        """Chained decode: dispatch K steps back-to-back WITHOUT fetching
+        between them (device state is self-contained), then drain the K
+        token arrays. JAX's async dispatch overlaps the host<->device
+        round-trip with device compute — on a tunneled/remote device this
+        is the difference between RTT-bound and compute-bound decode.
+
+        K = min remaining tokens across active slots (no overrun), capped
+        by cache headroom and a scheduling-latency bound: new arrivals wait
+        at most K steps for their prefill."""
         slot_req = [self.scheduler.slot_request(s) for s in range(self.n_slots)]
-        self.cache, toks = self._decode_fn(self.cache, self.last_tokens,
-                                           self.lengths)
-        toks_np = np.asarray(toks)
-        new_lengths = np.array(self.lengths)  # writable host copy
-        for slot, req in enumerate(slot_req):
-            if req < 0:
-                continue
-            new_lengths[slot] += 1
-            self._record_token(req, slot, int(toks_np[slot]))
-        self.lengths = jnp.asarray(new_lengths)
-        self.last_tokens = jnp.asarray(toks_np)
+        active = np.array([r >= 0 for r in slot_req], bool)
+        remaining = [self._max_new[r] - len(self._results[r])
+                     for r in slot_req if r >= 0]
+        # k chained steps write KV rows L..L+k-1 for the fullest slot, so
+        # k <= max_len - L keeps every write in bounds
+        headroom = self.max_len - int(
+            max(self._host_lengths[s] for s in range(self.n_slots)
+                if active[s]))
+        k = max(1, min(min(remaining), headroom, self.decode_chunk))
+        active_dev = jnp.asarray(active)
+
+        tok_batches = []
+        for _ in range(k):
+            self.cache, self.lengths, self.last_tokens, toks = \
+                self._decode_fn(self.params, self.cache, self.lengths,
+                                self.last_tokens, active_dev)
+            tok_batches.append(toks)
+        done_slots: set[int] = set()
+        for toks in tok_batches:
+            toks_np = np.asarray(toks)  # first fetch blocks; rest are ready
+            for slot, req in enumerate(slot_req):
+                if req < 0 or slot in done_slots:
+                    continue
+                self._host_lengths[slot] += 1
+                if self._record_token(req, slot, int(toks_np[slot])):
+                    # finished mid-chain: later chained tokens are garbage
+                    # for this slot; drop them (its cache is reset by the
+                    # next prefill into the slot). The local return value —
+                    # not the shared _done set — decides, so a concurrent
+                    # release() from a server thread can't unfinish it.
+                    done_slots.add(slot)
 
     def _record_token(self, req_id: int, slot: int, token: int,
-                      first_token: bool = False) -> None:
+                      first_token: bool = False) -> bool:
+        """Returns True when this token finished the request."""
         if first_token:
-            self._first_token_t[req_id] = time.monotonic()
+            now = time.monotonic()
+            self._first_token_t[req_id] = now
+            self._ttft_window.append(now - self._submit_t[req_id])
         self._results[req_id].append(token)
         hit_eos = self.eos_id is not None and token == self.eos_id
-        # cache exhaustion: the NEXT decode would write at index `lengths`,
-        # which must stay < max_len
-        out_of_room = int(np.asarray(self.lengths)[slot]) + 1 >= self.max_len
+        # cache exhaustion: _host_lengths == KV rows written; the NEXT decode
+        # writes at that index, which must stay < max_len (the host mirror
+        # avoids a device fetch here)
+        out_of_room = self._host_lengths[slot] >= self.max_len
         freed = self.scheduler.token_done(slot, finished=hit_eos or out_of_room)
         if freed:
             self._done.add(req_id)
+            self._prompts.pop(req_id, None)
+            self._max_new.pop(req_id, None)
+        return freed

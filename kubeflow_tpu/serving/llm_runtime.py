@@ -1,0 +1,187 @@
+"""LLM serving runtime — registers modelFormat "llama" so an
+InferenceService predictor resolves to the continuous-batching engine
+(SURVEY.md §2.4 runtime table: the huggingfaceserver/Triton-LLM slot).
+
+    kind: InferenceService
+    spec:
+      predictor:
+        model:
+          modelFormat: llama
+          config:
+            model: {d_model: ..., n_layers: ...}   # LlamaConfig overrides
+            n_slots: 4
+            max_len: 512
+            buckets: [64, 128, 256]
+            checkpoint: /path/to/orbax/dir         # optional params source
+
+V1/V2 payload: {"prompt_tokens": [...], "max_new_tokens": N} (or a list of
+those). The engine thread runs continuous batching underneath, so
+concurrent HTTP requests share decode steps; per-request TTFT lands in
+Model.metrics() for the KServe-TTFT baseline metric (config #5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from kubeflow_tpu.serving.model import Model, serving_runtime
+
+# jax and the llama model module are imported inside load()/_load_params()
+# so that registering this runtime (imported by kubeflow_tpu.serving for
+# its side effect) keeps the serving package import jax-free.
+
+
+class LLMModel(Model):
+    def __init__(self, name: str, uri: str | None = None, *,
+                 model: dict[str, Any] | None = None, n_slots: int = 4,
+                 max_len: int = 512, buckets=(64, 128, 256),
+                 eos_id: int | None = None, checkpoint: str | None = None,
+                 seed: int = 0, timeout_s: float = 300.0, **_ignored: Any):
+        super().__init__(name)
+        self._cfg_overrides = dict(model or {})
+        self._n_slots = n_slots
+        self._max_len = max_len
+        self._buckets = tuple(buckets)
+        self._eos_id = eos_id
+        self._checkpoint = checkpoint or uri
+        self._seed = seed
+        self._timeout_s = timeout_s
+        self._engine = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._loop_error: BaseException | None = None
+        # rids whose waiter gave up (timeout/error) while still in flight;
+        # the engine thread releases them once they finish — a waiter thread
+        # must never release an unfinished request out from under the loop
+        self._abandoned: set[int] = set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def load(self) -> None:
+        from kubeflow_tpu.models import llama
+        from kubeflow_tpu.serving.llm import LLMEngine
+
+        cfg = llama.LlamaConfig(**self._cfg_overrides)
+        params = self._load_params(cfg)
+        self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
+                                 max_len=self._max_len,
+                                 buckets=self._buckets, eos_id=self._eos_id)
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"llm-engine-{self.name}")
+        self._thread.start()
+        self._mark_ready()
+
+    def _load_params(self, cfg):
+        import jax
+
+        from kubeflow_tpu.models import llama
+
+        if self._checkpoint:
+            # orbax trainer checkpoint: restore the params subtree against
+            # the model's abstract shapes (opt_state is not needed to serve)
+            import orbax.checkpoint as ocp
+
+            abstract = jax.eval_shape(
+                lambda: llama.init(jax.random.key(0), cfg))
+            with ocp.CheckpointManager(self._checkpoint) as mngr:
+                step = mngr.latest_step()
+                if step is not None:
+                    restored = mngr.restore(
+                        step, args=ocp.args.StandardRestore(
+                            {"params": abstract}))
+                    return restored["params"]
+        return llama.init(jax.random.key(self._seed), cfg)
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                progressed = self._engine.step()
+                self._sweep_abandoned()
+                if not progressed:
+                    # idle: sleep until a submit wakes us
+                    self._wake.wait(timeout=0.02)
+                    self._wake.clear()
+        except BaseException as e:  # surface to waiting predict() calls
+            self._loop_error = e
+            raise
+
+    def _sweep_abandoned(self) -> None:
+        for rid in list(self._abandoned):
+            if self._engine.is_done(rid):
+                self._engine.release(rid)
+                self._abandoned.discard(rid)
+
+    def unload(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        super().unload()
+
+    # -- inference -----------------------------------------------------------
+
+    def predict(self, payload: Any) -> Any:
+        if isinstance(payload, list):
+            # submit the whole batch first so requests share decode steps
+            rids: list[int] = []
+            out: list[dict[str, Any]] = []
+            try:
+                for p in payload:
+                    rids.append(self._submit(p))
+                for rid in rids:
+                    out.append({"output_tokens": self._wait(rid)})
+            except BaseException:
+                if len(rids) == len(payload):
+                    # a _wait failed: it abandoned its own rid; abandon the
+                    # not-yet-waited rest
+                    self._abandoned.update(rids[len(out) + 1:])
+                else:
+                    # a submit failed: nothing was waited on — abandon every
+                    # rid that did get into the engine
+                    self._abandoned.update(rids)
+                raise
+            return out
+        return {"output_tokens": self._wait(self._submit(payload))}
+
+    def _submit(self, payload: Any) -> int:
+        if not isinstance(payload, dict) or "prompt_tokens" not in payload:
+            raise ValueError(
+                "llama runtime expects {'prompt_tokens': [...], "
+                "'max_new_tokens': N}")
+        prompt = [int(t) for t in payload["prompt_tokens"]]
+        max_new = int(payload.get("max_new_tokens", 32))
+        rid = self._engine.submit(prompt, max_new)
+        self._wake.set()
+        return rid
+
+    def _wait(self, rid: int) -> list[int]:
+        deadline = time.monotonic() + self._timeout_s
+        try:
+            while not self._engine.is_done(rid):
+                if (self._stop.is_set() or self._thread is None
+                        or not self._thread.is_alive()):
+                    raise RuntimeError(
+                        f"llm engine loop is not running "
+                        f"({self._loop_error!r})")
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"generation timed out after {self._timeout_s}s")
+                time.sleep(0.001)
+        except BaseException:
+            self._abandoned.add(rid)  # engine thread releases it when done
+            raise
+        out = self._engine.result(rid)
+        self._engine.release(rid)  # long-lived server: drop request state
+        return out
+
+    def metrics(self) -> dict[str, Any]:
+        return self._engine.metrics() if self._engine else {}
+
+
+@serving_runtime("llama")
+def _llama_runtime(name: str, uri: str | None = None,
+                   **config: Any) -> Model:
+    return LLMModel(name, uri, **config)

@@ -138,3 +138,73 @@ def test_engine_python_scheduler_fallback(tiny):
                        prefer_native=False)
     out = engine.generate([5, 6, 7], max_new_tokens=3)
     assert out == _ref_generate(params, cfg, [5, 6, 7], 3)
+
+
+# -- InferenceService integration (modelFormat: llama) ------------------------
+
+def test_llm_inference_service_e2e():
+    from kubeflow_tpu import serving
+    from kubeflow_tpu.control import Cluster, new_resource
+
+    tiny_cfg = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=64, max_seq_len=64,
+                    attention_impl="xla", dtype=jnp.float32, remat=False)
+
+    c = Cluster(n_devices=8)
+    c.add(serving.InferenceServiceController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "llm", spec={
+            "predictor": {"model": {
+                "modelFormat": "llama",
+                "config": {"model": tiny_cfg, "n_slots": 2, "max_len": 32,
+                           "buckets": [8, 16], "seed": 0},
+            }, "minReplicas": 1, "scaleToZeroIdleSeconds": 60},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "llm",
+            lambda o: any(cond.get("type") == "Ready"
+                          for cond in o["status"].get("conditions", [])),
+            timeout=60)
+        url = isvc["status"]["url"]
+
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(
+            url + "/v1/models/llm:predict",
+            data=_json.dumps({"instances": [
+                {"prompt_tokens": [3, 17, 42, 9, 55],
+                 "max_new_tokens": 4}]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as r:
+            out = _json.loads(r.read())
+
+    cfg = llama.LlamaConfig(**tiny_cfg)
+    params = llama.init(jax.random.key(0), cfg)
+    ref = _ref_generate(params, cfg, [3, 17, 42, 9, 55], 4)
+    assert out["predictions"] == [{"output_tokens": ref}]
+
+
+def test_cache_exhaustion_uses_every_kv_row(tiny):
+    """max_len=8, prompt=4: rows 4..7 hold decoded KV, so exactly
+    max_len - prompt_len + 1 tokens come out before the slot is freed."""
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=1, max_len=8, buckets=(4,))
+    prompt = [3, 17, 42, 9]
+    out = engine.generate(prompt, max_new_tokens=10)
+    assert len(out) == 5  # truncated by cache, not max_new
+    assert out == _ref_generate(params, cfg, prompt, 5)
+
+
+def test_release_drops_request_state(tiny):
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8,))
+    rid = engine.submit([1, 2, 3], max_new_tokens=2)
+    engine.run_until_idle()
+    assert engine.result(rid) == _ref_generate(params, cfg, [1, 2, 3], 2)
+    engine.release(rid)
+    assert not engine.is_done(rid)
+    for d in (engine._prompts, engine._results, engine._submit_t,
+              engine._first_token_t, engine._max_new):
+        assert rid not in d
+    m = engine.metrics()  # ttft survives release via the sliding window
+    assert m["ttft_p50_s"] >= 0.0 and m["completed"] == 1
