@@ -19,7 +19,8 @@ from typing import Any, Callable
 
 from kubeflow_tpu import hpo
 from kubeflow_tpu.api.specs import ValidationError, load_yaml_file, validate
-from kubeflow_tpu.control import Cluster, JAXJobController
+from kubeflow_tpu.control import (Cluster, JAXJobController,
+                                  add_training_controllers)
 from kubeflow_tpu.control.conditions import is_finished
 from kubeflow_tpu.control.store import NotFoundError
 from kubeflow_tpu.pipelines.controllers import (PipelineRunController,
@@ -43,6 +44,7 @@ class Platform:
         self.cluster.executor.log_dir = os.path.join(self.root, "logs")
         os.makedirs(self.cluster.executor.log_dir, exist_ok=True)
         self.cluster.add(JAXJobController)
+        add_training_controllers(self.cluster)
         self.hpo_db = hpo.add_hpo_controllers(
             self.cluster, metrics_dir=os.path.join(self.root, "metrics"))
         self.pipelines = self.cluster.add(

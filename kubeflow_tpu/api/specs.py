@@ -42,6 +42,15 @@ VALIDATORS: dict[str, Callable[[dict[str, Any]], list[str]]] = {
 }
 
 
+def _register_framework_validators() -> None:
+    from kubeflow_tpu.control.frameworks import job_validators
+
+    VALIDATORS.update(job_validators())
+
+
+_register_framework_validators()
+
+
 def _register_platform_validators() -> None:
     from kubeflow_tpu.platform.profiles import validate_profile
 

@@ -31,7 +31,10 @@ from kubeflow_tpu.control.conditions import is_finished
 from kubeflow_tpu.version import __version__
 
 # kinds whose status reaches a terminal Succeeded/Failed condition
-WAITABLE_KINDS = ("JAXJob", "Experiment", "PipelineRun", "Trial")
+from kubeflow_tpu.control.frameworks import FRAMEWORK_KINDS
+
+_JOB_KINDS = ("JAXJob",) + FRAMEWORK_KINDS
+WAITABLE_KINDS = _JOB_KINDS + ("Experiment", "PipelineRun", "Trial")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -151,7 +154,7 @@ def _cmd_run(args, out) -> int:
             except TimeoutError as e:
                 print(f"{kind}/{name} timeout: {e}", file=out)
                 rc = 1
-            if args.logs and kind == "JAXJob":
+            if args.logs and kind in _JOB_KINDS:
                 print(p.job_logs(name, ns), file=out)
     return rc
 

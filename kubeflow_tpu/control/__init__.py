@@ -12,6 +12,8 @@ natively — no kubectl, no etcd — around TPU training processes:
 - scheduler.py   : gang scheduler + device inventory (Volcano PodGroup analog)
 - executor.py    : pod runtime (thread/subprocess backends — the kubelet analog)
 - jobs.py        : JAXJob controller (training-operator analog)
+- frameworks.py  : TFJob/PyTorchJob/XGBoostJob/MXJob/PaddleJob/MPIJob kinds
+                   on the same engine (per-kind SetClusterSpec env analogs)
 """
 
 from kubeflow_tpu.control.store import (  # noqa: F401
@@ -34,3 +36,14 @@ from kubeflow_tpu.control.scheduler import (  # noqa: F401
 )
 from kubeflow_tpu.control.executor import PodExecutor, worker_target  # noqa: F401
 from kubeflow_tpu.control.jobs import JAXJobController  # noqa: F401
+from kubeflow_tpu.control.frameworks import (  # noqa: F401
+    TRAINING_CONTROLLERS,
+    FRAMEWORK_KINDS,
+    TFJobController,
+    PyTorchJobController,
+    XGBoostJobController,
+    MXJobController,
+    PaddleJobController,
+    MPIJobController,
+    add_training_controllers,
+)

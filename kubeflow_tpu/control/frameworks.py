@@ -1,0 +1,251 @@
+"""Framework-compat training job kinds — the unified training-operator's
+per-framework controllers (SURVEY.md §2.2: TFJob/PyTorchJob/XGBoostJob/
+MXJob/PaddleJob/MPIJob rows) rebuilt on the shared JAXJob reconcile engine.
+
+Each controller differs from JAXJob ONLY in its `SetClusterSpec` analog —
+the rendezvous environment it injects into pods — exactly how the reference
+hosts every framework on one kubeflow/common JobController and specializes
+per-kind env generation (⊘ training-operator `pkg/controller.v1/*/
+*_controller.go SetClusterSpec`):
+
+- TFJob       → `TF_CONFIG` JSON (cluster spec + task)      ⊘ genClusterSpec
+- PyTorchJob  → `MASTER_ADDR`/`MASTER_PORT`/`WORLD_SIZE`/`RANK` (+ `PET_*`
+                when elasticPolicy is set)
+- XGBoostJob  → Rabit tracker env (`DMLC_TRACKER_URI` ...)
+- MXJob       → PS root env (`DMLC_PS_ROOT_URI` ...)
+- PaddleJob   → `PADDLE_TRAINER_ENDPOINTS`/`PADDLE_CURRENT_ENDPOINT` ...
+- MPIJob      → hostfile ConfigMap + `OMPI_MCA_orte_default_hostfile` on
+                the launcher                      ⊘ mpi-operator newConfigMap
+
+Everything else — gang scheduling, expectations, RunPolicy (restart/backoff/
+deadline/TTL), elastic resize, heartbeat failure detection, status
+conditions — is inherited unchanged from JAXJobController.
+
+Pods here are processes on one host, so every "service DNS name" becomes
+127.0.0.1 with a deterministic per-rank port (the headless-Service stable
+naming analog, SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+from kubeflow_tpu.control.jobs import (JAXJobController, _effective_replicas,
+                                       _replica_order)
+from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
+
+TFJOB_KIND = "TFJob"
+PYTORCHJOB_KIND = "PyTorchJob"
+XGBOOSTJOB_KIND = "XGBoostJob"
+MXJOB_KIND = "MXJob"
+PADDLEJOB_KIND = "PaddleJob"
+MPIJOB_KIND = "MPIJob"
+
+
+class _FrameworkJobController(JAXJobController):
+    """Shared helpers for per-rank host:port assignment."""
+
+    singleton_roles = ("master",)
+
+    def _host_port(self, job, rank: int) -> str:
+        # coordinator port is the job's base; ranks get base+1+rank
+        return f"127.0.0.1:{self._coordinator_port(job) + 1 + rank}"
+
+    def _order(self, job) -> list[tuple[str, int]]:
+        return _replica_order(job["spec"], _effective_replicas(job),
+                              self.role_priority)
+
+
+class TFJobController(_FrameworkJobController):
+    """TFJob: injects TF_CONFIG per pod (⊘ tfjob_controller.go
+    SetClusterSpec / genClusterSpec, SURVEY.md §3.2)."""
+
+    kind = TFJOB_KIND
+    roles = ("chief", "master", "ps", "worker", "evaluator")
+    singleton_roles = ("chief", "master")
+    role_priority = ("chief", "master")
+    success_roles = ("chief", "master", "worker")
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        order = self._order(job)
+        cluster: dict[str, list[str]] = {}
+        for r, (t, _i) in enumerate(order):
+            cluster.setdefault(t, []).append(self._host_port(job, r))
+        tf_config = {
+            "cluster": cluster,
+            "task": {"type": rtype, "index": idx},
+            "environment": "cloud",
+        }
+        return {"TF_CONFIG": json.dumps(tf_config, sort_keys=True)}
+
+
+class PyTorchJobController(_FrameworkJobController):
+    """PyTorchJob: MASTER_ADDR/PORT + WORLD_SIZE/RANK for the c10d TCPStore
+    rendezvous (⊘ pytorchjob_controller.go SetClusterSpec, SURVEY.md §3.1);
+    PET_* torchelastic env when elasticPolicy is present."""
+
+    kind = PYTORCHJOB_KIND
+    roles = ("master", "worker")
+    success_roles = ("master", "worker")
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        addr, port = self._host_port(job, 0).split(":")
+        env = {
+            "MASTER_ADDR": addr,
+            "MASTER_PORT": port,
+            "WORLD_SIZE": str(world),
+            "RANK": str(rank),
+            "LOCAL_RANK": "0",
+        }
+        elastic = job["spec"].get("elasticPolicy")
+        if elastic:
+            env.update({
+                "PET_RDZV_BACKEND": elastic.get("rdzvBackend", "c10d"),
+                "PET_RDZV_ENDPOINT": f"{addr}:{port}",
+                "PET_MIN_SIZE": str(elastic.get("minReplicas", 1)),
+                "PET_MAX_SIZE": str(elastic.get("maxReplicas", world)),
+                "PET_NNODES": str(world),
+                "PET_NPROC_PER_NODE": "1",
+            })
+        return env
+
+
+class XGBoostJobController(_FrameworkJobController):
+    """XGBoostJob: Rabit tracker env rooted at master-0
+    (⊘ xgboostjob_controller.go SetPodEnv)."""
+
+    kind = XGBOOSTJOB_KIND
+    roles = ("master", "worker")
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        addr, port = self._host_port(job, 0).split(":")
+        workers = _effective_replicas(job).get("worker", 0)
+        return {
+            "MASTER_ADDR": addr,
+            "MASTER_PORT": port,
+            "WORLD_SIZE": str(world),
+            "RANK": str(rank),
+            "DMLC_TRACKER_URI": addr,
+            "DMLC_TRACKER_PORT": port,
+            "DMLC_NUM_WORKER": str(workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_TASK_ID": str(idx),
+            "DMLC_ROLE": "master" if rtype == "master" else "worker",
+        }
+
+
+class MXJobController(_FrameworkJobController):
+    """MXJob: DMLC parameter-server root env rooted at the scheduler
+    (⊘ mxjob_controller.go SetClusterSpec)."""
+
+    kind = MXJOB_KIND
+    roles = ("scheduler", "server", "worker")
+    singleton_roles = ("scheduler",)
+    role_priority = ("scheduler",)
+    success_roles = ("worker",)
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        addr, port = self._host_port(job, 0).split(":")
+        eff = _effective_replicas(job)
+        return {
+            "DMLC_PS_ROOT_URI": addr,
+            "DMLC_PS_ROOT_PORT": port,
+            "DMLC_NUM_SERVER": str(eff.get("server", 0)),
+            "DMLC_NUM_WORKER": str(eff.get("worker", 0)),
+            "DMLC_ROLE": rtype,
+            "DMLC_TASK_ID": str(idx),
+        }
+
+
+class PaddleJobController(_FrameworkJobController):
+    """PaddleJob: trainer endpoint list + this pod's endpoint
+    (⊘ paddlejob_controller.go SetClusterSpec)."""
+
+    kind = PADDLEJOB_KIND
+    roles = ("master", "ps", "worker")
+    success_roles = ("master", "worker")
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        order = self._order(job)
+        worker_hosts = [self._host_port(job, r)
+                        for r, (t, _i) in enumerate(order) if t == "worker"]
+        env = {
+            "PADDLE_TRAINERS_NUM": str(len(worker_hosts)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(worker_hosts),
+            "PADDLE_CURRENT_ENDPOINT": self._host_port(job, rank),
+        }
+        if rtype == "worker":
+            # trainer id indexes PADDLE_TRAINER_ENDPOINTS: fleet expects
+            # trainer_endpoints[trainer_id] == current_endpoint, so it is the
+            # worker index, NOT the global rank (master/ps are not trainers)
+            env["PADDLE_TRAINER_ID"] = str(idx)
+            env["PADDLE_CURRENT_ENDPOINT"] = worker_hosts[idx]
+        return env
+
+
+class MPIJobController(_FrameworkJobController):
+    """MPIJob: launcher + workers; generates the hostfile ConfigMap the
+    launcher's mpirun consumes (⊘ mpi_job_controller.go newConfigMap,
+    SURVEY.md §2.2 MPIJob row). The hostfile is also materialized to a real
+    path so an actual `mpirun --hostfile` can read it."""
+
+    kind = MPIJOB_KIND
+    roles = ("launcher", "worker")
+    singleton_roles = ("launcher",)
+    role_priority = ("launcher",)
+    success_roles = ("launcher",)
+
+    def _hostfile(self, job) -> tuple[str, str]:
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        workers = _effective_replicas(job).get("worker", 0)
+        slots = (job["spec"]["replicaSpecs"].get("worker", {})
+                 .get("template", {}).get("resources", {}).get("cpu", 1))
+        content = "".join(f"{name}-worker-{i} slots={slots}\n"
+                          for i in range(workers))
+        path = os.path.join(tempfile.gettempdir(),
+                            f"ktpu-{ns}-{name}-hostfile")
+        return content, path
+
+    def cluster_env(self, job, rtype, idx, rank, world):
+        if rtype != "launcher":
+            return {"OMPI_COMM_WORLD_RANK": str(rank - 1)}
+        content, path = self._hostfile(job)
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        cm = new_resource("ConfigMap", f"{name}-config",
+                          spec={"data": {"hostfile": content}},
+                          namespace=ns, owner=job)
+        try:
+            self.store.create(cm)
+        except AlreadyExistsError:
+            self.store.mutate(
+                "ConfigMap", f"{name}-config",
+                lambda o: o["spec"]["data"].update(hostfile=content), ns)
+        with open(path, "w") as f:
+            f.write(content)
+        return {"OMPI_MCA_orte_default_hostfile": path}
+
+
+TRAINING_CONTROLLERS: tuple[type[JAXJobController], ...] = (
+    TFJobController, PyTorchJobController, XGBoostJobController,
+    MXJobController, PaddleJobController, MPIJobController)
+
+FRAMEWORK_KINDS: tuple[str, ...] = tuple(
+    c.kind for c in TRAINING_CONTROLLERS)
+
+
+def add_training_controllers(cluster) -> None:
+    """Register every framework job kind on a Cluster — the unified
+    training-operator manager analog (one manager, all reconcilers,
+    ⊘ cmd/training-operator.v1/main.go)."""
+    for ctrl in TRAINING_CONTROLLERS:
+        cluster.add(ctrl)
+
+
+def job_validators() -> dict[str, Any]:
+    """kind → validator map for the admission layer (api/specs.py)."""
+    return {c.kind: c.validate for c in TRAINING_CONTROLLERS}

@@ -111,13 +111,18 @@ def _effective_replicas(job: dict[str, Any]) -> dict[str, int]:
 
 
 def _replica_order(spec: dict[str, Any],
-                   replicas: dict[str, int] | None = None
+                   replicas: dict[str, int] | None = None,
+                   priority: tuple[str, ...] = ("master",)
                    ) -> list[tuple[str, int]]:
-    """Deterministic global process ranking: replica types sorted (master
-    first if present), then index — the genClusterSpec ordering analog."""
+    """Deterministic global process ranking: replica types sorted (priority
+    roles first — master/chief/launcher — then alphabetical), then index —
+    the genClusterSpec ordering analog."""
     order: list[tuple[str, int]] = []
-    rtypes = sorted(spec.get("replicaSpecs", {}),
-                    key=lambda t: (t != "master", t))
+
+    def key(t: str):
+        return (priority.index(t) if t in priority else len(priority), t)
+
+    rtypes = sorted(spec.get("replicaSpecs", {}), key=key)
     for rtype in rtypes:
         n = (replicas or {}).get(
             rtype, spec["replicaSpecs"][rtype].get("replicas", 1))
@@ -127,13 +132,47 @@ def _replica_order(spec: dict[str, Any],
 
 
 class JAXJobController(Controller):
+    """Also the base for the framework-compat job kinds (TFJob, PyTorchJob,
+    ... — control/frameworks.py): subclasses override `kind`, the role
+    attributes, and `cluster_env` (the SetClusterSpec analog); every other
+    semantic — gang, expectations, RunPolicy, elastic, heartbeats — is
+    shared, mirroring how the reference hosts all job controllers on one
+    kubeflow/common engine (SURVEY.md §2.2)."""
+
     kind = JOB_KIND
     owned_kinds = ("Pod",)
+    # rank-0-first role ordering (genClusterSpec analog); subclasses override
+    role_priority: tuple[str, ...] = ("master",)
+    # allowed replica-type names; None = any (JAXJob is schema-free)
+    roles: tuple[str, ...] | None = None
+    # roles capped at replicas=1 (a second master is a spec error); empty for
+    # JAXJob — it is schema-free, and its admission validator (validate_job)
+    # must stay in lockstep with reconcile-time validation
+    singleton_roles: tuple[str, ...] = ()
+    # successPolicy=Worker0 gates on index 0 of the first of these roles
+    # present in the spec (falls back to global rank 0)
+    success_roles: tuple[str, ...] = ("master", "worker")
 
     def __init__(self, cluster):
         super().__init__(cluster)
         # per-job rendezvous/heartbeat coordinators (failureDetection jobs)
         self._coordinators: dict[str, Any] = {}
+
+    @classmethod
+    def validate(cls, job: dict[str, Any]) -> list[str]:
+        """validate_job + per-kind role schema (the per-kind validating
+        webhook analog)."""
+        errs = validate_job(job)
+        replicas = job.get("spec", {}).get("replicaSpecs", {})
+        for rtype, rspec in replicas.items():
+            if cls.roles is not None and rtype not in cls.roles:
+                errs.append(
+                    f"{cls.kind} does not allow replica type {rtype!r} "
+                    f"(allowed: {', '.join(cls.roles)})")
+            if rtype in cls.singleton_roles and rspec.get("replicas", 1) > 1:
+                errs.append(
+                    f"replicaSpecs.{rtype}.replicas must be 1 for {cls.kind}")
+        return errs
 
     def reconcile(self, job: dict[str, Any]) -> float | None:
         name = job["metadata"]["name"]
@@ -144,13 +183,13 @@ class JAXJobController(Controller):
         if is_finished(status):
             return self._reconcile_finished(job)
 
-        errs = validate_job(job)
+        errs = self.validate(job)
         if errs:
             self._fail(job, "InvalidSpec", "; ".join(errs))
             return None
 
         if not status.get("conditions"):
-            self.store.mutate(JOB_KIND, name, lambda o: (
+            self.store.mutate(self.kind, name, lambda o: (
                 o["status"].update(startTime=time.time()),
                 set_condition(o["status"], JobConditionType.CREATED,
                               "JobCreated", f"JAXJob {name} is created.")),
@@ -192,7 +231,7 @@ class JAXJobController(Controller):
                     int(p["metadata"]["labels"][REPLICA_INDEX_LABEL])): p
                    for p in pods}
 
-        order = _replica_order(job["spec"], eff)
+        order = _replica_order(job["spec"], eff, self.role_priority)
         total_restarts = status.get("restartCount", 0)
         backoff_limit = run_policy.get("backoffLimit")  # unset = unlimited
         restarted = False
@@ -233,7 +272,7 @@ class JAXJobController(Controller):
                     # elastic shrink: restart the WHOLE gang one worker
                     # smaller (checkpoint-restore carries the training state,
                     # §5.3) instead of waiting for the lost capacity
-                    self.store.mutate(JOB_KIND, name, lambda o: (
+                    self.store.mutate(self.kind, name, lambda o: (
                         o["status"].update(
                             elasticReplicas=eff["worker"] - 1,
                             gangEpoch=epoch + 1,
@@ -280,11 +319,11 @@ class JAXJobController(Controller):
                 if running == len(order):
                     set_condition(o["status"], JobConditionType.RUNNING,
                                   "JobRunning", "all replicas running")
-        self.store.mutate(JOB_KIND, name, write, ns)
+        self.store.mutate(self.kind, name, write, ns)
 
         # -- success ----------------------------------------------------------
         if self._check_success(job, replica_statuses, order):
-            self.store.mutate(JOB_KIND, name, lambda o: (
+            self.store.mutate(self.kind, name, lambda o: (
                 o["status"].update(completionTime=time.time()),
                 set_condition(o["status"], JobConditionType.SUCCEEDED,
                               "JobSucceeded", "success policy satisfied")),
@@ -309,6 +348,10 @@ class JAXJobController(Controller):
             return all(rs["succeeded"] >= eff.get(rt, 1)
                        for rt, rs in replica_statuses.items())
         rtype0, idx0 = order[0]
+        for role in self.success_roles:
+            if role in job["spec"].get("replicaSpecs", {}):
+                rtype0, idx0 = role, 0
+                break
         pod = self.store.try_get(
             "Pod", self._pod_name(job, rtype0, idx0),
             job["metadata"].get("namespace", "default"))
@@ -320,6 +363,16 @@ class JAXJobController(Controller):
 
     def _coordinator_port(self, job) -> int:
         return _BASE_PORT + int(job["metadata"]["uid"][:4], 16) % 8000
+
+    def cluster_env(self, job, rtype: str, idx: int, rank: int,
+                    world: int) -> dict[str, str]:
+        """The SetClusterSpec analog: per-pod rendezvous env. JAXJob hands
+        out the jax.distributed.initialize triple; framework kinds override
+        with TF_CONFIG / MASTER_ADDR / DMLC_* / PADDLE_* shapes."""
+        return {
+            "KTPU_COORDINATOR_ADDRESS":
+                f"127.0.0.1:{self._coordinator_port(job)}",
+        }
 
     def _create_pod(self, job, rtype: str, idx: int, rank: int,
                     world: int, epoch: int = 0) -> None:
@@ -336,9 +389,8 @@ class JAXJobController(Controller):
             "KTPU_NUM_PROCESSES": str(world),
             "KTPU_PROCESS_ID": str(rank),
             "KTPU_GANG_EPOCH": str(epoch),
-            "KTPU_COORDINATOR_ADDRESS":
-                f"127.0.0.1:{self._coordinator_port(job)}",
         })
+        env.update(self.cluster_env(job, rtype, idx, rank, world))
         rdv = self._coordinators.get(self.key_of(job))
         if rdv is not None:
             fd = job["spec"].get("failureDetection", {})
@@ -413,7 +465,7 @@ class JAXJobController(Controller):
         except OSError:
             return
         ns = job["metadata"].get("namespace", "default")
-        order = _replica_order(job["spec"], eff)
+        order = _replica_order(job["spec"], eff, self.role_priority)
         for rank in dead:
             if rank >= len(order):
                 continue
@@ -452,7 +504,7 @@ class JAXJobController(Controller):
         ns = job["metadata"].get("namespace", "default")
         self._stop_coordinator(self.key_of(job))
         try:
-            self.store.mutate(JOB_KIND, job["metadata"]["name"], lambda o: (
+            self.store.mutate(self.kind, job["metadata"]["name"], lambda o: (
                 o["status"].update(completionTime=time.time()),
                 set_condition(o["status"], JobConditionType.FAILED,
                               reason, message)), ns)
@@ -488,5 +540,5 @@ class JAXJobController(Controller):
         if remaining > 0:
             return remaining
         self.store.delete_owned_by(job)
-        self.store.try_delete(JOB_KIND, job["metadata"]["name"], ns)
+        self.store.try_delete(self.kind, job["metadata"]["name"], ns)
         return None

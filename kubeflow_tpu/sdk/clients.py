@@ -35,23 +35,33 @@ class _ClientBase:
 
 
 class TrainingClient(_ClientBase):
-    """TrainingClient analog: create/inspect/wait/delete JAXJobs."""
+    """TrainingClient analog: create/inspect/wait/delete training jobs.
+
+    `kind` selects the job CRD — JAXJob (default) or any framework-compat
+    kind (TFJob, PyTorchJob, XGBoostJob, MXJob, PaddleJob, MPIJob), matching
+    the reference SDK's per-kind clients (⊘ sdk/python
+    training_client.py)."""
+
+    def __init__(self, *args, kind: str = JOB_KIND, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.kind = kind
 
     def create_job(self, job: dict[str, Any] | None = None, *,
                    name: str | None = None, **kwargs) -> dict[str, Any]:
-        """Pass a full JAXJob resource, or builder kwargs (see
-        `specs.jaxjob`)."""
+        """Pass a full job resource, or builder kwargs (see
+        `specs.jaxjob`; builders apply to kind=JAXJob only)."""
         if job is None:
             if name is None:
                 raise ValueError("name is required when building from kwargs")
             job = specs.jaxjob(name, namespace=self.namespace, **kwargs)
+            job["kind"] = self.kind
         return self.backend.apply(job)
 
     def get_job(self, name: str) -> dict[str, Any]:
-        return self.backend.get(JOB_KIND, name, self.namespace)
+        return self.backend.get(self.kind, name, self.namespace)
 
     def list_jobs(self) -> list[dict[str, Any]]:
-        return self.backend.list(JOB_KIND, self.namespace)
+        return self.backend.list(self.kind, self.namespace)
 
     def get_job_logs(self, name: str) -> str:
         return self.backend.job_logs(name, self.namespace)
@@ -63,7 +73,7 @@ class TrainingClient(_ClientBase):
         """Wait until the job reaches any of `expected` (or any terminal
         state — a job that Failed while we wait for Succeeded raises)."""
         job = self.backend.wait(
-            JOB_KIND, name,
+            self.kind, name,
             lambda o: (any(has_condition(o.get("status", {}), c)
                            for c in expected)
                        or is_finished(o.get("status", {}))),
@@ -71,11 +81,12 @@ class TrainingClient(_ClientBase):
         if not any(has_condition(job["status"], c) for c in expected):
             conds = [c["type"] for c in job["status"].get("conditions", [])]
             raise RuntimeError(
-                f"JAXJob {name} reached {conds}, expected one of {expected}")
+                f"{self.kind} {name} reached {conds}, "
+                f"expected one of {expected}")
         return job
 
     def delete_job(self, name: str) -> None:
-        self.backend.delete(JOB_KIND, name, self.namespace)
+        self.backend.delete(self.kind, name, self.namespace)
 
 
 class KatibClient(_ClientBase):

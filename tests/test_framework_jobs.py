@@ -1,0 +1,223 @@
+"""Framework-compat job kinds (TFJob/PyTorchJob/XGBoostJob/MXJob/PaddleJob/
+MPIJob): per-kind SetClusterSpec env injection, role schemas, and a real
+torch.distributed gloo rendezvous driven purely by the injected env — the
+reference's own test strategy (assert the env the controller hands out,
+SURVEY.md §4.1/§4.4) plus one live framework e2e."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from kubeflow_tpu.control import (
+    Cluster,
+    MPIJobController,
+    MXJobController,
+    PaddleJobController,
+    PyTorchJobController,
+    TFJobController,
+    XGBoostJobController,
+    new_resource,
+    worker_target,
+)
+from kubeflow_tpu.control.conditions import has_condition, is_finished
+
+_envs: dict[str, dict[str, dict]] = {}
+_lock = threading.Lock()
+
+
+@worker_target("fw_record")
+def _record(env, cancel):
+    with _lock:
+        _envs.setdefault(env["KTPU_JOB_NAME"], {})[env["KTPU_POD_NAME"]] = env
+
+
+def _job(kind, name, roles: dict[str, int], *, target="fw_record",
+         spec_extra=None, template_extra=None):
+    return new_resource(kind, name, spec={
+        "successPolicy": "AllWorkers",
+        "replicaSpecs": {
+            r: {"replicas": n,
+                "template": {"backend": "thread", "target": target,
+                             **(template_extra or {})}}
+            for r, n in roles.items()},
+        **(spec_extra or {}),
+    })
+
+
+def _run(controller_cls, job, timeout=30):
+    c = Cluster(n_devices=8)
+    c.add(controller_cls)
+    with c:
+        c.store.create(job)
+        done = c.wait_for(job["kind"], job["metadata"]["name"],
+                          lambda o: is_finished(o["status"]), timeout=timeout)
+        pods = c.store.list("Pod")
+        return done, pods
+
+
+def test_tfjob_injects_tf_config():
+    job = _job("TFJob", "tf1", {"chief": 1, "worker": 2, "ps": 1})
+    done, _ = _run(TFJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["tf1"]
+    assert len(envs) == 4
+    cfgs = {pod: json.loads(e["TF_CONFIG"]) for pod, e in envs.items()}
+    # one shared cluster spec; per-pod task {type,index}
+    clusters = {json.dumps(c["cluster"], sort_keys=True)
+                for c in cfgs.values()}
+    assert len(clusters) == 1
+    cluster = next(iter(cfgs.values()))["cluster"]
+    assert len(cluster["chief"]) == 1 and len(cluster["worker"]) == 2
+    assert len(cluster["ps"]) == 1
+    assert cfgs["tf1-chief-0"]["task"] == {"type": "chief", "index": 0}
+    assert cfgs["tf1-worker-1"]["task"] == {"type": "worker", "index": 1}
+    # chief is global rank 0 (role_priority), so its host is first
+    assert envs["tf1-chief-0"]["KTPU_PROCESS_ID"] == "0"
+
+
+def test_pytorchjob_env_and_elastic_pet():
+    job = _job("PyTorchJob", "pt1", {"master": 1, "worker": 2},
+               spec_extra={"elasticPolicy": {"minReplicas": 1,
+                                             "maxReplicas": 3}})
+    done, _ = _run(PyTorchJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["pt1"]
+    master = envs["pt1-master-0"]
+    w1 = envs["pt1-worker-1"]
+    assert master["RANK"] == "0" and master["WORLD_SIZE"] == "3"
+    assert w1["RANK"] == "2"
+    assert w1["MASTER_ADDR"] == master["MASTER_ADDR"] == "127.0.0.1"
+    assert w1["MASTER_PORT"] == master["MASTER_PORT"]
+    assert w1["PET_RDZV_BACKEND"] == "c10d"
+    assert w1["PET_MIN_SIZE"] == "1" and w1["PET_MAX_SIZE"] == "3"
+
+
+def test_xgboost_rabit_tracker_env():
+    job = _job("XGBoostJob", "xgb1", {"master": 1, "worker": 2})
+    done, _ = _run(XGBoostJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["xgb1"]
+    m = envs["xgb1-master-0"]
+    w = envs["xgb1-worker-0"]
+    assert m["DMLC_ROLE"] == "master" and w["DMLC_ROLE"] == "worker"
+    assert w["DMLC_TRACKER_URI"] == "127.0.0.1"
+    assert w["DMLC_TRACKER_PORT"] == m["MASTER_PORT"]
+    assert w["DMLC_NUM_WORKER"] == "2"
+
+
+def test_mxjob_ps_root_env():
+    job = _job("MXJob", "mx1", {"scheduler": 1, "server": 1, "worker": 2})
+    done, _ = _run(MXJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["mx1"]
+    s = envs["mx1-scheduler-0"]
+    w = envs["mx1-worker-0"]
+    assert s["DMLC_ROLE"] == "scheduler" and s["KTPU_PROCESS_ID"] == "0"
+    assert w["DMLC_PS_ROOT_URI"] == "127.0.0.1"
+    assert w["DMLC_PS_ROOT_PORT"] == s["DMLC_PS_ROOT_PORT"]
+    assert w["DMLC_NUM_SERVER"] == "1" and w["DMLC_NUM_WORKER"] == "2"
+
+
+def test_paddlejob_endpoints():
+    job = _job("PaddleJob", "pd1", {"worker": 3})
+    done, _ = _run(PaddleJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["pd1"]
+    eps = envs["pd1-worker-0"]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 3
+    for i in range(3):
+        e = envs[f"pd1-worker-{i}"]
+        assert e["PADDLE_TRAINERS_NUM"] == "3"
+        assert e["PADDLE_CURRENT_ENDPOINT"] == eps[i]
+        assert e["PADDLE_TRAINER_ID"] == str(i)
+        assert e["PADDLE_TRAINER_ENDPOINTS"] == ",".join(eps)
+
+
+def test_paddlejob_trainer_id_ignores_non_worker_roles():
+    """With a master present, trainer ids still index the ENDPOINTS list
+    (fleet expects trainer_endpoints[trainer_id] == current_endpoint)."""
+    job = _job("PaddleJob", "pd2", {"master": 1, "worker": 2})
+    done, _ = _run(PaddleJobController, job)
+    assert has_condition(done["status"], "Succeeded")
+    envs = _envs["pd2"]
+    eps = envs["pd2-worker-0"]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 2
+    assert "PADDLE_TRAINER_ID" not in envs["pd2-master-0"]
+    for i in range(2):
+        e = envs[f"pd2-worker-{i}"]
+        assert e["PADDLE_TRAINER_ID"] == str(i)
+        assert e["PADDLE_CURRENT_ENDPOINT"] == eps[i]
+
+
+def test_mpijob_hostfile_configmap():
+    job = _job("MPIJob", "mpi1", {"launcher": 1, "worker": 2},
+               spec_extra={"successPolicy": "Worker0"})
+    c = Cluster(n_devices=8)
+    c.add(MPIJobController)
+    with c:
+        c.store.create(job)
+        done = c.wait_for("MPIJob", "mpi1",
+                          lambda o: is_finished(o["status"]), timeout=30)
+        cm = c.store.get("ConfigMap", "mpi1-config")
+    assert has_condition(done["status"], "Succeeded")
+    hostfile = cm["spec"]["data"]["hostfile"]
+    assert hostfile.splitlines() == ["mpi1-worker-0 slots=1",
+                                    "mpi1-worker-1 slots=1"]
+    launcher_env = _envs["mpi1"]["mpi1-launcher-0"]
+    path = launcher_env["OMPI_MCA_orte_default_hostfile"]
+    with open(path) as f:
+        assert f.read() == hostfile
+
+
+def test_torch_ddp_gloo_rendezvous_e2e():
+    """PyTorchJob whose pods run REAL torch.distributed: the injected
+    MASTER_ADDR/PORT + WORLD_SIZE/RANK drive a gloo TCPStore rendezvous and
+    an allreduce across 2 subprocesses (the §3.1 stack, CPU-scale)."""
+    script = (
+        "import datetime, os, torch, torch.distributed as dist\n"
+        "dist.init_process_group('gloo',"
+        " timeout=datetime.timedelta(seconds=90))\n"
+        "t = torch.ones(1)\n"
+        "dist.all_reduce(t)\n"
+        "assert int(t.item()) == int(os.environ['WORLD_SIZE']), t\n"
+        "dist.destroy_process_group()\n"
+    )
+    job = new_resource("PyTorchJob", "ddp", spec={
+        "successPolicy": "AllWorkers",
+        "runPolicy": {"activeDeadlineSeconds": 120},
+        "replicaSpecs": {
+            "master": {"replicas": 1, "template": {
+                "backend": "subprocess", "command": script,
+                "env": {"PYTHONPATH": ""}}},
+            "worker": {"replicas": 1, "template": {
+                "backend": "subprocess", "command": script,
+                "env": {"PYTHONPATH": ""}}},
+        },
+    })
+    done, pods = _run(PyTorchJobController, job, timeout=120)
+    assert has_condition(done["status"], "Succeeded"), done["status"]
+
+
+@pytest.mark.parametrize("ctrl,roles,err_fragment", [
+    (PyTorchJobController, {"master": 2, "worker": 1}, "must be 1"),
+    (TFJobController, {"gpu_worker": 1}, "does not allow replica type"),
+    (MXJobController, {"scheduler": 1, "ps": 1}, "does not allow"),
+    (MPIJobController, {"launcher": 2, "worker": 1}, "must be 1"),
+])
+def test_role_schema_validation(ctrl, roles, err_fragment):
+    job = _job(ctrl.kind, "v", roles)
+    errs = ctrl.validate(job)
+    assert any(err_fragment in e for e in errs), errs
+
+
+def test_framework_kinds_registered_in_admission_layer():
+    from kubeflow_tpu.api.specs import VALIDATORS
+
+    for kind in ("TFJob", "PyTorchJob", "XGBoostJob", "MXJob", "PaddleJob",
+                 "MPIJob"):
+        assert kind in VALIDATORS
+    bad = _job("TFJob", "t", {"nope": 1})
+    assert VALIDATORS["TFJob"](bad)
