@@ -1,0 +1,88 @@
+"""KfDef — the kfctl deployment-config analog (SURVEY.md §2.1 kfctl row:
+`kfctl init/apply -f kfdef.yaml`, `KfDef` CRD-as-config ⊘ bootstrap/kfctl
+`pkg/apis/apps/kfdef`).
+
+The reference's KfDef lists the applications (kustomize packages) an
+install deploys; here it lists which controller groups a Platform hosts:
+
+    apiVersion: kubeflow-tpu/v1
+    kind: KfDef
+    metadata: {name: my-deploy}
+    spec:
+      applications:
+        - name: training      # JAXJob + TFJob/PyTorchJob/... controllers
+        - name: hpo           # Experiment/Trial/suggestion engine
+        - name: pipelines     # PipelineRun/ScheduledRun + metadata store
+        - name: serving       # InferenceService controller
+        - name: platform      # Profiles/Notebooks/Tensorboards/Volumes/...
+          enabled: false      # omit or disable a group
+
+`tpukctl init DIR` scaffolds the file; `tpukctl daemon --kfdef FILE`
+(and `Platform(components=...)`) deploys exactly those groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+KFDEF_KIND = "KfDef"
+
+# group -> description (what the group installs); order = install order
+COMPONENTS: dict[str, str] = {
+    "training": "training-operator analog: JAXJob + framework job kinds",
+    "hpo": "Katib analog: Experiment/Trial controllers + suggestion algos",
+    "pipelines": "KFP analog: PipelineRun/ScheduledRun + artifact/metadata",
+    "serving": "KServe analog: InferenceService controller + runtimes",
+    "platform": "kubeflow/kubeflow analog: Profiles/Notebooks/Tensorboards/"
+                "Volumes/PVCViewers + PodDefault webhook",
+}
+
+# groups whose controllers create resources owned by another group
+REQUIRES: dict[str, tuple[str, ...]] = {
+    "hpo": ("training",),      # trials instantiate training jobs
+}
+
+ALL_COMPONENTS: tuple[str, ...] = tuple(COMPONENTS)
+
+
+def default_kfdef(name: str = "kubeflow-tpu") -> dict[str, Any]:
+    """The `kfctl init` scaffold: every application enabled."""
+    return {
+        "apiVersion": "kubeflow-tpu/v1",
+        "kind": KFDEF_KIND,
+        "metadata": {"name": name},
+        "spec": {"applications": [{"name": c, "enabled": True}
+                                  for c in ALL_COMPONENTS]},
+    }
+
+
+def validate_kfdef(obj: dict[str, Any]) -> list[str]:
+    errs: list[str] = []
+    apps = obj.get("spec", {}).get("applications")
+    if not isinstance(apps, list) or not apps:
+        return ["spec.applications must be a non-empty list"]
+    enabled = set()
+    for i, app in enumerate(apps):
+        name = app.get("name") if isinstance(app, dict) else None
+        if name not in COMPONENTS:
+            errs.append(
+                f"spec.applications[{i}].name {name!r} unknown "
+                f"(known: {', '.join(ALL_COMPONENTS)})")
+            continue
+        if app.get("enabled", True):
+            enabled.add(name)
+    for comp in sorted(enabled):
+        for dep in REQUIRES.get(comp, ()):
+            if dep not in enabled:
+                errs.append(f"application {comp!r} requires {dep!r}")
+    return errs
+
+
+def components_of(obj: dict[str, Any]) -> tuple[str, ...]:
+    """Enabled component groups, in install order."""
+    errs = validate_kfdef(obj)
+    if errs:
+        raise ValueError("invalid KfDef: " + "; ".join(errs))
+    enabled = {app["name"] for app in obj["spec"]["applications"]
+               if app.get("enabled", True)}
+    return tuple(c for c in ALL_COMPONENTS if c in enabled)

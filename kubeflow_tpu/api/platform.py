@@ -38,35 +38,59 @@ class Platform:
     """
 
     def __init__(self, n_devices: int | None = None,
-                 root: str | None = None):
+                 root: str | None = None,
+                 components: tuple[str, ...] | None = None):
+        """`components` gates which controller groups are installed (the
+        KfDef applications list, api/kfdef.py); None = everything."""
+        from kubeflow_tpu.api.kfdef import ALL_COMPONENTS, validate_kfdef
+
+        if components is None:
+            components = ALL_COMPONENTS
+        else:
+            components = tuple(components)
+            errs = validate_kfdef({"spec": {"applications": [
+                {"name": c} for c in components]}})
+            if errs:
+                raise ValueError("; ".join(errs))
+        self.components = components
         self.root = root or tempfile.mkdtemp(prefix="kubeflow-tpu-")
         self.cluster = Cluster(n_devices=n_devices)
         self.cluster.executor.log_dir = os.path.join(self.root, "logs")
         os.makedirs(self.cluster.executor.log_dir, exist_ok=True)
-        self.cluster.add(JAXJobController)
-        add_training_controllers(self.cluster)
-        self.hpo_db = hpo.add_hpo_controllers(
-            self.cluster, metrics_dir=os.path.join(self.root, "metrics"))
-        self.pipelines = self.cluster.add(
-            PipelineRunController, root=os.path.join(self.root, "pipelines"))
-        self.cluster.add(ScheduledRunController)
-        self.serving = self.cluster.add(InferenceServiceController)
-        # L2 platform glue (SURVEY.md §2.1): multi-tenancy, workspaces,
-        # PodDefault admission
-        from kubeflow_tpu.platform import (NotebookController,
-                                           ProfileController,
-                                           PVCViewerController,
-                                           TensorboardController,
-                                           VolumeController,
-                                           install_poddefault_webhook)
+        self.hpo_db = None
+        self.pipelines = None
+        self.serving = None
+        self.volumes = None
+        if "training" in components:
+            self.cluster.add(JAXJobController)
+            add_training_controllers(self.cluster)
+        if "hpo" in components:
+            self.hpo_db = hpo.add_hpo_controllers(
+                self.cluster, metrics_dir=os.path.join(self.root, "metrics"))
+        if "pipelines" in components:
+            self.pipelines = self.cluster.add(
+                PipelineRunController,
+                root=os.path.join(self.root, "pipelines"))
+            self.cluster.add(ScheduledRunController)
+        if "serving" in components:
+            self.serving = self.cluster.add(InferenceServiceController)
+        if "platform" in components:
+            # L2 platform glue (SURVEY.md §2.1): multi-tenancy, workspaces,
+            # PodDefault admission
+            from kubeflow_tpu.platform import (NotebookController,
+                                               ProfileController,
+                                               PVCViewerController,
+                                               TensorboardController,
+                                               VolumeController,
+                                               install_poddefault_webhook)
 
-        install_poddefault_webhook(self.cluster.store)
-        self.cluster.add(ProfileController)
-        self.cluster.add(NotebookController)
-        self.cluster.add(TensorboardController)
-        self.volumes = self.cluster.add(
-            VolumeController, data_root=os.path.join(self.root, "volumes"))
-        self.cluster.add(PVCViewerController)
+            install_poddefault_webhook(self.cluster.store)
+            self.cluster.add(ProfileController)
+            self.cluster.add(NotebookController)
+            self.cluster.add(TensorboardController)
+            self.volumes = self.cluster.add(
+                VolumeController, data_root=os.path.join(self.root, "volumes"))
+            self.cluster.add(PVCViewerController)
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -83,8 +107,9 @@ class Platform:
             self._started = False
         # release only our own DB — another live Platform in this process may
         # have installed its own default since
-        from kubeflow_tpu.hpo.observations import clear_default_db
-        clear_default_db(self.hpo_db)
+        if self.hpo_db is not None:
+            from kubeflow_tpu.hpo.observations import clear_default_db
+            clear_default_db(self.hpo_db)
 
     def __enter__(self) -> "Platform":
         return self.start()

@@ -8,6 +8,7 @@ client/server split:
 
     GET    /healthz
     GET    /version
+    GET    /metrics                    prometheus text exposition (§5.5)
     GET    /apis/{kind}?namespace=NS|_all&labelSelector=k=v,k2=v2
     GET    /apis/{kind}/{ns}/{name}
     POST   /apis                       body = resource JSON (apply semantics)
@@ -58,6 +59,15 @@ class ApiServer:
             def _error(self, code: int, reason: str, msg: str) -> None:
                 self._send(code, {"error": msg, "reason": reason})
 
+            def _send_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 outer._route(self, "GET")
 
@@ -98,6 +108,10 @@ class ApiServer:
                 h._send(200, {"ok": True})
             elif method == "GET" and parts == ["version"]:
                 h._send(200, {"version": __version__})
+            elif method == "GET" and parts == ["metrics"]:
+                from kubeflow_tpu.utils.metrics import REGISTRY
+
+                h._send_text(200, REGISTRY.render())
             elif parts[:1] == ["apis"]:
                 self._apis(h, method, parts[1:], q)
             elif method == "GET" and parts[:1] == ["logs"] and len(parts) == 3:

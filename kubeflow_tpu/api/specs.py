@@ -43,9 +43,11 @@ VALIDATORS: dict[str, Callable[[dict[str, Any]], list[str]]] = {
 
 
 def _register_framework_validators() -> None:
+    from kubeflow_tpu.api.kfdef import KFDEF_KIND, validate_kfdef
     from kubeflow_tpu.control.frameworks import job_validators
 
     VALIDATORS.update(job_validators())
+    VALIDATORS[KFDEF_KIND] = validate_kfdef
 
 
 _register_framework_validators()

@@ -58,6 +58,9 @@ def _build_parser() -> argparse.ArgumentParser:
     daemon.add_argument("--host", default="127.0.0.1")
     daemon.add_argument("--port", type=int, default=8443)
     daemon.add_argument("--devices", type=int, default=None)
+    daemon.add_argument("--kfdef", default=None,
+                        help="KfDef YAML selecting which component groups "
+                             "to deploy (kfctl apply analog)")
 
     apply = sub.add_parser("apply", help="apply -f file.yaml to the server")
     apply.add_argument("-f", "--filename", required=True, action="append")
@@ -93,6 +96,12 @@ def _build_parser() -> argparse.ArgumentParser:
     wait.add_argument("name")
     wait.add_argument("-n", "--namespace", default="default")
     wait.add_argument("--timeout", type=float, default=600.0)
+
+    init = sub.add_parser(
+        "init", help="scaffold a KfDef deployment dir (kfctl init analog)")
+    init.add_argument("directory")
+    init.add_argument("--name", default=None,
+                      help="deployment name (default: directory basename)")
 
     sub.add_parser("version", help="print version")
     return p
@@ -159,10 +168,37 @@ def _cmd_run(args, out) -> int:
     return rc
 
 
+def _cmd_init(args, out) -> int:
+    import yaml
+
+    from kubeflow_tpu.api.kfdef import default_kfdef
+
+    os.makedirs(args.directory, exist_ok=True)
+    path = os.path.join(args.directory, "kfdef.yaml")
+    if os.path.exists(path):
+        print(f"error: {path} already exists", file=out)
+        return 1
+    name = args.name or os.path.basename(os.path.abspath(args.directory))
+    with open(path, "w") as f:
+        yaml.safe_dump(default_kfdef(name), f, sort_keys=False)
+    print(f"wrote {path}\nnext: tpukctl daemon --kfdef {path}", file=out)
+    return 0
+
+
 def _cmd_daemon(args, out) -> int:
     from kubeflow_tpu.api.platform import Platform
     from kubeflow_tpu.api.server import ApiServer
-    with Platform(n_devices=args.devices) as p:
+
+    components = None
+    if args.kfdef:
+        import yaml
+
+        from kubeflow_tpu.api.kfdef import components_of
+
+        with open(args.kfdef) as f:
+            components = components_of(yaml.safe_load(f))
+        print(f"deploying components: {', '.join(components)}", file=out)
+    with Platform(n_devices=args.devices, components=components) as p:
         server = ApiServer(p, host=args.host, port=args.port).start()
         print(f"API server listening on {server.url}", file=out)
         try:
@@ -182,10 +218,12 @@ def main(argv: list[str] | None = None, out=None) -> int:
     if args.cmd == "version":
         print(f"tpukctl {__version__}", file=out)
         return 0
-    if args.cmd in ("run", "daemon"):
+    if args.cmd in ("run", "daemon", "init"):
         try:
             if args.cmd == "run":
                 return _cmd_run(args, out)
+            if args.cmd == "init":
+                return _cmd_init(args, out)
             return _cmd_daemon(args, out)
         except Exception as e:
             print(f"error: {e}", file=out)

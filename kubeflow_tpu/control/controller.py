@@ -20,6 +20,8 @@ from typing import Any
 
 from kubeflow_tpu.control.expectations import Expectations
 from kubeflow_tpu.control.store import ConflictError, ResourceStore
+from kubeflow_tpu.utils.metrics import (RECONCILE_DURATION, RECONCILE_TOTAL,
+                                        WORKQUEUE_DEPTH)
 
 log = logging.getLogger("kubeflow_tpu.control")
 
@@ -74,6 +76,10 @@ class _RateLimitedQueue:
                     if wait <= 0:
                         return None
                 self._cv.wait(wait)
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
 
     def shutdown(self) -> None:
         with self._cv:
@@ -157,19 +163,24 @@ class Controller:
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
             key = self.queue.get(timeout=1.0)
+            WORKQUEUE_DEPTH.set(self.queue.depth(), kind=self.kind)
             if key is None:
                 continue
             try:
-                ns, name = key.split("/", 1)
-                obj = self.store.try_get(self.kind, name, ns)
-                requeue = (self.reconcile(obj) if obj is not None
-                           else self.reconcile_deleted(name, ns))
+                with RECONCILE_DURATION.time(kind=self.kind):
+                    ns, name = key.split("/", 1)
+                    obj = self.store.try_get(self.kind, name, ns)
+                    requeue = (self.reconcile(obj) if obj is not None
+                               else self.reconcile_deleted(name, ns))
                 self.queue.forget(key)
+                RECONCILE_TOTAL.inc(kind=self.kind, result="success")
                 if requeue is not None:
                     self.queue.add(key, requeue)
             except ConflictError:
+                RECONCILE_TOTAL.inc(kind=self.kind, result="conflict")
                 self.queue.add_rate_limited(key)  # stale read; retry fast
             except Exception:
+                RECONCILE_TOTAL.inc(kind=self.kind, result="error")
                 log.error("reconcile %s %s failed:\n%s", self.kind, key,
                           traceback.format_exc())
                 self.queue.add_rate_limited(key)

@@ -47,6 +47,8 @@ from kubeflow_tpu.control.controller import Controller
 from kubeflow_tpu.control.scheduler import GROUP_LABEL
 from kubeflow_tpu.control.store import (AlreadyExistsError, NotFoundError,
                                         new_resource)
+from kubeflow_tpu.utils.metrics import (JOBS_CREATED, JOBS_FAILED,
+                                        JOBS_RESTARTED, JOBS_SUCCESSFUL)
 
 JOB_KIND = "JAXJob"
 JOB_NAME_LABEL = "kubeflow-tpu/job-name"
@@ -192,8 +194,10 @@ class JAXJobController(Controller):
             self.store.mutate(self.kind, name, lambda o: (
                 o["status"].update(startTime=time.time()),
                 set_condition(o["status"], JobConditionType.CREATED,
-                              "JobCreated", f"JAXJob {name} is created.")),
+                              "JobCreated",
+                              f"{self.kind} {name} is created.")),
                 ns)
+            JOBS_CREATED.inc(kind=self.kind)
             return 0.0
 
         run_policy = job["spec"].get("runPolicy", {})
@@ -266,6 +270,7 @@ class JAXJobController(Controller):
                     return None
                 total_restarts += 1
                 restarted = True
+                JOBS_RESTARTED.inc(kind=self.kind)
                 elastic = job["spec"].get("elasticPolicy")
                 if (elastic and rtype == "worker"
                         and eff["worker"] > elastic.get("minReplicas", 1)):
@@ -328,6 +333,7 @@ class JAXJobController(Controller):
                 set_condition(o["status"], JobConditionType.SUCCEEDED,
                               "JobSucceeded", "success policy satisfied")),
                 ns)
+            JOBS_SUCCESSFUL.inc(kind=self.kind)
             self._clean_pods(job)
             self._stop_coordinator(key)
             return 0.0
@@ -502,6 +508,7 @@ class JAXJobController(Controller):
 
     def _fail(self, job, reason: str, message: str) -> None:
         ns = job["metadata"].get("namespace", "default")
+        JOBS_FAILED.inc(kind=self.kind, reason=reason)
         self._stop_coordinator(self.key_of(job))
         try:
             self.store.mutate(self.kind, job["metadata"]["name"], lambda o: (

@@ -55,6 +55,10 @@ class TrainerConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 200
     keep_checkpoints: int = 3
+    # step-windowed jax.profiler capture (SURVEY.md §5.1); None disables
+    profile_dir: str | None = None
+    profile_start_step: int = 2
+    profile_num_steps: int = 3
 
 
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
@@ -224,13 +228,28 @@ class Trainer:
         steps_since_log = 0
         first_interval = True  # includes jit compile; flagged, not averaged in
         start_step = int(state["step"])
+        prof = None
+        if self.config.profile_dir:
+            from kubeflow_tpu.training.profiling import StepProfiler
+
+            # window is relative to THIS run's first step: on resume the
+            # compile happens again, and profile_start_step exists to skip it
+            prof = StepProfiler(self.config.profile_dir,
+                                start_step + self.config.profile_start_step,
+                                self.config.profile_num_steps)
         for i in range(num_steps):
             batch = self.shard_batch(next(data))
             if step_fn is None:
                 step_fn = self.compiled_step(state, batch)
-            state, metrics = step_fn(state, batch)
-            steps_since_log += 1
             step = start_step + i + 1
+            if prof is not None:
+                prof.maybe_start(step)
+            state, metrics = step_fn(state, batch)
+            if prof is not None:
+                # sync by fetching a scalar: on the tunneled TPU platform
+                # block_until_ready returns early, a fetch does not
+                prof.maybe_stop(step, sync=lambda: jax.device_get(metrics))
+            steps_since_log += 1
             if step % self.config.log_every == 0 or i == num_steps - 1:
                 metrics = jax.device_get(metrics)
                 now = time.perf_counter()
@@ -248,6 +267,8 @@ class Trainer:
             if ckpt is not None:
                 # manager applies save_interval_steps; final step forced below
                 ckpt.save(step, state)
+        if prof is not None:
+            prof.close()
         if ckpt is not None:
             final = start_step + num_steps
             if ckpt.latest_step() != final:  # interval may have saved it already

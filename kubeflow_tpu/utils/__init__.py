@@ -1,6 +1,6 @@
-"""Shared utilities: cron parsing, (more to come: prometheus-style metrics
-registry, yaml spec loading)."""
+"""Shared utilities: cron parsing, prometheus-style metrics registry."""
 
-from kubeflow_tpu.utils import cron
+from kubeflow_tpu.utils import cron, metrics
+from kubeflow_tpu.utils.metrics import REGISTRY, Registry
 
-__all__ = ["cron"]
+__all__ = ["cron", "metrics", "REGISTRY", "Registry"]

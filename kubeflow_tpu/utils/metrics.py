@@ -1,0 +1,229 @@
+"""Prometheus-style metrics registry — the controller-runtime metrics
+endpoint analog (SURVEY.md §5.5: workqueue depth, reconcile durations,
+jobs created/successful/failed/restarted ⊘ kubeflow/common `metrics.go`,
+controller-runtime `pkg/metrics`).
+
+Text exposition only (the scrape format), no client library dependency:
+
+    registry.counter("jobs_created_total", "desc", ["kind"]).inc(kind="TFJob")
+    registry.render()  ->  "# HELP ...\n# TYPE ...\njobs_created_total{...} 1"
+
+Thread-safe; one process-global `REGISTRY` plus injectable instances for
+tests. Served by api/server.py at GET /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterable
+
+
+def _escape(value: str) -> str:
+    """Label-value escaping per the text exposition format."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(value: float) -> str:
+    """Full-precision exposition (the %g shortcut corrupts counters past
+    1e6): integers render bare, floats via repr."""
+    if value == int(value) and abs(value) < 2**53:
+        return str(int(value))
+    return repr(float(value))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Iterable[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.label_names)}")
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _labeled(self, key: tuple[str, ...]) -> dict[str, str]:
+        return dict(zip(self.label_names, key))
+
+    def samples(self) -> list[tuple[str, dict[str, str], float]]:
+        with self._lock:
+            return [(self.name, self._labeled(k), v)
+                    for k, v in sorted(self._values.items())]
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for name, labels, value in self.samples():
+            lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (the prometheus shape: _bucket{le=},
+    _sum, _count)."""
+
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                       30.0, 60.0)
+
+    def __init__(self, name, help_, label_names, buckets=None):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels: str):
+        """Context manager: observes elapsed seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+
+        return _Timer()
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key in sorted(self._totals):
+                base = self._labeled(key)
+                for i, b in enumerate(self.buckets):
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels({**base, 'le': f'{b:g}'})} "
+                        f"{self._counts[key][i]}")
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})}"
+                    f" {self._totals[key]}")
+                lines.append(f"{self.name}_sum{_fmt_labels(base)} "
+                             f"{_fmt_value(self._sums[key])}")
+                lines.append(f"{self.name}_count{_fmt_labels(base)} "
+                             f"{self._totals[key]}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_make(self, cls, name: str, help_: str, label_names,
+                     **kwargs) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, label_names or (), **kwargs)
+                self._metrics[name] = m
+                return m
+            if not isinstance(m, cls):
+                raise ValueError(f"{name} already registered as {m.kind}")
+            if m.label_names != tuple(label_names or ()):
+                raise ValueError(
+                    f"{name} already registered with labels "
+                    f"{list(m.label_names)}, not {list(label_names or ())}")
+            buckets = kwargs.get("buckets")
+            if buckets is not None and tuple(sorted(buckets)) != m.buckets:
+                raise ValueError(
+                    f"{name} already registered with buckets {m.buckets}")
+            return m
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self._get_or_make(Counter, name, help_, label_names)
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self._get_or_make(Gauge, name, help_, label_names)
+
+    def histogram(self, name, help_="", label_names=(),
+                  buckets=None) -> Histogram:
+        return self._get_or_make(Histogram, name, help_, label_names,
+                                 buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- control-plane instruments (kubeflow/common metrics.go analog) -----------
+
+RECONCILE_TOTAL = REGISTRY.counter(
+    "controller_reconcile_total", "Reconcile invocations", ["kind", "result"])
+RECONCILE_DURATION = REGISTRY.histogram(
+    "controller_reconcile_duration_seconds", "Reconcile latency", ["kind"])
+WORKQUEUE_DEPTH = REGISTRY.gauge(
+    "controller_workqueue_depth", "Pending keys in the workqueue", ["kind"])
+JOBS_CREATED = REGISTRY.counter(
+    "training_jobs_created_total", "Jobs that entered Created", ["kind"])
+JOBS_SUCCESSFUL = REGISTRY.counter(
+    "training_jobs_successful_total", "Jobs that Succeeded", ["kind"])
+JOBS_FAILED = REGISTRY.counter(
+    "training_jobs_failed_total", "Jobs that Failed", ["kind", "reason"])
+JOBS_RESTARTED = REGISTRY.counter(
+    "training_jobs_restarted_total", "Pod restarts across jobs", ["kind"])
