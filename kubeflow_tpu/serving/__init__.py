@@ -19,13 +19,15 @@ from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
 from kubeflow_tpu.serving.router import Router
 from kubeflow_tpu.serving.server import ModelServer
 from kubeflow_tpu.serving.storage import StorageError, download
+from kubeflow_tpu.serving.agent import MultiModelAgent, PayloadLogger
 from kubeflow_tpu.serving import llm_runtime as _llm_runtime  # noqa: F401
 # ^ imported for its @serving_runtime("llama") registration side effect
 
 __all__ = [
     "DynamicBatcher", "FunctionModel", "ISVC_KIND", "InferRequest",
     "InferResponse", "InferTensor", "InferenceServiceController", "Model",
-    "ModelError", "ModelRepository", "ModelServer", "ProtocolError",
+    "ModelError", "ModelRepository", "ModelServer", "MultiModelAgent",
+    "PayloadLogger", "ProtocolError",
     "Router", "StorageError", "download", "load_model", "serving_runtime",
     "v1_decode", "v1_encode", "validate_isvc",
 ]

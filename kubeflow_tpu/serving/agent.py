@@ -1,0 +1,202 @@
+"""Serving agent — payload logging + multi-model puller (SURVEY.md §2.4
+agent row: ⊘ kserve `pkg/agent` logger/batcher/puller; the batcher lives in
+serving/batching.py).
+
+PayloadLogger: per-request JSONL records (the kserve logger sidecar emits
+CloudEvents to a logUrl; here the sink is a JSONL file or an HTTP endpoint).
+Configured per InferenceService via spec.predictor.logger:
+
+    logger:
+      mode: all | request | response
+      path: /var/log/isvc.jsonl        # or url: http://collector/...
+
+MultiModelAgent: pull-on-demand model registry with LRU eviction — the
+high-density multi-model pattern (⊘ kserve agent puller + ModelMesh):
+models are downloaded (storage.download), instantiated through the
+serving-runtime registry, and evicted least-recently-used past
+`max_loaded`.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+from typing import Any
+
+from kubeflow_tpu.serving.model import (Model, ModelError, ModelRepository,
+                                        load_model)
+from kubeflow_tpu.serving.storage import download
+
+
+class PayloadLogger:
+    """Thread-safe JSONL payload log. `mode` picks which halves to record."""
+
+    def __init__(self, path: str | None = None, url: str | None = None,
+                 mode: str = "all"):
+        if mode not in ("all", "request", "response"):
+            raise ValueError(f"logger mode {mode!r} invalid")
+        if not path and not url:
+            raise ValueError("logger needs path or url")
+        self.path = path
+        self.url = url
+        self.mode = mode
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._queue: queue.Queue | None = None
+        if url:
+            # the url sink must not sit on the inference hot path (kserve's
+            # logger is an async sidecar): a worker thread drains a queue
+            self._queue = queue.Queue(maxsize=1024)
+            threading.Thread(target=self._url_worker, daemon=True,
+                             name="payload-logger").start()
+
+    def _emit(self, record: dict[str, Any]) -> None:
+        # logging must never fail (or slow) the inference path: every sink
+        # error is swallowed, and the url sink is async
+        try:
+            line = json.dumps(record, default=str)
+        except Exception:
+            return
+        if self.path:
+            try:
+                with self._lock:
+                    with open(self.path, "a") as f:
+                        f.write(line + "\n")
+            except Exception:
+                pass
+        if self._queue is not None:
+            try:
+                self._queue.put_nowait(line)
+            except queue.Full:
+                pass  # shed log load before shedding inference load
+
+    def _url_worker(self) -> None:
+        while True:
+            line = self._queue.get()
+            try:
+                req = urllib.request.Request(
+                    self.url, data=line.encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2.0):
+                    pass
+            except Exception:
+                pass
+            finally:
+                self._queue.task_done()
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for queued url-sink records (tests / shutdown)."""
+        if self._queue is None:
+            return
+        deadline = time.monotonic() + timeout
+        while not self._queue.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def next_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"req-{self._seq}"
+
+    def log_request(self, model: str, request_id: str,
+                    payload: Any) -> None:
+        if self.mode in ("all", "request"):
+            self._emit({"ts": time.time(), "id": request_id, "model": model,
+                        "type": "request", "payload": payload})
+
+    def log_response(self, model: str, request_id: str, payload: Any,
+                     latency_ms: float, status: int = 200) -> None:
+        if self.mode in ("all", "response"):
+            self._emit({"ts": time.time(), "id": request_id, "model": model,
+                        "type": "response", "status": status,
+                        "latency_ms": round(latency_ms, 3),
+                        "payload": payload})
+
+
+class MultiModelAgent:
+    """Pull/evict manager over a ModelRepository.
+
+    pull() is idempotent per name; predict-path callers `touch()` names so
+    eviction tracks recency. Models currently loading are never evicted
+    mid-load (the lock covers the registry bookkeeping, not load itself —
+    loads run outside it so a slow load doesn't block serving others).
+    """
+
+    def __init__(self, repository: ModelRepository | None = None,
+                 max_loaded: int = 4, storage_root: str | None = None):
+        if max_loaded < 1:
+            raise ValueError("max_loaded must be >= 1")
+        self.repository = repository or ModelRepository()
+        self.max_loaded = max_loaded
+        self.storage_root = storage_root
+        self._lock = threading.Lock()
+        self._last_used: dict[str, float] = {}
+        self._loading: set[str] = set()
+        self.pulls = 0
+        self.evictions = 0
+
+    def pull(self, name: str, model_format: str, uri: str | None = None,
+             **config: Any) -> Model:
+        """Download + load + register; evicts LRU past max_loaded."""
+        with self._lock:
+            try:
+                existing = self.repository.get(name)
+            except ModelError:
+                existing = None
+            if existing is not None or name in self._loading:
+                self._last_used[name] = time.monotonic()
+                if existing is not None:
+                    return existing
+                raise ModelError(f"model {name!r} is still loading")
+            self._loading.add(name)
+        try:
+            local = uri
+            if uri and "://" in uri:
+                local = download(uri, artifact_root=self.storage_root)
+            model = load_model(model_format, name, local, **config)
+            self.repository.register(model)  # loads the model
+            with self._lock:
+                self.pulls += 1
+                self._loading.discard(name)
+                self._last_used[name] = time.monotonic()
+            self._evict_over_capacity()
+            return model
+        except BaseException:
+            with self._lock:
+                self._loading.discard(name)
+            raise
+
+    def touch(self, name: str) -> None:
+        with self._lock:
+            if name in self._last_used:
+                self._last_used[name] = time.monotonic()
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            self._last_used.pop(name, None)
+        self.repository.unload(name)
+
+    def loaded(self) -> list[str]:
+        return self.repository.names()
+
+    def _evict_over_capacity(self) -> None:
+        while True:
+            with self._lock:
+                names = self.repository.names()
+                if len(names) <= self.max_loaded:
+                    return
+                # oldest by last use; names never touched sort first
+                victim = min(
+                    (n for n in names if n not in self._loading),
+                    key=lambda n: self._last_used.get(n, 0.0),
+                    default=None)
+                if victim is None:
+                    return
+                self._last_used.pop(victim, None)
+                self.evictions += 1
+                # unload INSIDE the lock: selection + removal must be atomic
+                # against a concurrent pull() returning the victim (which
+                # would also refresh its timestamp and dodge selection)
+                self.repository.unload(victim)

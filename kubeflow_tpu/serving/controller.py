@@ -59,6 +59,14 @@ def validate_isvc(isvc: dict[str, Any]) -> list[str]:
         errs.append("canaryTrafficPercent must be an int in [0,100]")
     if pct > 0 and not spec.get("canary", {}).get("model"):
         errs.append("canaryTrafficPercent > 0 requires spec.canary.model")
+    for comp in ("predictor", "canary", "transformer"):
+        lg = spec.get(comp, {}).get("logger")
+        if lg is None:
+            continue
+        if not lg.get("path") and not lg.get("url"):
+            errs.append(f"spec.{comp}.logger needs path or url")
+        if lg.get("mode", "all") not in ("all", "request", "response"):
+            errs.append(f"spec.{comp}.logger.mode invalid: {lg.get('mode')}")
     return errs
 
 
@@ -164,7 +172,7 @@ class InferenceServiceController(Controller):
                 canary = self._reconcile_component(isvc, "canary",
                                                    canary_spec, lazy=False)
         except (ModelError, storage.StorageError, ImportError,
-                AttributeError) as e:
+                AttributeError, TypeError, ValueError) as e:
             self.store.mutate(ISVC_KIND, name, lambda o: set_condition(
                 o["status"], JobConditionType.FAILED, "ModelLoadFailed",
                 str(e)), ns)
@@ -223,9 +231,17 @@ class InferenceServiceController(Controller):
         repo = ModelRepository()
         repo.register(model)   # loads; raises on failure
         batching = comp_spec.get("batching")
+        logger = None
+        if comp_spec.get("logger"):
+            from kubeflow_tpu.serving.agent import PayloadLogger
+
+            lg = comp_spec["logger"]
+            logger = PayloadLogger(path=lg.get("path"), url=lg.get("url"),
+                                   mode=lg.get("mode", "all"))
         server = ModelServer(
             repo, name=f"{name}-{component}",
-            batching={model.name: batching} if batching else None)
+            batching={model.name: batching} if batching else None,
+            payload_logger=logger)
         server.start()
         inst = _Instance(name, component, self._revision_of(comp_spec),
                          server)

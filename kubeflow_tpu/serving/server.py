@@ -35,9 +35,11 @@ from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
 class ModelServer:
     def __init__(self, repository: ModelRepository | None = None,
                  port: int = 0, name: str = "kubeflow-tpu-server",
-                 batching: dict[str, Any] | None = None):
+                 batching: dict[str, Any] | None = None,
+                 payload_logger: Any | None = None):
         self.repository = repository or ModelRepository()
         self.name = name
+        self.payload_logger = payload_logger  # serving/agent.PayloadLogger
         self._batchers: dict[str, DynamicBatcher] = {}
         self._batch_cfg = batching or {}
         self._metrics_lock = threading.Lock()
@@ -171,36 +173,80 @@ class ModelServer:
             self.request_count[key] = self.request_count.get(key, 0) + 1
             self.latency_sum[model] = self.latency_sum.get(model, 0.0) + dt
 
+    def _logged(self, name: str, t0: float, code: int,
+                resp: dict[str, Any], rid: str | None
+                ) -> tuple[int, dict[str, Any]]:
+        if self.payload_logger is not None and rid is not None:
+            self.payload_logger.log_response(
+                name, rid, resp, (time.perf_counter() - t0) * 1e3, code)
+        return code, resp
+
+    def _log_request(self, name: str, body: dict[str, Any]) -> str | None:
+        if self.payload_logger is None:
+            return None
+        rid = self.payload_logger.next_id()
+        self.payload_logger.log_request(name, rid, body)
+        return rid
+
+    def _log_error(self, name: str, t0: float, rid: str | None,
+                   exc: Exception) -> None:
+        """Pair error responses with their request records (the exception is
+        converted to an HTTP status by _handle_post; mirror that here)."""
+        if self.payload_logger is None or rid is None:
+            return
+        code = (400 if isinstance(exc, ProtocolError)
+                else 404 if isinstance(exc, ModelError) else 500)
+        self._logged(name, t0, code, {"error": str(exc)}, rid)
+
     def _v1(self, name: str, verb: str, body: dict[str, Any]
             ) -> tuple[int, dict[str, Any]]:
-        model = self.repository.get(name)
-        if not model.ready:
-            return 503, {"error": f"model {name!r} not ready"}
-        instances = v1_decode(body)
+        rid = self._log_request(name, body)
         t0 = time.perf_counter()
-        payload = model.preprocess(instances)
-        if verb == "predict":
-            result = self._predictor(model)(payload)
-        elif verb == "explain":
-            result = model.explain(payload)
-        else:
-            return 400, {"error": f"unknown verb {verb!r}"}
-        result = model.postprocess(result)
-        self._observe(name, verb, time.perf_counter() - t0)
-        return 200, v1_encode(result)
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                return self._logged(name, t0, 503,
+                                    {"error": f"model {name!r} not ready"},
+                                    rid)
+            instances = v1_decode(body)
+            t_infer = time.perf_counter()  # /metrics latency excludes decode
+            payload = model.preprocess(instances)
+            if verb == "predict":
+                result = self._predictor(model)(payload)
+            elif verb == "explain":
+                result = model.explain(payload)
+            else:
+                return self._logged(name, t0, 400,
+                                    {"error": f"unknown verb {verb!r}"}, rid)
+            result = model.postprocess(result)
+            self._observe(name, verb, time.perf_counter() - t_infer)
+            return self._logged(name, t0, 200, v1_encode(result), rid)
+        except Exception as e:
+            self._log_error(name, t0, rid, e)
+            raise
 
     def _v2_infer(self, name: str, body: dict[str, Any]
                   ) -> tuple[int, dict[str, Any]]:
-        model = self.repository.get(name)
-        if not model.ready:
-            return 503, {"error": f"model {name!r} not ready"}
-        req = InferRequest.from_json(name, body)
+        rid = self._log_request(name, body)
         t0 = time.perf_counter()
-        payload = model.preprocess(req.as_dict())
-        result = model.postprocess(self._predictor(model)(payload))
-        self._observe(name, "infer", time.perf_counter() - t0)
-        return 200, InferResponse.from_result(name, result,
-                                              id=req.id).to_json()
+        try:
+            model = self.repository.get(name)
+            if not model.ready:
+                return self._logged(name, t0, 503,
+                                    {"error": f"model {name!r} not ready"},
+                                    rid)
+            req = InferRequest.from_json(name, body)
+            t_infer = time.perf_counter()
+            payload = model.preprocess(req.as_dict())
+            result = model.postprocess(self._predictor(model)(payload))
+            self._observe(name, "infer", time.perf_counter() - t_infer)
+            return self._logged(
+                name, t0, 200,
+                InferResponse.from_result(name, result, id=req.id).to_json(),
+                rid)
+        except Exception as e:
+            self._log_error(name, t0, rid, e)
+            raise
 
     # -- metrics --------------------------------------------------------------
 

@@ -1,6 +1,10 @@
 from kubeflow_tpu.training.trainer import OptimizerConfig, Trainer, TrainerConfig
 from kubeflow_tpu.training.metrics_writer import MetricsWriter, read_metrics
 from kubeflow_tpu.training.checkpoint import CheckpointManager, restore_or_init
+from kubeflow_tpu.training.loader import (NativeTokenLoader, PyTokenLoader,
+                                          token_file_dataset, write_corpus)
 
 __all__ = ["Trainer", "TrainerConfig", "OptimizerConfig", "MetricsWriter",
-           "read_metrics", "CheckpointManager", "restore_or_init"]
+           "read_metrics", "CheckpointManager", "restore_or_init",
+           "NativeTokenLoader", "PyTokenLoader", "token_file_dataset",
+           "write_corpus"]

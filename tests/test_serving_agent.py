@@ -1,0 +1,175 @@
+"""Serving agent: payload logging through the model server, multi-model
+pull/evict (kserve pkg/agent + ModelMesh analogs, SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.serving import (FunctionModel, ModelRepository, ModelServer,
+                                  MultiModelAgent, PayloadLogger)
+from kubeflow_tpu.serving.model import ModelError, serving_runtime
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_payload_logger_records_request_and_response(tmp_path):
+    log = str(tmp_path / "payloads.jsonl")
+    repo = ModelRepository()
+    repo.register(FunctionModel("double", lambda xs: [2 * x for x in xs]))
+    server = ModelServer(repo, payload_logger=PayloadLogger(path=log)).start()
+    try:
+        out = _post(server.url + "/v1/models/double:predict",
+                    {"instances": [1, 2]})
+        assert out["predictions"] == [2, 4]
+    finally:
+        server.stop()
+    records = [json.loads(line) for line in open(log)]
+    assert [r["type"] for r in records] == ["request", "response"]
+    req, resp = records
+    assert req["payload"] == {"instances": [1, 2]}
+    assert req["id"] == resp["id"]
+    assert resp["status"] == 200 and resp["latency_ms"] >= 0
+    assert resp["payload"] == {"predictions": [2, 4]}
+
+
+def test_payload_logger_pairs_error_responses(tmp_path):
+    """ProtocolError/ModelError paths still emit a response record, and a
+    broken file sink never fails the inference path."""
+    log = str(tmp_path / "err.jsonl")
+    repo = ModelRepository()
+    repo.register(FunctionModel("ok", lambda xs: xs))
+    server = ModelServer(repo, payload_logger=PayloadLogger(path=log)).start()
+    try:
+        # unknown model -> 404, logged as response status 404
+        try:
+            _post(server.url + "/v1/models/nope:predict", {"instances": [1]})
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # malformed v1 body -> 400
+        try:
+            _post(server.url + "/v1/models/ok:predict", {"wrong": 1})
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # sink breakage must not break serving
+        server.payload_logger.path = str(tmp_path / "gone" / "x.jsonl")
+        out = _post(server.url + "/v1/models/ok:predict", {"instances": [7]})
+        assert out["predictions"] == [7]
+    finally:
+        server.stop()
+    records = [json.loads(line) for line in open(log)]
+    by_type = {}
+    for r in records:
+        by_type.setdefault(r["type"], []).append(r)
+    statuses = sorted(r["status"] for r in by_type["response"])
+    assert statuses == [400, 404]
+    req_ids = {r["id"] for r in by_type["request"]}
+    assert all(r["id"] in req_ids for r in by_type["response"])
+
+
+def test_invalid_logger_spec_rejected():
+    from kubeflow_tpu.serving import validate_isvc
+
+    errs = validate_isvc({"spec": {"predictor": {
+        "model": {"modelFormat": "echo"}, "logger": {}}}})
+    assert any("logger needs path or url" in e for e in errs)
+    errs = validate_isvc({"spec": {"predictor": {
+        "model": {"modelFormat": "echo"},
+        "logger": {"path": "/x", "mode": "bogus"}}}})
+    assert any("mode invalid" in e for e in errs)
+
+
+def test_payload_logger_modes_and_errors(tmp_path):
+    log = str(tmp_path / "p.jsonl")
+    lg = PayloadLogger(path=log, mode="response")
+    lg.log_request("m", "r1", {"x": 1})
+    lg.log_response("m", "r1", {"y": 2}, 1.5, 200)
+    records = [json.loads(line) for line in open(log)]
+    assert len(records) == 1 and records[0]["type"] == "response"
+    with pytest.raises(ValueError):
+        PayloadLogger(path=log, mode="nope")
+    with pytest.raises(ValueError):
+        PayloadLogger()
+
+
+_loads: list[str] = []
+_unloads: list[str] = []
+
+
+@serving_runtime("tracked")
+def _tracked(name, uri=None, **config):
+    class _M(FunctionModel):
+        def load(self):
+            _loads.append(self.name)
+            super().load()
+
+        def unload(self):
+            _unloads.append(self.name)
+            super().unload()
+
+    return _M(name, lambda x: x)
+
+
+def test_multi_model_agent_pull_and_lru_evict():
+    _loads.clear()
+    _unloads.clear()
+    agent = MultiModelAgent(max_loaded=2)
+    agent.pull("a", "tracked")
+    agent.pull("b", "tracked")
+    agent.touch("a")          # b becomes LRU
+    agent.pull("c", "tracked")
+    assert sorted(agent.loaded()) == ["a", "c"]
+    assert _unloads == ["b"]
+    assert agent.pulls == 3 and agent.evictions == 1
+    # pulling an already-loaded model is a no-op returning the instance
+    m = agent.pull("a", "tracked")
+    assert m.name == "a" and agent.pulls == 3
+    agent.unload("a")
+    assert agent.loaded() == ["c"]
+
+
+def test_multi_model_agent_pull_failure_releases_slot():
+    @serving_runtime("boom")
+    def _boom(name, uri=None, **config):
+        raise RuntimeError("load failed")
+
+    agent = MultiModelAgent(max_loaded=2)
+    with pytest.raises(RuntimeError):
+        agent.pull("x", "boom")
+    # the failed name is not wedged in the loading set
+    agent.pull("x", "tracked")
+    assert agent.loaded() == ["x"]
+
+
+def test_isvc_logger_spec_wires_payload_log(tmp_path):
+    from kubeflow_tpu.control import Cluster, new_resource
+    from kubeflow_tpu import serving
+
+    log = str(tmp_path / "isvc.jsonl")
+    c = Cluster(n_devices=2)
+    c.add(serving.InferenceServiceController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "echo2", spec={
+            "predictor": {"model": {"modelFormat": "echo"},
+                          "logger": {"path": log},
+                          "minReplicas": 1},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "echo2",
+            lambda o: any(cond.get("type") == "Ready"
+                          for cond in o["status"].get("conditions", [])),
+            timeout=30)
+        out = _post(isvc["status"]["url"] + "/v1/models/echo2:predict",
+                    {"instances": [5]})
+        assert out["predictions"] == [5]
+    records = [json.loads(line) for line in open(log)]
+    assert {r["type"] for r in records} == {"request", "response"}
