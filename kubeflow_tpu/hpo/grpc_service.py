@@ -1,0 +1,268 @@
+"""Suggestion service over gRPC — Katib's per-experiment algorithm
+Deployment + `GetSuggestions` API (SURVEY.md §2.3 ⊘ katib
+`pkg/suggestion/v1beta1/*` services, `suggestion_controller.go` gRPC
+client). The in-process suggestion controller uses the algorithms
+directly; this service is the out-of-process deployment shape — the same
+algorithm registry behind the same wire API the reference uses, so an
+external experiment controller (or the reference's, pointed here) can
+drive this framework's algorithms.
+
+Like serving/grpc_server.py, service wiring is hand-registered (no
+grpcio-tools in the image) over protoc-generated messages
+(hpo/protos/suggestion_pb2.py).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Any
+
+from kubeflow_tpu.hpo.algorithms import TrialResult, make_algorithm
+from kubeflow_tpu.hpo.protos import suggestion_pb2 as pb
+from kubeflow_tpu.hpo.space import SearchSpace, SpaceError
+
+SERVICE = "suggestion.Suggestion"
+
+
+def _space_from_pb(exp: "pb.ExperimentSpec") -> SearchSpace:
+    specs = []
+    for p in exp.parameters:
+        fs: dict[str, Any] = {}
+        if p.feasible_space.min:
+            fs["min"] = p.feasible_space.min
+        if p.feasible_space.max:
+            fs["max"] = p.feasible_space.max
+        if p.feasible_space.step:
+            fs["step"] = p.feasible_space.step
+        if p.feasible_space.scale:
+            fs["scale"] = p.feasible_space.scale
+        if p.feasible_space.list:
+            fs["list"] = list(p.feasible_space.list)
+        specs.append({"name": p.name,
+                      "parameterType": p.parameter_type or "double",
+                      "feasibleSpace": fs})
+    return SearchSpace.parse(specs)
+
+
+def _cast_param(param, s: str) -> Any:
+    """Wire string -> the parameter's value domain. Categorical/discrete
+    values must round-trip to the SPACE's choice objects (a numeric-looking
+    categorical string like "1" must stay the space's choice, not int 1)."""
+    if param.type == "double":
+        return float(s)
+    if param.type == "int":
+        return int(float(s))
+    for c in param.values:
+        if str(c) == s:
+            return c
+    return s
+
+
+def _history_from_pb(space: SearchSpace, exp: "pb.ExperimentSpec",
+                     trials) -> list[TrialResult]:
+    # algorithms minimize; negate for maximize objectives (the experiment
+    # controller's convention, hpo/algorithms/base.py)
+    sign = -1.0 if exp.objective_type == "maximize" else 1.0
+    by_name = {p.name: p for p in space.parameters}
+    out = []
+    for t in trials:
+        params = {}
+        for a in t.parameter_assignments:
+            p = by_name.get(a.name)
+            params[a.name] = _cast_param(p, a.value) if p else a.value
+        value = sign * t.objective_value if t.has_objective else None
+        out.append(TrialResult(params=params, value=value,
+                               status=t.status or "Succeeded"))
+    return out
+
+
+class SuggestionService:
+    """gRPC server hosting the suggestion-algorithm registry.
+
+    Stateful algorithms (CMA-ES, hyperband) are cached per experiment name
+    so repeated GetSuggestions calls continue one optimization — Katib's
+    per-experiment service Deployment has the same lifetime semantics.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 max_workers: int = 4):
+        import grpc
+        import threading
+
+        self._grpc = grpc
+        self._algorithms: dict[str, Any] = {}
+        # one lock for cache AND suggest: stateful algorithms (CMA-ES,
+        # hyperband) are not thread-safe, and two concurrent first calls
+        # must not each construct (and half-discard) an instance
+        self._algo_lock = threading.Lock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "GetSuggestions": grpc.unary_unary_rpc_method_handler(
+                self._get_suggestions,
+                request_deserializer=pb.GetSuggestionsRequest.FromString,
+                response_serializer=pb.GetSuggestionsReply.SerializeToString),
+            "ValidateAlgorithmSettings": grpc.unary_unary_rpc_method_handler(
+                self._validate,
+                request_deserializer=(
+                    pb.ValidateAlgorithmSettingsRequest.FromString),
+                response_serializer=(
+                    pb.ValidateAlgorithmSettingsReply.SerializeToString)),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "SuggestionService":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace).wait()
+
+    def _algorithm(self, exp: "pb.ExperimentSpec"):
+        key = exp.name or "_anonymous"
+        algo = self._algorithms.get(key)
+        if algo is None:
+            settings = {s.name: s.value for s in exp.algorithm_settings}
+            algo = make_algorithm(exp.algorithm_name or "random",
+                                  _space_from_pb(exp), settings,
+                                  seed=int(exp.seed))
+            self._algorithms[key] = algo
+        return algo
+
+    def _get_suggestions(self, request, context):
+        try:
+            with self._algo_lock:
+                algo = self._algorithm(request.experiment)
+                history = _history_from_pb(algo.space, request.experiment,
+                                           request.trials)
+                n = max(1, request.current_request_number)
+                assignments = algo.suggest(n, history)
+        except (SpaceError, KeyError, ValueError) as e:
+            context.abort(self._grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        reply = pb.GetSuggestionsReply()
+        for a in assignments:
+            s = reply.suggestions.add()
+            for name, value in a.items():
+                pa = s.parameter_assignments.add()
+                pa.name = name
+                pa.value = str(value)
+        return reply
+
+    def _validate(self, request, context):
+        try:
+            settings = {s.name: s.value
+                        for s in request.experiment.algorithm_settings}
+            make_algorithm(request.experiment.algorithm_name or "random",
+                           _space_from_pb(request.experiment), settings,
+                           seed=int(request.experiment.seed))
+            return pb.ValidateAlgorithmSettingsReply(error="")
+        except (SpaceError, KeyError, ValueError) as e:
+            return pb.ValidateAlgorithmSettingsReply(error=str(e))
+
+
+class SuggestionClient:
+    """The suggestion-controller side of the wire (⊘ katib
+    suggestion_controller.go `SyncSuggestion` gRPC client)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self.timeout = timeout
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE}/GetSuggestions",
+            request_serializer=pb.GetSuggestionsRequest.SerializeToString,
+            response_deserializer=pb.GetSuggestionsReply.FromString)
+        self._validate = self._channel.unary_unary(
+            f"/{SERVICE}/ValidateAlgorithmSettings",
+            request_serializer=(
+                pb.ValidateAlgorithmSettingsRequest.SerializeToString),
+            response_deserializer=pb.ValidateAlgorithmSettingsReply.FromString)
+
+    @staticmethod
+    def _fill_experiment(e: "pb.ExperimentSpec",
+                         experiment: dict[str, Any]) -> None:
+        e.name = experiment.get("name", "")
+        e.algorithm_name = experiment.get("algorithm", "random")
+        e.objective_type = experiment.get("objectiveType", "minimize")
+        e.seed = int(experiment.get("seed", 0))
+        for k, v in (experiment.get("settings") or {}).items():
+            s = e.algorithm_settings.add()
+            s.name, s.value = k, str(v)
+        for p in experiment.get("parameters", []):
+            ps = e.parameters.add()
+            ps.name = p["name"]
+            ps.parameter_type = p.get("parameterType", "double")
+            fs = p.get("feasibleSpace", {})
+            for attr in ("min", "max", "step", "scale"):
+                if fs.get(attr) is not None:
+                    setattr(ps.feasible_space, attr, str(fs[attr]))
+            for v in fs.get("list", []):
+                ps.feasible_space.list.append(str(v))
+
+    def _cast_reply(self, experiment: dict[str, Any], name: str,
+                    value: str) -> Any:
+        for p in experiment.get("parameters", []):
+            if p["name"] != name:
+                continue
+            ptype = p.get("parameterType", "double")
+            if ptype == "double":
+                return float(value)
+            if ptype == "int":
+                return int(float(value))
+            # categorical/discrete: return the caller's original choice
+            # object whose string form matches the wire value; discrete
+            # values are floats server-side ("128" arrives as "128.0"),
+            # so fall back to numeric equality
+            choices = p.get("feasibleSpace", {}).get("list", [])
+            for c in choices:
+                if str(c) == value:
+                    return c
+            try:
+                fv = float(value)
+            except ValueError:
+                return value
+            for c in choices:
+                try:
+                    if float(c) == fv:
+                        return c
+                except (TypeError, ValueError):
+                    continue
+        return value
+
+    def get_suggestions(self, experiment: dict[str, Any],
+                        trials: list[dict[str, Any]],
+                        count: int) -> list[dict[str, Any]]:
+        """experiment: {name, algorithm, settings, parameters(Katib-shaped),
+        objectiveType, seed}; trials: [{params, value|None, status}]."""
+        req = pb.GetSuggestionsRequest(current_request_number=count)
+        self._fill_experiment(req.experiment, experiment)
+        for t in trials:
+            pt = req.trials.add()
+            pt.name = t.get("name", "")
+            pt.status = t.get("status", "Succeeded")
+            if t.get("value") is not None:
+                pt.objective_value = float(t["value"])
+                pt.has_objective = True
+            for k, v in t.get("params", {}).items():
+                a = pt.parameter_assignments.add()
+                a.name, a.value = k, str(v)
+        reply = self._get(req, timeout=self.timeout)
+        return [{a.name: self._cast_reply(experiment, a.name, a.value)
+                 for a in s.parameter_assignments}
+                for s in reply.suggestions]
+
+    def validate(self, experiment: dict[str, Any]) -> str:
+        req = pb.ValidateAlgorithmSettingsRequest()
+        self._fill_experiment(req.experiment, experiment)
+        return self._validate(req, timeout=self.timeout).error
+
+    def close(self) -> None:
+        self._channel.close()

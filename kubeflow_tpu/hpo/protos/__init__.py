@@ -1,0 +1,4 @@
+"""protoc-generated Katib suggestion-service messages (suggestion.proto).
+
+Regenerate: scripts/gen_protos.sh.
+"""

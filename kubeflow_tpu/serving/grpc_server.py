@@ -1,0 +1,265 @@
+"""Open Inference Protocol gRPC dataplane (SURVEY.md §2.4/§2.6: the
+reference serves V2 over both REST and gRPC — kserve `kserve/protocol/grpc`,
+Triton's GRPCInferenceService; SURVEY §2.2 keeps gRPC as the native control-
+plane transport since grpcio's C++ core is in the image).
+
+No grpcio-tools in the image, so service wiring is hand-registered with
+`grpc.method_handlers_generic_handler` over protoc-generated message
+classes (kubeflow_tpu/serving/protos/inference_pb2.py — regenerate with
+scripts/gen_protos.sh).
+
+The server shares ModelRepository/DynamicBatcher semantics with the HTTP
+ModelServer: same models, same predict path, two dataplanes — exactly the
+kserve layout.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import Model, ModelError, ModelRepository
+from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
+                                           InferTensor, ProtocolError,
+                                           _DTYPES)
+from kubeflow_tpu.serving.protos import inference_pb2 as pb
+
+SERVICE = "inference.GRPCInferenceService"
+
+# OIP datatype -> InferTensorContents field
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents", "INT16": "int_contents", "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents", "UINT16": "uint_contents",
+    "UINT32": "uint_contents", "UINT64": "uint64_contents",
+    "FP16": "fp32_contents",  # FP16 rides the fp32 field, per the OIP spec
+    "FP32": "fp32_contents", "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+
+def _tensor_from_pb(t: "pb.ModelInferRequest.InferInputTensor") -> InferTensor:
+    dt = t.datatype
+    if dt not in _CONTENTS_FIELD:
+        raise ProtocolError(f"unknown datatype {dt!r}")
+    values = list(getattr(t.contents, _CONTENTS_FIELD[dt]))
+    shape = tuple(t.shape)
+    try:
+        if dt == "BYTES":
+            arr = np.array(values, dtype=object).reshape(shape)
+        else:
+            arr = np.array(values, dtype=_DTYPES[dt]).reshape(shape)
+    except ValueError as e:
+        raise ProtocolError(
+            f"tensor {t.name!r}: {len(values)} values do not fit shape "
+            f"{list(shape)} ({e})") from e
+    return InferTensor(name=t.name, data=arr, datatype=dt)
+
+
+def _tensor_to_pb(out: "pb.ModelInferResponse.InferOutputTensor",
+                  t: InferTensor) -> None:
+    out.name = t.name
+    out.datatype = t.datatype
+    out.shape.extend(int(d) for d in np.asarray(t.data).shape)
+    field = _CONTENTS_FIELD.get(t.datatype)
+    if field is None:
+        raise ProtocolError(f"unknown datatype {t.datatype!r}")
+    flat = np.asarray(t.data).reshape(-1)
+    if t.datatype == "BYTES":
+        getattr(out.contents, field).extend(
+            v if isinstance(v, bytes) else str(v).encode() for v in flat)
+    elif t.datatype == "BOOL":
+        getattr(out.contents, field).extend(bool(v) for v in flat)
+    elif t.datatype in ("FP16", "FP32", "FP64"):
+        getattr(out.contents, field).extend(float(v) for v in flat)
+    else:
+        getattr(out.contents, field).extend(int(v) for v in flat)
+
+
+class GrpcInferenceServer:
+    """OIP gRPC server over a ModelRepository."""
+
+    def __init__(self, repository: ModelRepository | None = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 max_workers: int = 8,
+                 batching: dict[str, Any] | None = None):
+        import grpc
+
+        self.repository = repository or ModelRepository()
+        # same per-model DynamicBatcher config shape as the HTTP ModelServer,
+        # so both dataplanes share batching semantics
+        self._batch_cfg = batching or {}
+        self._batchers: dict[str, Any] = {}
+        self._batch_lock = threading.Lock()
+        self._grpc = grpc
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "ServerLive": self._unary(self._server_live,
+                                      pb.ServerLiveRequest,
+                                      pb.ServerLiveResponse),
+            "ServerReady": self._unary(self._server_ready,
+                                       pb.ServerReadyRequest,
+                                       pb.ServerReadyResponse),
+            "ModelReady": self._unary(self._model_ready,
+                                      pb.ModelReadyRequest,
+                                      pb.ModelReadyResponse),
+            "ModelMetadata": self._unary(self._model_metadata,
+                                         pb.ModelMetadataRequest,
+                                         pb.ModelMetadataResponse),
+            "ModelInfer": self._unary(self._model_infer,
+                                      pb.ModelInferRequest,
+                                      pb.ModelInferResponse),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+        self._started = False
+
+    def _unary(self, fn, req_cls, resp_cls):
+        return self._grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "GrpcInferenceServer":
+        self._server.start()
+        self._started = True
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        if self._started:
+            self._server.stop(grace).wait()
+            self._started = False
+
+    # -- rpc impls -----------------------------------------------------------
+
+    def _server_live(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def _server_ready(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def _model_ready(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self.repository.ready(request.name))
+
+    def _model_metadata(self, request, context):
+        try:
+            model = self.repository.get(request.name)
+        except ModelError as e:
+            context.abort(self._grpc.StatusCode.NOT_FOUND, str(e))
+        resp = pb.ModelMetadataResponse(name=model.name,
+                                        platform="kubeflow-tpu")
+        for spec, field in ((model.input_spec(), resp.inputs),
+                            (model.output_spec(), resp.outputs)):
+            for s in spec:
+                tm = field.add()
+                tm.name = s.get("name", "")
+                tm.datatype = s.get("datatype", "")
+                tm.shape.extend(int(d) for d in s.get("shape", []))
+        return resp
+
+    def _predictor(self, model: Model):
+        cfg = self._batch_cfg.get(model.name)
+        if not cfg:
+            return model.predict
+        from kubeflow_tpu.serving.batching import DynamicBatcher
+
+        with self._batch_lock:
+            if model.name not in self._batchers:
+                self._batchers[model.name] = DynamicBatcher(
+                    model.predict,
+                    max_batch_size=int(cfg.get("maxBatchSize", 16)),
+                    max_latency_ms=float(cfg.get("maxLatencyMs", 5.0)))
+            return self._batchers[model.name]
+
+    def _model_infer(self, request, context):
+        try:
+            if request.raw_input_contents:
+                context.abort(
+                    self._grpc.StatusCode.INVALID_ARGUMENT,
+                    "raw_input_contents not supported; send typed "
+                    "InferTensorContents")
+            model = self.repository.get(request.model_name)
+            if not model.ready:
+                context.abort(self._grpc.StatusCode.UNAVAILABLE,
+                              f"model {request.model_name!r} not ready")
+            req = InferRequest(
+                model_name=request.model_name,
+                inputs=[_tensor_from_pb(t) for t in request.inputs],
+                id=request.id)
+            payload = model.preprocess(req.as_dict())
+            result = model.postprocess(self._predictor(model)(payload))
+            resp_obj = InferResponse.from_result(request.model_name, result,
+                                                 id=request.id)
+            resp = pb.ModelInferResponse(model_name=resp_obj.model_name,
+                                         id=resp_obj.id)
+            for t in resp_obj.outputs:
+                _tensor_to_pb(resp.outputs.add(), t)
+            return resp
+        except ModelError as e:
+            context.abort(self._grpc.StatusCode.NOT_FOUND, str(e))
+        except ProtocolError as e:
+            context.abort(self._grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+
+class GrpcInferenceClient:
+    """Minimal OIP gRPC client (the kserve InferenceGRPCClient analog)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self.timeout = timeout
+
+        def m(name, req_cls, resp_cls):
+            return self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+
+        self._live = m("ServerLive", pb.ServerLiveRequest,
+                       pb.ServerLiveResponse)
+        self._ready = m("ServerReady", pb.ServerReadyRequest,
+                        pb.ServerReadyResponse)
+        self._model_ready = m("ModelReady", pb.ModelReadyRequest,
+                              pb.ModelReadyResponse)
+        self._metadata = m("ModelMetadata", pb.ModelMetadataRequest,
+                           pb.ModelMetadataResponse)
+        self._infer = m("ModelInfer", pb.ModelInferRequest,
+                        pb.ModelInferResponse)
+
+    def server_live(self) -> bool:
+        return self._live(pb.ServerLiveRequest(), timeout=self.timeout).live
+
+    def model_ready(self, name: str) -> bool:
+        return self._model_ready(pb.ModelReadyRequest(name=name),
+                                 timeout=self.timeout).ready
+
+    def model_metadata(self, name: str):
+        return self._metadata(pb.ModelMetadataRequest(name=name),
+                              timeout=self.timeout)
+
+    def infer(self, model_name: str,
+              inputs: dict[str, np.ndarray] | list[InferTensor],
+              id: str = "") -> dict[str, np.ndarray]:
+        if isinstance(inputs, dict):
+            inputs = [InferTensor(name=k, data=np.asarray(v))
+                      for k, v in inputs.items()]
+        req = pb.ModelInferRequest(model_name=model_name, id=id)
+        for t in inputs:
+            _tensor_to_pb(req.inputs.add(), t)
+        resp = self._infer(req, timeout=self.timeout)
+        return {t.name: _tensor_from_pb(t).data for t in resp.outputs}
+
+    def close(self) -> None:
+        self._channel.close()

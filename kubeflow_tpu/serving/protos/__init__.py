@@ -1,0 +1,5 @@
+"""protoc-generated Open Inference Protocol messages (inference.proto).
+
+Regenerate: scripts/gen_protos.sh (protoc --python_out, no grpc plugin
+needed — service wiring is hand-registered in serving/grpc_server.py).
+"""

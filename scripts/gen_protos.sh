@@ -1,0 +1,10 @@
+#!/bin/sh
+# Regenerate protoc outputs (committed, so runtime needs no protoc).
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=kubeflow_tpu/serving/protos \
+       --proto_path=kubeflow_tpu/serving/protos \
+       kubeflow_tpu/serving/protos/inference.proto
+protoc --python_out=kubeflow_tpu/hpo/protos \
+       --proto_path=kubeflow_tpu/hpo/protos \
+       kubeflow_tpu/hpo/protos/suggestion.proto

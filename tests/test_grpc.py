@@ -1,0 +1,246 @@
+"""gRPC control/data planes: Open Inference Protocol gRPC server/client and
+the Katib-style suggestion gRPC service (SURVEY.md §2.3/§2.4 — the
+reference's native wire APIs, kept on grpcio)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.grpc_server import (GrpcInferenceClient,
+                                              GrpcInferenceServer)
+from kubeflow_tpu.serving.model import FunctionModel, ModelRepository
+
+
+@pytest.fixture()
+def oip():
+    repo = ModelRepository()
+    repo.register(FunctionModel("sq", lambda d: {"y": d["x"] ** 2}))
+    server = GrpcInferenceServer(repo).start()
+    client = GrpcInferenceClient(server.address)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_oip_health_and_ready(oip):
+    server, client = oip
+    assert client.server_live()
+    assert client.model_ready("sq")
+    assert not client.model_ready("nope")
+
+
+def test_oip_infer_round_trip(oip):
+    _, client = oip
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = client.infer("sq", {"x": x})
+    np.testing.assert_allclose(out["y"], x ** 2)
+    assert out["y"].dtype == np.float32
+
+
+def test_oip_int_and_bool_dtypes(oip):
+    server, client = oip
+    server.repository.register(
+        FunctionModel("neg", lambda d: {"out": ~d["b"],
+                                        "i": -d["i"]}))
+    out = client.infer("neg", {"b": np.array([True, False]),
+                               "i": np.array([1, -2], np.int64)})
+    np.testing.assert_array_equal(out["out"], [False, True])
+    np.testing.assert_array_equal(out["i"], [-1, 2])
+    assert out["i"].dtype == np.int64
+
+
+def test_oip_unknown_model_aborts(oip):
+    import grpc
+
+    _, client = oip
+    with pytest.raises(grpc.RpcError) as e:
+        client.infer("missing", {"x": np.zeros(1, np.float32)})
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_oip_bad_shape_and_raw_contents_rejected(oip):
+    import grpc
+
+    from kubeflow_tpu.serving.protos import inference_pb2 as pb
+
+    server, client = oip
+    req = pb.ModelInferRequest(model_name="sq")
+    t = req.inputs.add()
+    t.name, t.datatype = "x", "FP32"
+    t.shape.extend([2, 2])
+    t.contents.fp32_contents.extend([1.0, 2.0, 3.0])  # 3 values, shape 4
+    with pytest.raises(grpc.RpcError) as e:
+        client._infer(req, timeout=5)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    req2 = pb.ModelInferRequest(model_name="sq")
+    req2.raw_input_contents.append(b"\x00\x00\x80\x3f")
+    with pytest.raises(grpc.RpcError) as e:
+        client._infer(req2, timeout=5)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "raw_input_contents" in e.value.details()
+
+
+def test_oip_batching_parity():
+    """gRPC dataplane honors the same per-model batching config as HTTP."""
+    batch_sizes = []
+
+    def fn(d):
+        xs = d["x"]
+        batch_sizes.append(len(xs))
+        return {"y": xs * 2}
+
+    repo = ModelRepository()
+    repo.register(FunctionModel("b", fn))
+    server = GrpcInferenceServer(
+        repo, batching={"b": {"maxBatchSize": 8, "maxLatencyMs": 20}}).start()
+    client = GrpcInferenceClient(server.address)
+    try:
+        import threading
+
+        results = [None] * 4
+
+        def call(i):
+            results[i] = client.infer(
+                "b", {"x": np.array([float(i)], np.float32)})
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(4):
+            np.testing.assert_allclose(results[i]["y"], [2.0 * i])
+        assert max(batch_sizes) > 1  # requests actually shared a batch
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_oip_matches_http_dataplane(oip):
+    """Same model through both dataplanes -> identical numbers."""
+    import json
+    import urllib.request
+
+    from kubeflow_tpu.serving.server import ModelServer
+
+    server, client = oip
+    http = ModelServer(server.repository).start()
+    try:
+        x = np.array([[2.0, 3.0]], np.float32)
+        g = client.infer("sq", {"x": x})["y"]
+        body = {"inputs": [{"name": "x", "shape": [1, 2],
+                            "datatype": "FP32", "data": x.tolist()}]}
+        req = urllib.request.Request(
+            http.url + "/v2/models/sq/infer",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            h = json.loads(r.read())
+        h_y = np.array(h["outputs"][0]["data"]).reshape(g.shape)
+        np.testing.assert_allclose(g, h_y)
+    finally:
+        http.stop()
+
+
+# -- suggestion service -------------------------------------------------------
+
+EXPERIMENT = {
+    "name": "exp1",
+    "algorithm": "random",
+    "seed": 5,
+    "objectiveType": "minimize",
+    "parameters": [
+        {"name": "lr", "parameterType": "double",
+         "feasibleSpace": {"min": "0.001", "max": "0.1", "scale": "log"}},
+        {"name": "layers", "parameterType": "int",
+         "feasibleSpace": {"min": "1", "max": "4"}},
+        {"name": "opt", "parameterType": "categorical",
+         "feasibleSpace": {"list": ["adam", "sgd"]}},
+    ],
+}
+
+
+@pytest.fixture()
+def suggestion():
+    from kubeflow_tpu.hpo.grpc_service import (SuggestionClient,
+                                               SuggestionService)
+
+    service = SuggestionService().start()
+    client = SuggestionClient(service.address)
+    yield client
+    client.close()
+    service.stop()
+
+
+def test_suggestion_grpc_random(suggestion):
+    out = suggestion.get_suggestions(EXPERIMENT, trials=[], count=3)
+    assert len(out) == 3
+    for a in out:
+        assert 0.001 <= a["lr"] <= 0.1
+        assert a["layers"] in (1, 2, 3, 4)
+        assert a["opt"] in ("adam", "sgd")
+
+
+def test_suggestion_grpc_bayesian_uses_history(suggestion):
+    exp = {**EXPERIMENT, "name": "exp2", "algorithm": "bayesianoptimization"}
+    trials = [{"name": f"t{i}", "params": {"lr": 0.01 * (i + 1),
+                                           "layers": 2, "opt": "adam"},
+               "value": float(i), "status": "Succeeded"}
+              for i in range(5)]
+    out = suggestion.get_suggestions(exp, trials=trials, count=2)
+    assert len(out) == 2 and all("lr" in a for a in out)
+
+
+def test_suggestion_grpc_stateful_continuation(suggestion):
+    """Same experiment name across calls continues one optimization (the
+    per-experiment service Deployment lifetime)."""
+    exp = {**EXPERIMENT, "name": "exp3"}
+    a = suggestion.get_suggestions(exp, trials=[], count=2)
+    b = suggestion.get_suggestions(exp, trials=[], count=2)
+    # random algorithm's rng advances across calls -> different samples
+    assert a != b
+
+
+def test_suggestion_grpc_validate(suggestion):
+    assert suggestion.validate(EXPERIMENT) == ""
+    bad = {**EXPERIMENT, "algorithm": "not-an-algo"}
+    assert "unknown algorithm" in suggestion.validate(bad)
+
+
+def test_suggestion_numeric_categorical_round_trip(suggestion):
+    """Numeric-looking categorical strings must survive the wire both ways
+    (a categorical "1" is a choice label, not the int 1)."""
+    exp = {"name": "cat-exp", "algorithm": "random", "seed": 3,
+           "parameters": [
+               {"name": "sku", "parameterType": "categorical",
+                "feasibleSpace": {"list": ["1", "2"]}},
+               {"name": "width", "parameterType": "discrete",
+                "feasibleSpace": {"list": [128, 256]}},
+           ]}
+    out = suggestion.get_suggestions(exp, trials=[], count=2)
+    for a in out:
+        assert a["sku"] in ("1", "2")       # str, matching caller's list
+        assert a["width"] in (128, 256)     # caller's original ints
+    # history with those values parses back into the algorithm cleanly
+    trials = [{"name": "t0", "params": out[0], "value": 1.0}]
+    again = suggestion.get_suggestions(exp, trials=trials, count=1)
+    assert again and again[0]["sku"] in ("1", "2")
+
+
+def test_suggestion_grpc_maximize_negates(suggestion):
+    """maximize objectives are negated before reaching the algorithm (the
+    minimize-only convention)."""
+    from kubeflow_tpu.hpo.grpc_service import _history_from_pb
+    from kubeflow_tpu.hpo.protos import suggestion_pb2 as pb
+    from kubeflow_tpu.hpo.space import SearchSpace
+
+    space = SearchSpace.parse([{"name": "x", "parameterType": "double",
+                                "feasibleSpace": {"min": 0, "max": 1}}])
+    req = pb.GetSuggestionsRequest()
+    req.experiment.objective_type = "maximize"
+    t = req.trials.add()
+    t.objective_value = 3.0
+    t.has_objective = True
+    hist = _history_from_pb(space, req.experiment, req.trials)
+    assert hist[0].value == -3.0
