@@ -41,7 +41,18 @@ def build(name: str, force: bool = False) -> str:
     src = os.path.join(SRC_DIR, f"{name}.cpp")
     if not os.path.exists(src):
         raise NativeUnavailable(f"no native source {src}")
-    out = os.path.join(BUILD_DIR, f"lib{name}.so")
+    # SURVEY.md §5.2: sanitizer presets for the native components
+    # (KTPU_NATIVE_SANITIZE=thread|address|undefined). The sanitized build
+    # gets its own artifact name so it never poisons (or hides behind) the
+    # cached normal .so. NOTE: dlopen'ing a sanitized .so needs the runtime
+    # preloaded (LD_PRELOAD=libtsan.so.2 python ...); the standalone race
+    # harness is scripts/native_sanitize.sh
+    san = os.environ.get("KTPU_NATIVE_SANITIZE")
+    if san and san not in ("thread", "address", "undefined"):
+        raise NativeUnavailable(
+            f"KTPU_NATIVE_SANITIZE={san!r} (want thread|address|undefined)")
+    suffix = f".{san[0]}san.so" if san else ".so"
+    out = os.path.join(BUILD_DIR, f"lib{name}{suffix}")
     if not force and os.path.exists(out) and \
             os.path.getmtime(out) >= os.path.getmtime(src):
         return out
@@ -50,8 +61,12 @@ def build(name: str, force: bool = False) -> str:
         raise NativeUnavailable("no C++ compiler on PATH")
     os.makedirs(BUILD_DIR, exist_ok=True)
     tmp = out + ".tmp"
-    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", tmp]
+    if san:
+        cmd = [cxx, "-O1", "-g", f"-fsanitize={san}", "-std=c++17",
+               "-shared", "-fPIC", "-pthread", src, "-o", tmp]
+    else:
+        cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeUnavailable(

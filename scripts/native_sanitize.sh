@@ -1,0 +1,16 @@
+#!/bin/sh
+# Race/memory check for the concurrent native components (SURVEY.md §5.2):
+# compiles cb_scheduler + data_loader INTO a standalone harness and runs it
+# under TSAN and ASAN (a sanitized .so cannot be dlopen'd into an already-
+# running Python, so the check is a binary, not the ctypes path).
+set -e
+cd "$(dirname "$0")/../native"
+mkdir -p build
+for SAN in thread address; do
+  echo "== -fsanitize=$SAN =="
+  g++ -O1 -g -std=c++17 -pthread -fsanitize=$SAN \
+      src/sanitize_harness.cpp src/cb_scheduler.cpp src/data_loader.cpp \
+      -o build/sanitize_$SAN
+  ./build/sanitize_$SAN
+done
+echo "all sanitizers clean"

@@ -1,6 +1,8 @@
 """Native (C++) component tests: metadata store vs sqlite twin, WAL replay,
 escaping robustness. The cb_scheduler native tests live in test_llm_serving."""
 
+import os
+
 import pytest
 
 from kubeflow_tpu.pipelines.artifacts import Artifact
@@ -79,3 +81,28 @@ def test_native_escaping(tmp_path):
     out = store.cached_outputs("")  # empty cache key never matches
     assert out is None
     store.close()
+
+
+def test_sanitize_harness_clean():
+    """TSAN+ASAN over the concurrent native components (SURVEY.md §5.2)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        probe = os.path.join(d, "p.cpp")
+        with open(probe, "w") as f:
+            f.write("int main(){return 0;}\n")
+        ok = subprocess.run(
+            ["g++", "-fsanitize=thread", probe, "-o",
+             os.path.join(d, "p")], capture_output=True)
+        if ok.returncode != 0:
+            pytest.skip("no TSAN runtime for g++")
+    proc = subprocess.run(
+        ["scripts/native_sanitize.sh"], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all sanitizers clean" in proc.stdout
