@@ -40,6 +40,7 @@ from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
 from kubeflow_tpu.control.controller import Controller
 from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
 from kubeflow_tpu.hpo import algorithms as alg
+from kubeflow_tpu.hpo import nas as _nas
 from kubeflow_tpu.hpo.observations import ObservationDB, default_db
 from kubeflow_tpu.hpo.space import SearchSpace, SpaceError
 from kubeflow_tpu.hpo.trial import (EXPERIMENT_LABEL, TRIAL_KIND,
@@ -60,8 +61,11 @@ def validate_experiment(exp: dict[str, Any]) -> list[str]:
     name = spec.get("algorithm", {}).get("algorithmName", "random")
     if name not in alg.algorithm_names():
         errs.append(f"unknown algorithm {name!r}")
+    nas = spec.get("nasConfig")
+    if nas is not None:
+        errs.extend(_nas.validate_nas_config(nas))
     try:
-        SearchSpace.parse(spec.get("parameters", []))
+        SearchSpace.parse(_nas.effective_parameters(spec))
     except SpaceError as e:
         errs.append(f"parameters: {e}")
     tt = spec.get("trialTemplate", {})
@@ -246,7 +250,7 @@ class ExperimentController(Controller):
                                                            "random"),
             "algorithmSettings": spec.get("algorithm", {}).get(
                 "algorithmSettings", {}),
-            "parameters": spec.get("parameters", []),
+            "parameters": _nas.effective_parameters(spec),
             "objectiveType": spec.get("objective", {}).get("type",
                                                            "minimize"),
             "requests": 0,

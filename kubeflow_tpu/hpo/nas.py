@@ -1,0 +1,84 @@
+"""NAS search-space expansion — Katib's `nasConfig` (SURVEY.md §2.3 ⊘ katib
+Experiment `spec.nasConfig` + pkg/suggestion/v1beta1/nas).
+
+Katib's NAS experiments describe a graph (numLayers) and candidate
+operations; the suggestion service samples an architecture per trial. Here
+the expansion is explicit and algorithm-agnostic: `nas_parameters` turns
+nasConfig into one categorical parameter per layer, so EVERY suggestion
+algorithm (random, TPE, GP-bayesian, CMA-ES, ...) can drive architecture
+search — and the trial is an ordinary training job running the `nas_cnn`
+model with the sampled ops.
+
+The differentiable path (DARTS supernet, models/nas_cnn.py) needs no
+experiment at all: one training job learns the op mixture directly.
+
+    spec:
+      nasConfig:
+        numLayers: 4
+        operations: [conv3, conv5, maxpool, identity]   # default: all
+      trialTemplate:
+        spec: <job spec with ${trialParameters.op_0} ... substitutions>
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeflow_tpu.hpo.space import SpaceError
+
+# kept in sync with models/nas_cnn.py OP_NAMES (asserted by tests); NOT
+# imported from there so the control plane's validate path stays jax-free
+OP_NAMES: tuple[str, ...] = ("conv3", "conv5", "sep3", "maxpool", "avgpool",
+                             "identity")
+
+
+def validate_nas_config(nas: dict[str, Any]) -> list[str]:
+    errs = []
+    n = nas.get("numLayers")
+    if not isinstance(n, int) or n < 1:
+        errs.append("nasConfig.numLayers must be an int >= 1")
+    ops = nas.get("operations", list(OP_NAMES))
+    if not isinstance(ops, list) or not ops:
+        errs.append("nasConfig.operations must be a non-empty list")
+    else:
+        for op in ops:
+            if op not in OP_NAMES:
+                errs.append(f"nasConfig.operations: unknown op {op!r} "
+                            f"(known: {', '.join(OP_NAMES)})")
+    return errs
+
+
+def nas_parameters(nas: dict[str, Any]) -> list[dict[str, Any]]:
+    """nasConfig -> Katib-shaped categorical parameters (op_0 .. op_{L-1}).
+
+    Raises SpaceError on malformed configs so validation surfaces the
+    problem as InvalidSpec (the same channel SearchSpace.parse uses) rather
+    than crashing the reconciler."""
+    n = nas.get("numLayers")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        raise SpaceError(f"nasConfig.numLayers must be an int >= 1, "
+                         f"got {n!r}")
+    ops = nas.get("operations", list(OP_NAMES))
+    if not isinstance(ops, list) or not ops:
+        raise SpaceError("nasConfig.operations must be a non-empty list")
+    ops = [str(o) for o in ops]
+    return [{"name": f"op_{i}", "parameterType": "categorical",
+             "feasibleSpace": {"list": ops}}
+            for i in range(n)]
+
+
+def effective_parameters(spec: dict[str, Any]) -> list[dict[str, Any]]:
+    """The experiment's search space: explicit `parameters`, extended by the
+    nasConfig expansion when present (both may coexist — e.g. searching
+    architecture AND learning rate together)."""
+    params = list(spec.get("parameters", []))
+    nas = spec.get("nasConfig")
+    if nas:
+        params.extend(nas_parameters(nas))
+    return params
+
+
+def architecture_from_assignment(assignment: dict[str, Any],
+                                 num_layers: int) -> tuple[str, ...]:
+    """Collect op_i assignments back into a NasCnnConfig.ops tuple."""
+    return tuple(str(assignment[f"op_{i}"]) for i in range(num_layers))

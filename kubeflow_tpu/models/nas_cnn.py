@@ -1,0 +1,221 @@
+"""NAS-searchable CNN + DARTS-style supernet — Katib's NAS capability
+(SURVEY.md §2.3 suggestion row: ENAS/DARTS services ⊘ katib
+pkg/suggestion/v1beta1/nas) rebuilt TPU-first.
+
+Two search modes, matching how Katib's two NAS algorithms divide the work:
+
+1. **Trial-based search** (the ENAS-experiment shape): `NasCnnConfig.ops`
+   picks one operation per layer from OP_NAMES; each architecture is a
+   normal model any HPO algorithm can drive through the Experiment
+   controller (`nasConfig` -> categorical parameters, hpo/nas.py). Every
+   trial is an ordinary gang-scheduled training job.
+
+2. **Differentiable search (DARTS)**: `darts_init`/`darts_loss_fn` build a
+   supernet where every layer runs ALL candidate ops and mixes them with a
+   softmax over architecture logits alpha — one jitted program, all-ops
+   compute batched for the MXU (no data-dependent branching), exactly how
+   differentiable NAS should map onto XLA. `derive` reads off the argmax
+   architecture for retraining as mode 1.
+
+Ops are shape-preserving NHWC blocks so any op sequence composes; spatial
+reduction happens at fixed stride points like the DARTS macro skeleton.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+OP_NAMES: tuple[str, ...] = ("conv3", "conv5", "sep3", "maxpool", "avgpool",
+                             "identity")
+
+
+@dataclasses.dataclass(frozen=True)
+class NasCnnConfig:
+    n_classes: int = 10
+    channels: int = 16
+    image_size: int = 16
+    in_channels: int = 3
+    ops: tuple[str, ...] = ("conv3", "conv3", "conv3")  # one per layer
+    reduce_every: int = 2      # stride-2 pool after every k-th layer
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        for op in self.ops:
+            if op not in OP_NAMES:
+                raise ValueError(f"unknown op {op!r}; known: {OP_NAMES}")
+
+
+def _he(key, shape, fan_in):
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _op_params(key, op: str, c: int) -> Params:
+    """Every op gets its full parameter set so supernet layers can hold all
+    ops at once; parameter-free ops get an empty dict."""
+    if op == "conv3":
+        return {"w": _he(key, (3, 3, c, c), 9 * c), "b": jnp.zeros((c,))}
+    if op == "conv5":
+        return {"w": _he(key, (5, 5, c, c), 25 * c), "b": jnp.zeros((c,))}
+    if op == "sep3":
+        k1, k2 = jax.random.split(key)
+        # depthwise HWIO with feature_group_count=C: (H, W, 1, C)
+        return {"dw": _he(k1, (3, 3, 1, c), 9),
+                "pw": _he(k2, (1, 1, c, c), c), "b": jnp.zeros((c,))}
+    return {}  # maxpool / avgpool / identity
+
+
+def _apply_op(op: str, p: Params, x: jax.Array) -> jax.Array:
+    dn = ("NHWC", "HWIO", "NHWC")
+    if op in ("conv3", "conv5"):
+        y = jax.lax.conv_general_dilated(x, p["w"], (1, 1), "SAME",
+                                         dimension_numbers=dn)
+        return jax.nn.relu(y + p["b"])
+    if op == "sep3":
+        y = jax.lax.conv_general_dilated(
+            x, p["dw"], (1, 1), "SAME", dimension_numbers=dn,
+            feature_group_count=x.shape[-1])
+        y = jax.lax.conv_general_dilated(y, p["pw"], (1, 1), "SAME",
+                                         dimension_numbers=dn)
+        return jax.nn.relu(y + p["b"])
+    if op == "maxpool":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                     (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+    if op == "avgpool":
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                  (1, 3, 3, 1), (1, 1, 1, 1), "SAME")
+        return s / 9.0
+    return x  # identity
+
+
+def _reduce(x):
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                 (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+
+# -- mode 1: fixed architecture (one trial) -----------------------------------
+
+def init(rng: jax.Array, cfg: NasCnnConfig) -> Params:
+    keys = jax.random.split(rng, len(cfg.ops) + 2)
+    c = cfg.channels
+    params: Params = {
+        "stem": {"w": _he(keys[0], (3, 3, cfg.in_channels, c),
+                          9 * cfg.in_channels),
+                 "b": jnp.zeros((c,))},
+        "layers": [_op_params(keys[i + 1], op, c)
+                   for i, op in enumerate(cfg.ops)],
+        "head": {"w": _he(keys[-1], (c, cfg.n_classes), c),
+                 "b": jnp.zeros((cfg.n_classes,))},
+    }
+    return params
+
+
+def apply(params: Params, images: jax.Array, cfg: NasCnnConfig) -> jax.Array:
+    x = images.astype(cfg.dtype)
+    x = _apply_op("conv3", params["stem"], x)
+    for i, op in enumerate(cfg.ops):
+        x = _apply_op(op, params["layers"][i], x)
+        if (i + 1) % cfg.reduce_every == 0 and x.shape[1] > 2:
+            x = _reduce(x)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: NasCnnConfig):
+    logits = apply(params, batch["image"], cfg)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def _op_axes(op: str) -> Params:
+    """Logical sharding axes per op — single source for both the fixed-arch
+    model and the DARTS supernet."""
+    if op in ("conv3", "conv5"):
+        return {"w": (None, None, "conv_in", "conv_out"), "b": (None,)}
+    if op == "sep3":
+        return {"dw": (None, None, None, "conv_out"),
+                "pw": (None, None, "conv_in", "conv_out"), "b": (None,)}
+    return {}
+
+
+def logical_axes(cfg: NasCnnConfig) -> Params:
+    return {
+        "stem": {"w": (None, None, "conv_in", "conv_out"), "b": (None,)},
+        "layers": [_op_axes(op) for op in cfg.ops],
+        "head": {"w": ("embed", None), "b": (None,)},
+    }
+
+
+# -- mode 2: DARTS supernet ---------------------------------------------------
+
+def darts_init(rng: jax.Array, cfg: NasCnnConfig) -> Params:
+    """Supernet: every layer holds params for ALL ops plus architecture
+    logits alpha [n_layers, n_ops] (init 0 = uniform mixture)."""
+    n_layers = len(cfg.ops)
+    keys = jax.random.split(rng, n_layers * len(OP_NAMES) + 2)
+    c = cfg.channels
+    layers = []
+    ki = 1
+    for _ in range(n_layers):
+        ops = {}
+        for op in OP_NAMES:
+            ops[op] = _op_params(keys[ki], op, c)
+            ki += 1
+        layers.append(ops)
+    return {
+        "stem": {"w": _he(keys[0], (3, 3, cfg.in_channels, c),
+                          9 * cfg.in_channels), "b": jnp.zeros((c,))},
+        "layers": layers,
+        "alpha": jnp.zeros((n_layers, len(OP_NAMES)), jnp.float32),
+        "head": {"w": _he(keys[-1], (c, cfg.n_classes), c),
+                 "b": jnp.zeros((cfg.n_classes,))},
+    }
+
+
+def darts_apply(params: Params, images: jax.Array,
+                cfg: NasCnnConfig) -> jax.Array:
+    """All candidate ops run for every layer; the softmax(alpha) mixture is
+    a dense weighted sum — branch-free, fully batched for XLA."""
+    x = images.astype(cfg.dtype)
+    x = _apply_op("conv3", params["stem"], x)
+    weights = jax.nn.softmax(params["alpha"], axis=-1)
+    for i, layer_ops in enumerate(params["layers"]):
+        outs = jnp.stack([_apply_op(op, layer_ops[op], x)
+                          for op in OP_NAMES])
+        x = jnp.tensordot(weights[i], outs, axes=1)
+        if (i + 1) % cfg.reduce_every == 0 and x.shape[1] > 2:
+            x = _reduce(x)
+    x = jnp.mean(x, axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def darts_loss_fn(params: Params, batch: dict[str, jax.Array],
+                  cfg: NasCnnConfig):
+    logits = darts_apply(params, batch["image"], cfg)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def darts_logical_axes(cfg: NasCnnConfig) -> Params:
+    fixed = logical_axes(cfg)
+    layers = [{op: _op_axes(op) for op in OP_NAMES} for _ in cfg.ops]
+    return {"stem": fixed["stem"], "layers": layers,
+            "alpha": (None, None), "head": fixed["head"]}
+
+
+def derive(alpha) -> tuple[str, ...]:
+    """Read the discrete architecture off trained alphas (DARTS derive
+    step): argmax op per layer."""
+    idx = jnp.argmax(jnp.asarray(alpha), axis=-1)
+    return tuple(OP_NAMES[int(i)] for i in idx)

@@ -53,7 +53,8 @@ def _populate() -> None:
     if _populated:
         return
     _populated = True
-    from kubeflow_tpu.models import bert, llama, mnist_cnn, moe_llama, resnet
+    from kubeflow_tpu.models import (bert, llama, mnist_cnn, moe_llama,
+                                     nas_cnn, resnet)
 
     register("llama", ModelDef(llama.LlamaConfig, llama.init, llama.apply,
                                llama.loss_fn, llama.logical_axes))
@@ -67,3 +68,9 @@ def _populate() -> None:
                               bert.loss_fn, bert.logical_axes))
     register("resnet", ModelDef(resnet.ResNetConfig, resnet.init, resnet.apply,
                                 resnet.loss_fn, resnet.logical_axes))
+    register("nas_cnn", ModelDef(nas_cnn.NasCnnConfig, nas_cnn.init,
+                                 nas_cnn.apply, nas_cnn.loss_fn,
+                                 nas_cnn.logical_axes))
+    register("darts_supernet", ModelDef(
+        nas_cnn.NasCnnConfig, nas_cnn.darts_init, nas_cnn.darts_apply,
+        nas_cnn.darts_loss_fn, nas_cnn.darts_logical_axes))

@@ -1,0 +1,180 @@
+"""NAS: searchable CNN family, DARTS-style differentiable supernet, and
+nasConfig-driven Experiments (Katib NAS analog, SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.control import Cluster, JAXJobController, new_resource, \
+    worker_target
+from kubeflow_tpu.control.conditions import JobConditionType, has_condition, \
+    is_finished
+from kubeflow_tpu import hpo
+from kubeflow_tpu.hpo.nas import (architecture_from_assignment,
+                                  effective_parameters, nas_parameters,
+                                  validate_nas_config)
+from kubeflow_tpu.models import nas_cnn
+from kubeflow_tpu.training.metrics_writer import MetricsWriter
+
+
+def test_op_names_in_sync():
+    from kubeflow_tpu.hpo import nas as hpo_nas
+
+    assert hpo_nas.OP_NAMES == nas_cnn.OP_NAMES
+
+
+def test_nas_parameters_expansion():
+    params = nas_parameters({"numLayers": 3,
+                             "operations": ["conv3", "maxpool"]})
+    assert [p["name"] for p in params] == ["op_0", "op_1", "op_2"]
+    assert all(p["feasibleSpace"]["list"] == ["conv3", "maxpool"]
+               for p in params)
+    errs = validate_nas_config({"numLayers": 0})
+    assert any("numLayers" in e for e in errs)
+    errs = validate_nas_config({"numLayers": 2, "operations": ["warp"]})
+    assert any("unknown op" in e for e in errs)
+    # nasConfig composes with explicit parameters (arch + lr search)
+    spec = {"parameters": [{"name": "lr", "parameterType": "double",
+                            "feasibleSpace": {"min": 0.001, "max": 0.1}}],
+            "nasConfig": {"numLayers": 1}}
+    names = [p["name"] for p in effective_parameters(spec)]
+    assert names == ["lr", "op_0"]
+    arch = architecture_from_assignment({"op_0": "sep3", "op_1": "identity"},
+                                        2)
+    assert arch == ("sep3", "identity")
+
+
+def test_every_op_forward_and_grad():
+    cfg = nas_cnn.NasCnnConfig(ops=nas_cnn.OP_NAMES, channels=8,
+                               image_size=8, n_classes=4)
+    params = nas_cnn.init(jax.random.key(0), cfg)
+    batch = {"image": np.random.default_rng(0).normal(
+        size=(2, 8, 8, 3)).astype(np.float32),
+        "label": np.array([0, 1])}
+    (loss, metrics), grads = jax.value_and_grad(
+        nas_cnn.loss_fn, has_aux=True)(params, batch, cfg)
+    assert np.isfinite(float(loss))
+    # every parameterized op receives gradient
+    for i, op in enumerate(cfg.ops):
+        for leaf in jax.tree.leaves(grads["layers"][i]):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_nas_cnn_trains_via_trainer():
+    from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+    from kubeflow_tpu.training import data as data_lib
+
+    trainer = Trainer(TrainerConfig(
+        model="nas_cnn",
+        model_overrides=dict(ops=("conv3", "maxpool"), channels=8,
+                             image_size=8, n_classes=4),
+        batch_size=8,
+        optimizer=OptimizerConfig(learning_rate=3e-3, warmup_steps=2,
+                                  total_steps=40),
+        log_every=5))
+    trainer.metrics.echo = False
+    data = data_lib.for_model("nas_cnn", trainer.model_cfg, 8)
+    accs = []
+    trainer.train(data, 30,
+                  step_callback=lambda s, m: accs.append(m["accuracy"]))
+    assert accs[-1] > accs[0]
+
+
+def test_darts_supernet_learns_alphas():
+    """Joint weight+alpha training on the supernet: loss drops and the
+    architecture distribution moves away from uniform; derive() reads a
+    valid discrete architecture."""
+    from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+    from kubeflow_tpu.training import data as data_lib
+
+    trainer = Trainer(TrainerConfig(
+        model="darts_supernet",
+        # ops only sets the supernet depth; every layer holds all candidates
+        model_overrides=dict(ops=("conv3", "conv3"), channels=8,
+                             image_size=8, n_classes=4),
+        batch_size=8,
+        optimizer=OptimizerConfig(learning_rate=3e-3, warmup_steps=2,
+                                  total_steps=60),
+        log_every=10))
+    trainer.metrics.echo = False
+    data = data_lib.for_model("darts_supernet", trainer.model_cfg, 8)
+    losses = []
+    state = trainer.train(
+        data, 50, step_callback=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0]
+    alpha = np.asarray(jax.device_get(state["params"]["alpha"]))
+    assert alpha.shape == (2, len(nas_cnn.OP_NAMES))
+    assert np.abs(alpha).max() > 1e-4  # moved off the uniform init
+    arch = nas_cnn.derive(alpha)
+    assert len(arch) == 2 and all(op in nas_cnn.OP_NAMES for op in arch)
+
+
+def test_darts_matches_fixed_arch_at_onehot():
+    """A supernet with one-hot alpha must equal the fixed-arch model with
+    the same op params (the derive step's correctness contract)."""
+    cfg = nas_cnn.NasCnnConfig(ops=("conv3", "maxpool"), channels=8,
+                               image_size=8, n_classes=4)
+    sup = nas_cnn.darts_init(jax.random.key(1), cfg)
+    # force alpha one-hot at (conv3, maxpool)
+    alpha = np.full((2, len(nas_cnn.OP_NAMES)), -60.0, np.float32)
+    alpha[0, nas_cnn.OP_NAMES.index("conv3")] = 60.0
+    alpha[1, nas_cnn.OP_NAMES.index("maxpool")] = 60.0
+    sup["alpha"] = jnp.asarray(alpha)
+    fixed = nas_cnn.init(jax.random.key(2), cfg)
+    fixed["stem"] = sup["stem"]
+    fixed["head"] = sup["head"]
+    fixed["layers"] = [sup["layers"][0]["conv3"], sup["layers"][1]["maxpool"]]
+    x = np.random.default_rng(1).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(nas_cnn.darts_apply(sup, x, cfg)),
+        np.asarray(nas_cnn.apply(fixed, x, cfg)), rtol=1e-4, atol=1e-5)
+
+
+# -- nasConfig experiment e2e -------------------------------------------------
+
+@worker_target("nas_trial")
+def _nas_trial(env, cancel):
+    """Scores an architecture without training (keeps the e2e fast): a
+    deterministic objective preferring conv ops early, identity late."""
+    ops = [env["OP_0"], env["OP_1"]]
+    score = 0.0
+    score += {"conv3": 0.0, "maxpool": 0.5, "identity": 1.0}[ops[0]]
+    score += {"conv3": 0.3, "maxpool": 0.2, "identity": 0.0}[ops[1]]
+    w = MetricsWriter(env["KTPU_METRICS_FILE"], echo=False)
+    w.write(0, {"loss": score})
+    w.close()
+
+
+def test_nas_experiment_e2e(tmp_path):
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path))
+    exp = new_resource("Experiment", "nas-e2e", spec={
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "grid"},
+        "nasConfig": {"numLayers": 2,
+                      "operations": ["conv3", "maxpool", "identity"]},
+        "parallelTrialCount": 3,
+        "maxTrialCount": 9,  # full 3x3 grid
+        "maxFailedTrialCount": 2,
+        "trialTemplate": {"spec": {
+            "replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"backend": "thread", "target": "nas_trial",
+                             "env": {"OP_0": "${trialParameters.op_0}",
+                                     "OP_1": "${trialParameters.op_1}"}},
+            }}}},
+    })
+    with c:
+        c.store.create(exp)
+        done = c.wait_for("Experiment", "nas-e2e",
+                          lambda o: is_finished(o["status"]), timeout=90)
+    hpo.set_default_db(None)
+    assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+    opt = done["status"]["currentOptimalTrial"]
+    arch = architecture_from_assignment(opt["parameterAssignments"], 2)
+    assert arch == ("conv3", "identity")  # the known optimum of the score
+    assert opt["objectiveValue"] == pytest.approx(0.0)
