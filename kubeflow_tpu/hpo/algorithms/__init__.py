@@ -1,7 +1,7 @@
 """Suggestion algorithms (Katib suggestion-service analog, SURVEY.md §2.3).
 
 Importing this package registers: random, grid, sobol/quasirandom, hyperband,
-tpe, bayesianoptimization, cmaes.
+tpe, bayesianoptimization (alias: bayesian), cmaes.
 """
 
 from kubeflow_tpu.hpo.algorithms.base import (Algorithm, TrialResult,

@@ -21,6 +21,9 @@ def _matern52(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
 
 
 @register("bayesianoptimization")
+@register("bayesian")
+# both names resolve: "bayesianoptimization" is Katib's canonical id; the
+# short alias is what examples/katib-experiment.yaml (and humans) write
 class BayesianOptimization(Algorithm):
     def __init__(self, space, settings=None, seed=0):
         super().__init__(space, settings, seed)

@@ -7,6 +7,7 @@ user image); here a JAXJob spec names a registered model + config overrides.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Callable, NamedTuple
 
 
@@ -20,6 +21,7 @@ class ModelDef(NamedTuple):
 
 _REGISTRY: dict[str, ModelDef] = {}
 _populated = False
+_populate_lock = threading.Lock()
 
 
 def register(name: str, model: ModelDef) -> None:
@@ -49,10 +51,21 @@ def config_with(cfg, **overrides):
 
 
 def _populate() -> None:
+    """Thread-safe lazy registration: concurrent trial pods hit get() at
+    once, and the flag must only flip AFTER every built-in is registered
+    (flag-first left a window where a second thread saw an empty
+    registry)."""
     global _populated
     if _populated:
         return
-    _populated = True
+    with _populate_lock:
+        if _populated:
+            return
+        _do_populate()
+        _populated = True
+
+
+def _do_populate() -> None:
     from kubeflow_tpu.models import (bert, llama, mnist_cnn, moe_llama,
                                      nas_cnn, resnet)
 

@@ -1,0 +1,86 @@
+"""BASELINE config #4: 32-trial Bayesian HPO sweep over ResNet JAXJob
+trials, end-to-end through the Experiment/Trial/suggestion controllers on
+the local accelerator. Prints one JSON line with the sweep outcome.
+
+    python scripts/baseline_sweep.py            # full 32 trials
+    python scripts/baseline_sweep.py --trials 8 # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import time
+
+from kubeflow_tpu import hpo
+from kubeflow_tpu.control import Cluster, JAXJobController, new_resource
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=32)
+    ap.add_argument("--parallel", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=25)
+    args = ap.parse_args()
+
+    trainer_cfg = (
+        '{"model": "resnet", '
+        '"model_overrides": {"n_classes": 10, "stage_sizes": [1, 1], '
+        '"width": 8, "groups": 4}, '
+        '"batch_size": 16, "num_steps": %d, "log_every": 5, '
+        '"optimizer": {"learning_rate": ${trialParameters.lr}, '
+        '"weight_decay": ${trialParameters.wd}}}' % args.steps)
+
+    exp = new_resource("Experiment", "resnet-sweep", spec={
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "bayesian"},
+        "parameters": [
+            {"name": "lr", "parameterType": "double",
+             "feasibleSpace": {"min": 0.0003, "max": 0.03, "scale": "log"}},
+            {"name": "wd", "parameterType": "double",
+             "feasibleSpace": {"min": 1e-5, "max": 1e-2, "scale": "log"}},
+        ],
+        "parallelTrialCount": args.parallel,
+        "maxTrialCount": args.trials,
+        "maxFailedTrialCount": 3,
+        "trialTemplate": {
+            "trialParameters": [{"name": "lr", "reference": "lr"},
+                                {"name": "wd", "reference": "wd"}],
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"backend": "thread", "target": "trainer",
+                             "env": {"KTPU_TRAINER_CONFIG": trainer_cfg}},
+            }}}},
+    })
+
+    c = Cluster()
+    c.add(JAXJobController)
+    hpo.add_hpo_controllers(
+        c, metrics_dir=tempfile.mkdtemp(prefix="sweep-metrics-"))
+    t0 = time.time()
+    with c:
+        c.store.create(exp)
+        done = c.wait_for("Experiment", "resnet-sweep",
+                          lambda o: is_finished(o["status"]),
+                          timeout=3600)
+    hpo.set_default_db(None)
+    dt = time.time() - t0
+    ok = has_condition(done["status"], JobConditionType.SUCCEEDED)
+    opt = done["status"].get("currentOptimalTrial") or {}
+    print(json.dumps({
+        "metric": f"katib_sweep_{args.trials}_trials",
+        "value": round(dt, 1),
+        "unit": "seconds",
+        "succeeded": ok,
+        "trials": done["status"].get("trials", {}),
+        "best": {"params": opt.get("parameterAssignments"),
+                 "loss": opt.get("objectiveValue")},
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
