@@ -68,8 +68,12 @@ def main() -> int:
                           timeout=3600)
     hpo.set_default_db(None)
     dt = time.time() - t0
-    ok = has_condition(done["status"], JobConditionType.SUCCEEDED)
     opt = done["status"].get("currentOptimalTrial") or {}
+    # "Succeeded (MaxTrialsReached)" with zero good trials is NOT a passing
+    # sweep — the baseline needs an actual optimum
+    ok = (has_condition(done["status"], JobConditionType.SUCCEEDED)
+          and done["status"].get("trials", {}).get("succeeded", 0) > 0
+          and opt.get("objectiveValue") is not None)
     print(json.dumps({
         "metric": f"katib_sweep_{args.trials}_trials",
         "value": round(dt, 1),
