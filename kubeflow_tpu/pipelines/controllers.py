@@ -124,39 +124,143 @@ class PipelineRunController(Controller):
                          str(e))
             return None
         dag = spec["root"]["dag"]["tasks"]
+        exit_task = spec["root"].get("exitTask")
         tasks: dict[str, Any] = dict(status.get("tasks", {}))
         changed = False
+        failure: str | None = status.get("failureMessage")
+
+        # expand loops once per pass: None = items not resolvable yet,
+        # [] = resolved to zero instances (vacuously complete)
+        expansion: dict[str, list | None] = {}
+        for tname, tir in dag.items():
+            if tname == exit_task:
+                continue
+            try:
+                expansion[tname] = self._instances(run, spec, tname, tir,
+                                                   tasks)
+            except (ValueError, KeyError, TypeError) as e:
+                expansion[tname] = []
+                if tasks.get(tname, {}).get("state") != "Failed":
+                    tasks[tname] = {"state": "Failed", "message": str(e)}
+                    changed = True
+                failure = failure or f"task {tname} failed: {e}"
 
         for tname, tir in dag.items():
-            st = tasks.get(tname, {})
-            state = st.get("state")
-            if state in ("Succeeded", "Cached"):
-                continue
-            if state == "Failed":
-                self._finish(run, JobConditionType.FAILED, "TaskFailed",
-                             f"task {tname} failed: {st.get('message', '')}")
-                return None
-            if state == "Running":
-                new_st = self._check_pod(run, spec, tname, st)
-                if new_st is not None:
-                    tasks[tname] = new_st
+            if tname == exit_task:
+                continue   # finalizer runs in the completion phase below
+            instances = expansion[tname]
+            if instances is None:
+                continue   # loop items not resolvable yet
+            for key, item in instances:
+                st = tasks.get(key, {})
+                state = st.get("state")
+                if state in ("Succeeded", "Cached", "Skipped"):
+                    continue
+                if state == "Failed":
+                    failure = failure or (f"task {key} failed: "
+                                          f"{st.get('message', '')}")
+                    continue
+                if state == "Running":
+                    new_st = self._check_pod(run, spec, tname, key, st)
+                    if new_st is None:
+                        continue
+                    if (new_st["state"] == "Failed"
+                            and st.get("attempt", 0) < tir.get("retries", 0)):
+                        # retry budget left: reap the pod, back to Pending
+                        self.store.try_delete("Pod",
+                                              self._pod_name(run, key), ns)
+                        new_st = {"attempt": st.get("attempt", 0) + 1}
+                    tasks[key] = new_st
                     changed = True
-                continue
-            # Pending: are data + ordering dependencies satisfied?
-            deps = tir["dependencies"]
-            if all(tasks.get(d, {}).get("state") in ("Succeeded", "Cached")
-                   for d in deps):
-                tasks[tname] = self._start_task(run, spec, tname, tir, tasks)
+                    continue
+                # Pending: no new work once the run is failing
+                if failure:
+                    continue
+                dep_state = self._deps_state(dag, tir, key, item, tasks,
+                                             expansion)
+                if dep_state == "wait":
+                    continue
+                if dep_state == "skip":
+                    tasks[key] = {"state": "Skipped",
+                                  "reason": "upstream skipped"}
+                    changed = True
+                    continue
+                ctx = self._instance_ctx(tir, key, item)
+                try:
+                    if not self._conditions_hold(run, spec, tir, tasks, ctx):
+                        tasks[key] = {"state": "Skipped",
+                                      "reason": "condition false"}
+                        changed = True
+                        continue
+                except (ValueError, KeyError, TypeError) as e:
+                    # TypeError: mismatched operand types ("5" > 10) must
+                    # fail the run, not wedge the reconciler
+                    tasks[key] = {"state": "Failed",
+                                  "message": f"condition: {e}"}
+                    changed = True
+                    continue
+                new_st = self._start_task(run, spec, tname, tir, tasks,
+                                          key=key, ctx=ctx)
+                new_st["attempt"] = st.get("attempt", 0)
+                tasks[key] = new_st
                 changed = True
 
-        if changed:
-            self.store.mutate(RUN_KIND, name,
-                              lambda o: o["status"].update(tasks=tasks), ns)
-        if all(tasks.get(t, {}).get("state") in ("Succeeded", "Cached")
-               for t in dag):
+        if changed or (failure and not status.get("failureMessage")):
+            def write(o):
+                o["status"]["tasks"] = tasks
+                if failure:
+                    o["status"]["failureMessage"] = failure
+            self.store.mutate(RUN_KIND, name, write, ns)
+
+        done, running = self._main_progress(dag, exit_task, tasks, expansion)
+        if (done or (failure and not running)) and exit_task:
+            est = tasks.get(exit_task, {})
+            tir = dag[exit_task]
+            if est.get("state") in ("Succeeded", "Cached"):
+                pass   # finalizer finished; fall through to terminal below
+            elif est.get("state") == "Failed":
+                failure = failure or (f"exit task {exit_task} failed: "
+                                      f"{est.get('message', '')}")
+            elif est.get("state") == "Running":
+                new_st = self._check_pod(run, spec, exit_task, exit_task, est)
+                if new_st is not None:
+                    if (new_st["state"] == "Failed"
+                            and est.get("attempt", 0)
+                            < tir.get("retries", 0)):
+                        # the finalizer honors set_retry too
+                        self.store.try_delete(
+                            "Pod", self._pod_name(run, exit_task), ns)
+                        new_st = {"attempt": est.get("attempt", 0) + 1}
+                    tasks[exit_task] = new_st
+                    self.store.mutate(
+                        RUN_KIND, name,
+                        lambda o: o["status"].update(tasks=tasks), ns)
+                return 0.05
+            else:   # not started: the finalizer ignores failure state
+                ctx = self._instance_ctx(tir, exit_task, None)
+                new_st = self._start_task(
+                    run, spec, exit_task, tir, tasks, key=exit_task, ctx=ctx)
+                new_st["attempt"] = est.get("attempt", 0)
+                tasks[exit_task] = new_st
+                self.store.mutate(RUN_KIND, name,
+                                  lambda o: o["status"].update(tasks=tasks),
+                                  ns)
+                return 0.05
+        exit_done = (not exit_task
+                     or tasks.get(exit_task, {}).get("state")
+                     in ("Succeeded", "Cached", "Failed"))
+        if failure and not running and exit_done:
+            self._finish(run, JobConditionType.FAILED, "TaskFailed", failure)
+            return None
+        if done and exit_done and not failure:
+            n = len(tasks)
+            cached = sum(1 for t in tasks.values()
+                         if t.get("state") == "Cached")
+            skipped = sum(1 for t in tasks.values()
+                          if t.get("state") == "Skipped")
             self._finish(run, JobConditionType.SUCCEEDED, "RunSucceeded",
-                         f"{len(dag)} tasks completed "
-                         f"({sum(1 for t in tasks.values() if t.get('state') == 'Cached')} cached)")
+                         f"{n} tasks completed ({cached} cached, "
+                         f"{skipped} skipped)")
             return None
         return 0.05 if changed else 0.2
 
@@ -178,41 +282,182 @@ class PipelineRunController(Controller):
             raise KeyError(f"Pipeline {ref!r} not found")
         return obj["spec"]
 
-    def _resolve_inputs(self, run: dict[str, Any], spec: dict[str, Any],
-                        tir: dict[str, Any],
-                        tasks: dict[str, Any]) -> dict[str, Any]:
+    def _params(self, run: dict[str, Any],
+                spec: dict[str, Any]) -> dict[str, Any]:
         params = dict(spec.get("parameters", {}))
         params.update(run["spec"].get("parameters", {}))
+        return params
+
+    @staticmethod
+    def _instance_ctx(tir: dict[str, Any], key: str,
+                      item: Any) -> dict[str, Any]:
+        loop = tir.get("loop")
+        index = None
+        if loop and "[" in key:
+            index = int(key[key.index("[") + 1:-1])
+        return {"group": loop["group"] if loop else None,
+                "index": index, "item": item}
+
+    def _resolve_ref(self, run: dict[str, Any], spec: dict[str, Any],
+                     binding: dict[str, Any], tasks: dict[str, Any],
+                     ctx: dict[str, Any]) -> Any:
+        """One IR binding -> concrete value, in an instance context (the
+        kfp-v2 driver's input resolution, ⊘ backend/src/v2/driver)."""
+        if "constant" in binding:
+            return binding["constant"]
+        if "pipelineParam" in binding:
+            pname = binding["pipelineParam"]
+            params = self._params(run, spec)
+            if params.get(pname) is None:
+                raise ValueError(f"pipeline parameter {pname!r} not set")
+            return params[pname]
+        if "loopItem" in binding:
+            if binding["loopItem"] != ctx.get("group"):
+                raise ValueError("loop item referenced outside its loop")
+            return ctx["item"]
+        to = binding["taskOutput"]
+        src = to["task"]
+        src_tir = spec["root"]["dag"]["tasks"][src]
+        src_key = src
+        if (src_tir.get("loop")
+                and src_tir["loop"]["group"] == ctx.get("group")
+                and ctx.get("index") is not None):
+            src_key = f"{src}[{ctx['index']}]"
+        out = tasks[src_key]["outputs"][to["output"]]
+        return self.artifacts.get_json(out["uri"])
+
+    def _resolve_inputs(self, run: dict[str, Any], spec: dict[str, Any],
+                        tir: dict[str, Any], tasks: dict[str, Any],
+                        ctx: dict[str, Any]) -> dict[str, Any]:
         comp = spec["components"][tir["component"]]
         resolved = {}
         for iname, binding in tir["inputs"].items():
-            if "constant" in binding:
-                resolved[iname] = binding["constant"]
-            elif "pipelineParam" in binding:
-                pname = binding["pipelineParam"]
-                if params.get(pname) is None:
-                    raise ValueError(f"pipeline parameter {pname!r} not set")
-                resolved[iname] = params[pname]
-            else:
-                to = binding["taskOutput"]
-                out = tasks[to["task"]]["outputs"][to["output"]]
-                resolved[iname] = self.artifacts.get_json(out["uri"])
+            resolved[iname] = self._resolve_ref(run, spec, binding, tasks,
+                                                ctx)
         for iname, ispec in comp["inputs"].items():
             if iname not in resolved and "default" in ispec:
                 resolved[iname] = ispec["default"]
         return resolved
 
-    def _task_dir(self, run: dict[str, Any], tname: str) -> str:
-        d = os.path.join(self.root, "runs", run["metadata"]["uid"], tname)
+    # -- control flow (conditions / loops / skip propagation) -----------------
+
+    _TERMINAL_OK = ("Succeeded", "Cached", "Skipped")
+
+    def _instances(self, run, spec, tname: str, tir: dict[str, Any],
+                   tasks: dict[str, Any]
+                   ) -> list[tuple[str, Any]] | None:
+        """Instance keys (+ per-instance loop item) for a task; None while a
+        loop's items are not resolvable yet."""
+        loop = tir.get("loop")
+        if not loop:
+            return [(tname, None)]
+        binding = loop["items"]
+        if "taskOutput" in binding:
+            # the only genuinely deferred case: wait for the producer;
+            # anything else (unset param, bad type) must raise and FAIL the
+            # run rather than read as "not ready yet" forever
+            src = binding["taskOutput"]["task"]
+            sstate = tasks.get(src, {}).get("state")
+            if sstate == "Skipped":
+                return []
+            if sstate not in ("Succeeded", "Cached"):
+                return None
+        items = self._resolve_ref(run, spec, binding, tasks,
+                                  {"group": None, "index": None,
+                                   "item": None})
+        if not isinstance(items, list):
+            raise ValueError(
+                f"ParallelFor items for {tname!r} must be a list, "
+                f"got {type(items).__name__}")
+        return [(f"{tname}[{i}]", item) for i, item in enumerate(items)]
+
+    def _deps_state(self, dag: dict[str, Any], tir: dict[str, Any],
+                    key: str, item: Any, tasks: dict[str, Any],
+                    expansion: dict[str, list | None]) -> str:
+        """'ready' | 'wait' | 'skip' for one instance. Data dependencies on
+        a Skipped producer skip this task too (kfp's dependent-task
+        semantics); pure ordering deps treat Skipped as satisfied. A loop
+        that expanded to zero instances is vacuously satisfied."""
+        ctx = self._instance_ctx(tir, key, item)
+        data_deps = {b["taskOutput"]["task"]
+                     for b in tir["inputs"].values() if "taskOutput" in b}
+        for c in tir.get("conditions", []):
+            for b in (c["operand"], c["value"]):
+                if "taskOutput" in b:
+                    data_deps.add(b["taskOutput"]["task"])
+        for dep in tir["dependencies"]:
+            dep_tir = dag[dep]
+            dep_loop = dep_tir.get("loop")
+            if (dep_loop and dep_loop["group"] == ctx["group"]
+                    and ctx["index"] is not None):
+                dep_keys = [f"{dep}[{ctx['index']}]"]
+            elif dep_loop:
+                # depending on a whole loop from outside: every instance
+                exp = expansion.get(dep)
+                if exp is None:
+                    return "wait"   # loop not expanded yet
+                dep_keys = [k for k, _ in exp]   # [] = vacuously done
+            else:
+                dep_keys = [dep]
+            states = [tasks.get(k, {}).get("state") for k in dep_keys]
+            if not all(s in self._TERMINAL_OK for s in states):
+                return "wait"
+            if dep in data_deps and any(s == "Skipped" for s in states):
+                return "skip"
+        return "ready"
+
+    _OPS = {"==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b}
+
+    def _conditions_hold(self, run, spec, tir: dict[str, Any],
+                         tasks: dict[str, Any],
+                         ctx: dict[str, Any]) -> bool:
+        for c in tir.get("conditions", []):
+            lhs = self._resolve_ref(run, spec, c["operand"], tasks, ctx)
+            rhs = self._resolve_ref(run, spec, c["value"], tasks, ctx)
+            if not self._OPS[c["operator"]](lhs, rhs):
+                return False
+        return True
+
+    def _main_progress(self, dag: dict[str, Any], exit_task: str | None,
+                       tasks: dict[str, Any],
+                       expansion: dict[str, list | None]
+                       ) -> tuple[bool, bool]:
+        """(all main tasks terminal-ok, any instance still Running)."""
+        running = any(t.get("state") == "Running" for k, t in tasks.items()
+                      if k != exit_task)
+        done = True
+        for tname in dag:
+            if tname == exit_task:
+                continue
+            instances = expansion.get(tname)
+            if instances is None:
+                done = False
+                continue
+            for key, _item in instances:
+                if tasks.get(key, {}).get("state") not in self._TERMINAL_OK:
+                    done = False
+        return done, running
+
+    @staticmethod
+    def _fs_key(key: str) -> str:
+        """Instance key -> filesystem/pod-safe name (double[3] -> double-it3)."""
+        return key.replace("[", "-it").replace("]", "")
+
+    def _task_dir(self, run: dict[str, Any], key: str) -> str:
+        d = os.path.join(self.root, "runs", run["metadata"]["uid"],
+                         self._fs_key(key))
         os.makedirs(d, exist_ok=True)
         return d
 
     def _start_task(self, run: dict[str, Any], spec: dict[str, Any],
                     tname: str, tir: dict[str, Any],
-                    tasks: dict[str, Any]) -> dict[str, Any]:
+                    tasks: dict[str, Any], *, key: str,
+                    ctx: dict[str, Any]) -> dict[str, Any]:
         comp = spec["components"][tir["component"]]
         try:
-            inputs = self._resolve_inputs(run, spec, tir, tasks)
+            inputs = self._resolve_inputs(run, spec, tir, tasks, ctx)
         except (ValueError, KeyError) as e:
             return {"state": "Failed", "message": f"input resolution: {e}"}
         cache_key = json_digest({"component": comp["digest"],
@@ -222,18 +467,18 @@ class PipelineRunController(Controller):
             hit = self.metadata.cached_outputs(cache_key)
             if hit is not None:
                 eid = self.metadata.create_execution(
-                    run_id, tname, tir["component"], cache_key)
+                    run_id, key, tir["component"], cache_key)
                 self.metadata.finish_execution(eid, "CACHED")
                 return {"state": "Cached", "cached": True,
                         "outputs": {n: {"uri": a.uri, "digest": a.digest}
                                     for n, a in hit.items()},
                         "executionId": eid}
-        task_dir = self._task_dir(run, tname)
+        task_dir = self._task_dir(run, key)
         with open(os.path.join(task_dir, "component.json"), "w") as f:
             json.dump(comp, f)
         with open(os.path.join(task_dir, "inputs.json"), "w") as f:
             json.dump(inputs, f, default=str)
-        eid = self.metadata.create_execution(run_id, tname, tir["component"],
+        eid = self.metadata.create_execution(run_id, key, tir["component"],
                                              cache_key)
         for iname, ival in inputs.items():
             self.metadata.record_io(eid, iname, self.artifacts.put_json(ival),
@@ -251,10 +496,10 @@ class PipelineRunController(Controller):
             template["backend"] = "thread"
             template["target"] = "pipeline_task"
         pod = new_resource(
-            "Pod", self._pod_name(run, tname), spec=template,
+            "Pod", self._pod_name(run, key), spec=template,
             namespace=run["metadata"].get("namespace", "default"),
             labels={RUN_LABEL: run["metadata"]["name"],
-                    "kubeflow-tpu/pipeline-task": tname},
+                    "kubeflow-tpu/pipeline-task": key},
             owner=run)
         try:
             self.store.create(pod)
@@ -263,20 +508,21 @@ class PipelineRunController(Controller):
         return {"state": "Running", "executionId": eid,
                 "cacheKey": cache_key}
 
-    @staticmethod
-    def _pod_name(run: dict[str, Any], tname: str) -> str:
-        return f"{run['metadata']['name']}-{tname}"
+    @classmethod
+    def _pod_name(cls, run: dict[str, Any], key: str) -> str:
+        return f"{run['metadata']['name']}-{cls._fs_key(key)}"
 
     def _check_pod(self, run: dict[str, Any], spec: dict[str, Any],
-                   tname: str, st: dict[str, Any]) -> dict[str, Any] | None:
+                   tname: str, key: str,
+                   st: dict[str, Any]) -> dict[str, Any] | None:
         ns = run["metadata"].get("namespace", "default")
-        pod = self.store.try_get("Pod", self._pod_name(run, tname), ns)
+        pod = self.store.try_get("Pod", self._pod_name(run, key), ns)
         if pod is None:
             self.metadata.finish_execution(st.get("executionId", 0), "FAILED")
             return {**st, "state": "Failed", "message": "pod disappeared"}
         phase = pod["status"].get("phase", "Pending")
         if phase == "Failed":
-            err_path = os.path.join(self._task_dir(run, tname), "error.txt")
+            err_path = os.path.join(self._task_dir(run, key), "error.txt")
             msg = ""
             if os.path.exists(err_path):
                 with open(err_path) as f:
@@ -285,7 +531,7 @@ class PipelineRunController(Controller):
             return {**st, "state": "Failed", "message": msg or "task failed"}
         if phase != "Succeeded":
             return None
-        out_path = os.path.join(self._task_dir(run, tname), "outputs.json")
+        out_path = os.path.join(self._task_dir(run, key), "outputs.json")
         comp = spec["components"][spec["root"]["dag"]["tasks"][tname]
                                   ["component"]]
         values: dict[str, Any] = {}

@@ -21,10 +21,23 @@ per-component digests that drive step caching.
 
     spec = dsl.compile_pipeline(demo)
 
-Control flow: tasks run when their data dependencies complete; explicit
-ordering via `task.after(other)`. (KFP's dsl.Condition/ParallelFor are
-compiled control-flow containers; here conditional/fan-out steps are plain
-Python inside components — idiomatic for a single-IR engine.)
+Control flow (the kfp compiled-control-flow analogs, ⊘ kfp
+`dsl.Condition`/`dsl.ParallelFor`/`dsl.ExitHandler`):
+
+    with dsl.If(a.output, ">", 10):       # runtime-evaluated; group skips
+        b = double(n=a.output)            # (and data-dependents skip too)
+
+    with dsl.ParallelFor([1, 2, 3]) as item:   # fan-out: one instance per
+        c = double(n=item)                     # item (list, param, or an
+        d = double(n=c.output)                 # upstream output); chains
+                                               # inside the loop stay
+                                               # per-iteration
+
+    finalize = cleanup()                  # always runs, even on failure
+    with dsl.ExitHandler(finalize):
+        risky = train(...)
+
+    task.set_retry(2)                     # per-task retry budget
 """
 
 from __future__ import annotations
@@ -55,6 +68,29 @@ class PipelineParam:
 class TaskOutput:
     task: str
     output: str
+
+
+@dataclass(frozen=True)
+class LoopItem:
+    """Placeholder for the current ParallelFor item (bindable as an input
+    of tasks inside that loop group)."""
+    group: str
+
+
+_OPERATORS = ("==", "!=", ">", ">=", "<", "<=")
+
+
+@dataclass(frozen=True)
+class Predicate:
+    """Runtime predicate `operand <operator> value`. Operand/value may be a
+    TaskOutput, PipelineParam, LoopItem, or constant."""
+    operand: Any
+    operator: str
+    value: Any
+
+    def __post_init__(self):
+        if self.operator not in _OPERATORS:
+            raise DSLError(f"operator {self.operator!r} not in {_OPERATORS}")
 
 
 def _strip_decorators(source: str) -> str:
@@ -126,6 +162,10 @@ class Task:
         self.component = component
         self.inputs = inputs
         self.dependencies: set[str] = set()
+        self.conditions: list[Predicate] = []
+        self.loop_group: str | None = None
+        self.loop_items: Any = None
+        self.retries: int = 0
         for v in inputs.values():
             if isinstance(v, TaskOutput):
                 self.dependencies.add(v.task)
@@ -135,6 +175,13 @@ class Task:
 
     def after(self, *tasks: "Task") -> "Task":
         self.dependencies.update(t.name for t in tasks)
+        return self
+
+    def set_retry(self, num_retries: int) -> "Task":
+        """Retry budget for this task's pod (kfp task.set_retry analog)."""
+        if num_retries < 0:
+            raise DSLError("num_retries must be >= 0")
+        self.retries = num_retries
         return self
 
     @property
@@ -150,21 +197,38 @@ class Task:
         return {o: TaskOutput(self.name, o) for o in self.component.outputs}
 
     def to_ir(self) -> dict[str, Any]:
-        def encode(v):
-            if isinstance(v, TaskOutput):
-                return {"taskOutput": {"task": v.task, "output": v.output}}
-            if isinstance(v, PipelineParam):
-                return {"pipelineParam": v.name}
-            return {"constant": v}
-        return {"component": self.component.name,
-                "inputs": {k: encode(v) for k, v in self.inputs.items()},
-                "dependencies": sorted(self.dependencies)}
+        ir = {"component": self.component.name,
+              "inputs": {k: _encode(v) for k, v in self.inputs.items()},
+              "dependencies": sorted(self.dependencies)}
+        if self.conditions:
+            ir["conditions"] = [
+                {"operand": _encode(c.operand), "operator": c.operator,
+                 "value": _encode(c.value)} for c in self.conditions]
+        if self.loop_group is not None:
+            ir["loop"] = {"group": self.loop_group,
+                          "items": _encode(self.loop_items)}
+        if self.retries:
+            ir["retries"] = self.retries
+        return ir
+
+
+def _encode(v):
+    if isinstance(v, TaskOutput):
+        return {"taskOutput": {"task": v.task, "output": v.output}}
+    if isinstance(v, PipelineParam):
+        return {"pipelineParam": v.name}
+    if isinstance(v, LoopItem):
+        return {"loopItem": v.group}
+    return {"constant": v}
 
 
 class _PipelineContext:
     def __init__(self):
         self.tasks: dict[str, Task] = {}
         self.components: dict[str, Component] = {}
+        self.group_stack: list[Any] = []   # active If / ParallelFor groups
+        self.exit_task: str | None = None
+        self._loop_seq = 0
 
     def add_task(self, component: Component, kwargs: dict[str, Any]) -> Task:
         known = self.components.get(component.name)
@@ -185,8 +249,104 @@ class _PipelineContext:
             i += 1
             name = f"{base}-{i}"
         task = Task(name, component, kwargs)
+        loops = [g for g in self.group_stack if isinstance(g, ParallelFor)]
+        if len(loops) > 1:
+            raise DSLError("nested ParallelFor is not supported")
+        if loops:
+            task.loop_group = loops[0]._group
+            task.loop_items = loops[0].items
+            if isinstance(loops[0].items, TaskOutput):
+                task.dependencies.add(loops[0].items.task)
+        for g in self.group_stack:
+            if isinstance(g, If):
+                task.conditions.append(g.condition)
+                # condition operands are implicit dependencies: the engine
+                # can only evaluate the predicate once they exist
+                for ref in (g.condition.operand, g.condition.value):
+                    if isinstance(ref, TaskOutput):
+                        task.dependencies.add(ref.task)
         self.tasks[name] = task
         return task
+
+
+class _Group:
+    def __enter__(self):
+        if not _ACTIVE:
+            raise DSLError(
+                f"{type(self).__name__} is only usable inside a pipeline")
+        _ACTIVE[-1].group_stack.append(self)
+        return self._payload()
+
+    def __exit__(self, *exc):
+        _ACTIVE[-1].group_stack.pop()
+
+    def _payload(self):
+        return self
+
+
+class If(_Group):
+    """Runtime-conditional group (kfp dsl.Condition/dsl.If analog): tasks
+    inside run only when `operand <operator> value` holds at runtime;
+    otherwise they (and their data-dependents) are Skipped."""
+
+    def __init__(self, operand: Any, operator: str, value: Any):
+        self.condition = Predicate(operand, operator, value)
+
+
+# kfp v1 spells this dsl.Condition; same group, same semantics
+Condition = If
+
+
+class ParallelFor(_Group):
+    """Fan-out group (kfp dsl.ParallelFor analog): tasks inside run once
+    per item; `with ParallelFor(items) as item:` binds the per-instance
+    value. Items may be a constant list, a PipelineParam, or an upstream
+    TaskOutput producing a list. Chains inside the loop stay
+    per-iteration; outputs of looped tasks cannot be consumed outside the
+    loop (no Collected support)."""
+
+    def __init__(self, items: Any):
+        if not isinstance(items, (list, tuple, PipelineParam, TaskOutput)):
+            raise DSLError(
+                "ParallelFor items must be a list, a pipeline parameter, "
+                "or a task output")
+        self.items = list(items) if isinstance(items, (list, tuple)) \
+            else items
+        self._group = ""
+
+    def __enter__(self):
+        if not _ACTIVE:
+            raise DSLError("ParallelFor is only usable inside a pipeline")
+        ctx = _ACTIVE[-1]
+        ctx._loop_seq += 1
+        self._group = f"loop-{ctx._loop_seq}"
+        ctx.group_stack.append(self)
+        return LoopItem(self._group)
+
+
+class ExitHandler(_Group):
+    """Guaranteed-finalizer group (kfp dsl.ExitHandler analog): the exit
+    task runs once every other task is terminal — even when the run is
+    failing."""
+
+    def __init__(self, exit_task: Task):
+        if not isinstance(exit_task, Task):
+            raise DSLError("ExitHandler takes the finalizer Task")
+        self.exit_task = exit_task
+
+    def __enter__(self):
+        if not _ACTIVE:
+            raise DSLError("ExitHandler is only usable inside a pipeline")
+        ctx = _ACTIVE[-1]
+        if ctx.exit_task is not None:
+            raise DSLError("only one ExitHandler per pipeline")
+        if (self.exit_task.dependencies or self.exit_task.conditions
+                or self.exit_task.loop_group):
+            raise DSLError("the exit task must be unconditional and "
+                           "dependency-free")
+        ctx.exit_task = self.exit_task.name
+        ctx.group_stack.append(self)
+        return self
 
 
 class Pipeline:
@@ -230,16 +390,46 @@ def compile_pipeline(p: Pipeline) -> dict[str, Any]:
         _ACTIVE.pop()
     if not ctx.tasks:
         raise DSLError(f"pipeline {p.name!r} defines no tasks")
+    _check_group_scoping(ctx)
+    root: dict[str, Any] = {"dag": {"tasks": {t.name: t.to_ir()
+                                              for t in ctx.tasks.values()}}}
+    if ctx.exit_task is not None:
+        root["exitTask"] = ctx.exit_task
     spec = {
         "pipelineInfo": {"name": p.name, "description": p.description},
         "components": {c.name: c.to_ir() for c in ctx.components.values()},
-        "root": {"dag": {"tasks": {t.name: t.to_ir()
-                                   for t in ctx.tasks.values()}}},
+        "root": root,
         "parameters": p.params,
         "schemaVersion": "ktpu/v1",
     }
     _check_acyclic(spec)
     return spec
+
+
+def _check_group_scoping(ctx: "_PipelineContext") -> None:
+    """Loop outputs stay inside their group; LoopItem binds only inside
+    its own loop."""
+    group_of = {t.name: t.loop_group for t in ctx.tasks.values()}
+    for t in ctx.tasks.values():
+        cond_refs = [r for c in t.conditions for r in (c.operand, c.value)]
+        if (isinstance(t.loop_items, TaskOutput)
+                and group_of.get(t.loop_items.task) is not None):
+            raise DSLError(
+                f"{t.name}: ParallelFor items come from looped task "
+                f"{t.loop_items.task!r}; looped outputs cannot escape "
+                "their loop")
+        for v in list(t.inputs.values()) + cond_refs:
+            if isinstance(v, TaskOutput):
+                src_group = group_of.get(v.task)
+                if src_group is not None and src_group != t.loop_group:
+                    raise DSLError(
+                        f"{t.name} consumes {v.task}.{v.output} from inside "
+                        f"ParallelFor group {src_group!r}; looped outputs "
+                        "cannot escape their loop")
+            if isinstance(v, LoopItem) and v.group != t.loop_group:
+                raise DSLError(
+                    f"{t.name} binds the loop item of {v.group!r} outside "
+                    "that ParallelFor")
 
 
 def _check_acyclic(spec: dict[str, Any]) -> None:

@@ -1,0 +1,331 @@
+"""Compiled control flow in pipelines: dsl.If, dsl.ParallelFor,
+dsl.ExitHandler, per-task retries (kfp's control-flow containers,
+SURVEY.md §2.5)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu import pipelines as kfp
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.pipelines import dsl
+
+
+@dsl.component
+def emit(n: int) -> int:
+    return n
+
+
+@dsl.component
+def double(n: int) -> int:
+    return n * 2
+
+
+@dsl.component
+def make_list(n: int) -> list:
+    return list(range(n))
+
+
+@dsl.component
+def mark(tag: str) -> str:
+    return tag
+
+
+@dsl.component
+def flaky_twice(marker: str) -> int:
+    import os
+    count = int(open(marker).read()) if os.path.exists(marker) else 0
+    with open(marker, "w") as f:
+        f.write(str(count + 1))
+    if count < 2:
+        raise RuntimeError(f"flaky attempt {count}")
+    return count
+
+
+@dsl.component
+def boom() -> int:
+    raise RuntimeError("kaboom")
+
+
+@pytest.fixture()
+def pipe_cluster(tmp_path):
+    c = Cluster(n_devices=8)
+    ctrl = c.add(kfp.PipelineRunController, root=str(tmp_path))
+    with c:
+        yield c, ctrl
+
+
+def run_pipeline(cluster, p, name, parameters=None, timeout=60):
+    cluster.store.create(new_resource(kfp.RUN_KIND, name, spec={
+        "pipelineSpec": kfp.compile_pipeline(p),
+        "parameters": parameters or {}}))
+    return cluster.wait_for(kfp.RUN_KIND, name,
+                            lambda o: is_finished(o["status"]),
+                            timeout=timeout)
+
+
+# -- dsl.If -------------------------------------------------------------------
+
+@dsl.pipeline
+def conditional(n: int = 3):
+    a = emit(n=n)
+    with dsl.If(a.output, ">", 10):
+        b = double(n=a.output)
+        with dsl.If(a.output, "<", 100):   # nested: AND semantics
+            double(n=b.output)
+    with dsl.If(a.output, "<=", 10):
+        mark(tag="small")
+
+
+def test_condition_true_branch_runs(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+    run = run_pipeline(cluster, conditional, "ct", {"n": 42})
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    states = {t: s["state"] for t, s in run["status"]["tasks"].items()}
+    assert states["double"] == "Succeeded"
+    assert states["double-2"] == "Succeeded"
+    assert states["mark"] == "Skipped"
+    assert ctrl.task_output("ct", "double-2") == 168
+
+
+def test_condition_false_branch_skips_and_propagates(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+    run = run_pipeline(cluster, conditional, "cf", {"n": 3})
+    states = {t: s["state"] for t, s in run["status"]["tasks"].items()}
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    assert states["double"] == "Skipped"
+    # double-2 data-depends on skipped double -> skipped, not failed
+    assert states["double-2"] == "Skipped"
+    assert states["mark"] == "Succeeded"
+    assert "skipped" in run["status"]["conditions"][-1]["message"]
+
+
+# -- dsl.ParallelFor ----------------------------------------------------------
+
+@dsl.pipeline
+def fan_out():
+    items = make_list(n=3)
+    with dsl.ParallelFor(items.output) as item:
+        d = double(n=item)
+        double(n=d.output)   # chained: stays per-iteration
+
+
+def test_parallel_for_expands_per_item(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+    run = run_pipeline(cluster, fan_out, "pf")
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    tasks = run["status"]["tasks"]
+    for i, item in enumerate(range(3)):
+        assert tasks[f"double[{i}]"]["state"] in ("Succeeded", "Cached")
+        assert ctrl.task_output("pf", f"double[{i}]") == 2 * item
+        assert ctrl.task_output("pf", f"double-2[{i}]") == 4 * item
+
+
+def test_parallel_for_static_list_and_param(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def static_loop():
+        with dsl.ParallelFor([5, 7]) as item:
+            double(n=item)
+
+    run = run_pipeline(cluster, static_loop, "sl")
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    assert ctrl.task_output("sl", "double[0]") == 10
+    assert ctrl.task_output("sl", "double[1]") == 14
+
+
+def test_parallel_for_downstream_barrier(pipe_cluster):
+    """A task .after() a looped task waits for ALL its instances."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def loop_then_join():
+        with dsl.ParallelFor([1, 2, 3]) as item:
+            d = double(n=item)
+        mark(tag="joined").after(d)
+
+    run = run_pipeline(cluster, loop_then_join, "lj")
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    assert run["status"]["tasks"]["mark"]["state"] == "Succeeded"
+
+
+def test_loop_output_escape_rejected():
+    with pytest.raises(dsl.DSLError, match="cannot escape"):
+        @dsl.pipeline
+        def bad():
+            with dsl.ParallelFor([1, 2]) as item:
+                d = double(n=item)
+            double(n=d.output)
+
+        kfp.compile_pipeline(bad)
+
+
+def test_nested_parallel_for_rejected():
+    with pytest.raises(dsl.DSLError, match="nested ParallelFor"):
+        @dsl.pipeline
+        def nested():
+            with dsl.ParallelFor([1]) as a:
+                with dsl.ParallelFor([2]) as b:
+                    double(n=b)
+
+        kfp.compile_pipeline(nested)
+
+
+# -- dsl.ExitHandler ----------------------------------------------------------
+
+def test_exit_handler_runs_on_success(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def with_exit():
+        fin = mark(tag="finalized")
+        with dsl.ExitHandler(fin):
+            double(n=2)
+
+    run = run_pipeline(cluster, with_exit, "eh1")
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    assert run["status"]["tasks"]["mark"]["state"] in ("Succeeded", "Cached")
+    assert ctrl.task_output("eh1", "mark") == "finalized"
+
+
+def test_exit_handler_runs_on_failure(pipe_cluster):
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def failing_with_exit():
+        fin = mark(tag="cleanup")
+        with dsl.ExitHandler(fin):
+            boom()
+
+    run = run_pipeline(cluster, failing_with_exit, "eh2")
+    assert has_condition(run["status"], JobConditionType.FAILED)
+    # the finalizer still ran
+    assert run["status"]["tasks"]["mark"]["state"] in ("Succeeded", "Cached")
+    assert "boom" in run["status"]["conditions"][-1]["message"]
+
+
+# -- retries ------------------------------------------------------------------
+
+def test_set_retry_recovers_flaky_task(pipe_cluster, tmp_path):
+    cluster, ctrl = pipe_cluster
+    marker = str(tmp_path / "flaky-marker")
+
+    @dsl.pipeline
+    def retried(marker: str = ""):
+        flaky_twice(marker=marker).set_retry(3)
+
+    run = run_pipeline(cluster, retried, "rt", {"marker": marker},
+                       timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    st = run["status"]["tasks"]["flaky_twice"]
+    assert st["attempt"] == 2   # two failures, third attempt succeeded
+
+
+def test_retry_budget_exhausted_fails(pipe_cluster):
+    cluster, _ = pipe_cluster
+
+    @dsl.pipeline
+    def hopeless():
+        boom().set_retry(1)
+
+    run = run_pipeline(cluster, hopeless, "rx")
+    assert has_condition(run["status"], JobConditionType.FAILED)
+    assert run["status"]["tasks"]["boom"]["attempt"] == 1
+
+
+# -- review-regression: user errors must FAIL the run, never hang it ---------
+
+@dsl.component
+def emit_word() -> str:
+    return "five"
+
+
+def test_parallel_for_unset_param_fails_not_hangs(pipe_cluster):
+    cluster, _ = pipe_cluster
+
+    @dsl.pipeline
+    def loop_over_param(xs: list = None):  # noqa: RUF013 - no default given
+        with dsl.ParallelFor(dsl.PipelineParam("xs")) as item:
+            double(n=item)
+
+    run = run_pipeline(cluster, loop_over_param, "up", timeout=30)
+    assert has_condition(run["status"], JobConditionType.FAILED)
+    assert "not set" in run["status"]["conditions"][-1]["message"]
+
+
+def test_parallel_for_non_list_items_fails(pipe_cluster):
+    cluster, _ = pipe_cluster
+
+    @dsl.pipeline
+    def loop_over_scalar():
+        src = emit(n=7)
+        with dsl.ParallelFor(src.output) as item:
+            double(n=item)
+
+    run = run_pipeline(cluster, loop_over_scalar, "nl", timeout=30)
+    assert has_condition(run["status"], JobConditionType.FAILED)
+    assert "must be a list" in run["status"]["conditions"][-1]["message"]
+
+
+def test_empty_dynamic_loop_vacuously_succeeds(pipe_cluster):
+    cluster, _ = pipe_cluster
+
+    @dsl.pipeline
+    def empty_loop():
+        src = make_list(n=0)
+        with dsl.ParallelFor(src.output) as item:
+            d = double(n=item)
+        mark(tag="after-empty").after(d)
+
+    run = run_pipeline(cluster, empty_loop, "el", timeout=30)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    assert run["status"]["tasks"]["mark"]["state"] == "Succeeded"
+
+
+def test_condition_type_mismatch_fails_not_hangs(pipe_cluster):
+    cluster, _ = pipe_cluster
+
+    @dsl.pipeline
+    def bad_compare():
+        w = emit_word()
+        with dsl.If(w.output, ">", 10):
+            double(n=1)
+
+    run = run_pipeline(cluster, bad_compare, "tm", timeout=30)
+    assert has_condition(run["status"], JobConditionType.FAILED)
+    assert "condition" in run["status"]["conditions"][-1]["message"]
+
+
+def test_loop_items_from_looped_task_rejected_at_compile():
+    with pytest.raises(dsl.DSLError, match="cannot escape"):
+        @dsl.pipeline
+        def sibling_loops():
+            with dsl.ParallelFor([1, 2]) as i:
+                d = double(n=i)
+            with dsl.ParallelFor(d.output) as j:
+                double(n=j)
+
+        kfp.compile_pipeline(sibling_loops)
+
+
+def test_exit_handler_honors_set_retry(pipe_cluster, tmp_path):
+    cluster, _ = pipe_cluster
+    marker = str(tmp_path / "exit-marker")
+
+    @dsl.pipeline
+    def flaky_finalizer(marker: str = ""):
+        fin = flaky_twice(marker=marker).set_retry(3)
+        with dsl.ExitHandler(fin):
+            double(n=1)
+
+    run = run_pipeline(cluster, flaky_finalizer, "ef", {"marker": marker},
+                       timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    assert run["status"]["tasks"]["flaky_twice"]["attempt"] == 2
