@@ -74,6 +74,10 @@ class Platform:
             self.cluster.add(ScheduledRunController)
         if "serving" in components:
             self.serving = self.cluster.add(InferenceServiceController)
+            from kubeflow_tpu.serving.trainedmodel import \
+                TrainedModelController
+
+            self.cluster.add(TrainedModelController)
         if "platform" in components:
             # L2 platform glue (SURVEY.md §2.1): multi-tenancy, workspaces,
             # PodDefault admission

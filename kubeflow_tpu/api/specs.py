@@ -49,6 +49,11 @@ def _register_framework_validators() -> None:
     VALIDATORS.update(job_validators())
     VALIDATORS[KFDEF_KIND] = validate_kfdef
 
+    from kubeflow_tpu.serving.trainedmodel import (TRAINEDMODEL_KIND,
+                                                   validate_trainedmodel)
+
+    VALIDATORS[TRAINEDMODEL_KIND] = validate_trainedmodel
+
 
 _register_framework_validators()
 

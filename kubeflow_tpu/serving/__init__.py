@@ -20,6 +20,9 @@ from kubeflow_tpu.serving.router import Router
 from kubeflow_tpu.serving.server import ModelServer
 from kubeflow_tpu.serving.storage import StorageError, download
 from kubeflow_tpu.serving.agent import MultiModelAgent, PayloadLogger
+from kubeflow_tpu.serving.trainedmodel import (TRAINEDMODEL_KIND,
+                                               TrainedModelController,
+                                               validate_trainedmodel)
 from kubeflow_tpu.serving import llm_runtime as _llm_runtime  # noqa: F401
 # ^ imported for its @serving_runtime("llama") registration side effect
 
@@ -28,6 +31,7 @@ __all__ = [
     "InferResponse", "InferTensor", "InferenceServiceController", "Model",
     "ModelError", "ModelRepository", "ModelServer", "MultiModelAgent",
     "PayloadLogger", "ProtocolError",
-    "Router", "StorageError", "download", "load_model", "serving_runtime",
-    "v1_decode", "v1_encode", "validate_isvc",
+    "Router", "StorageError", "TRAINEDMODEL_KIND", "TrainedModelController",
+    "download", "load_model", "serving_runtime",
+    "v1_decode", "v1_encode", "validate_isvc", "validate_trainedmodel",
 ]

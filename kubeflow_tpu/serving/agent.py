@@ -134,6 +134,11 @@ class MultiModelAgent:
         self._lock = threading.Lock()
         self._last_used: dict[str, float] = {}
         self._loading: set[str] = set()
+        # models THIS agent pulled: capacity and eviction apply only to
+        # them — a shared repository may hold models owned by others (the
+        # host InferenceService's own predictor model must never be evicted
+        # to make room for attached TrainedModels)
+        self._owned: set[str] = set()
         self.pulls = 0
         self.evictions = 0
 
@@ -145,6 +150,13 @@ class MultiModelAgent:
                 existing = self.repository.get(name)
             except ModelError:
                 existing = None
+            if existing is not None and name not in self._owned:
+                # a foreign model (e.g. the host service's own predictor)
+                # already claims this name — silently returning it would
+                # report success while serving the WRONG model
+                raise ModelError(
+                    f"model name {name!r} is already in use by the host "
+                    f"repository")
             if existing is not None or name in self._loading:
                 self._last_used[name] = time.monotonic()
                 if existing is not None:
@@ -160,6 +172,7 @@ class MultiModelAgent:
             with self._lock:
                 self.pulls += 1
                 self._loading.discard(name)
+                self._owned.add(name)
                 self._last_used[name] = time.monotonic()
             self._evict_over_capacity()
             return model
@@ -176,15 +189,19 @@ class MultiModelAgent:
     def unload(self, name: str) -> None:
         with self._lock:
             self._last_used.pop(name, None)
+            self._owned.discard(name)
         self.repository.unload(name)
 
     def loaded(self) -> list[str]:
-        return self.repository.names()
+        """Models this agent pulled (still loaded)."""
+        names = set(self.repository.names())
+        with self._lock:
+            return sorted(self._owned & names)
 
     def _evict_over_capacity(self) -> None:
         while True:
             with self._lock:
-                names = self.repository.names()
+                names = self._owned & set(self.repository.names())
                 if len(names) <= self.max_loaded:
                     return
                 # oldest by last use; names never touched sort first
@@ -195,6 +212,7 @@ class MultiModelAgent:
                 if victim is None:
                     return
                 self._last_used.pop(victim, None)
+                self._owned.discard(victim)
                 self.evictions += 1
                 # unload INSIDE the lock: selection + removal must be atomic
                 # against a concurrent pull() returning the victim (which

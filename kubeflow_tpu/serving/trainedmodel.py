@@ -1,0 +1,160 @@
+"""TrainedModel controller — kserve's multi-model serving CRD (SURVEY.md
+§2.4 ModelMesh/agent-puller rows, ⊘ kserve `pkg/apis/serving/v1alpha1/
+trainedmodel_types.go` + `pkg/controller/v1alpha1/trainedmodel`): attach
+additional models to a running InferenceService's predictor server instead
+of spinning one service per model (high-density serving).
+
+    kind: TrainedModel
+    metadata: {name: sentiment-v2}
+    spec:
+      inferenceService: my-isvc        # host service
+      model:
+        modelFormat: echo              # any registered serving runtime
+        uri: /path/or/scheme://...     # optional (runtime-dependent)
+        config: {...}                  # runtime kwargs
+
+The host predictor's ModelServer repository gains the model (pulled
+through a per-ISVC MultiModelAgent with LRU eviction sized by the ISVC's
+`spec.predictor.maxLoadedModels`, default 8); requests route by model name
+on the existing dataplane: POST {isvc-url}/v1/models/<trainedmodel>:predict.
+Deleting the TrainedModel unloads it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeflow_tpu.control.conditions import JobConditionType, set_condition
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.pipelines.artifacts import json_digest
+from kubeflow_tpu.serving.agent import MultiModelAgent
+from kubeflow_tpu.serving.controller import (ISVC_KIND,
+                                             InferenceServiceController)
+from kubeflow_tpu.serving.model import ModelError
+from kubeflow_tpu.serving.storage import StorageError
+
+TRAINEDMODEL_KIND = "TrainedModel"
+
+
+def validate_trainedmodel(tm: dict[str, Any]) -> list[str]:
+    errs = []
+    spec = tm.get("spec", {})
+    if not spec.get("inferenceService"):
+        errs.append("spec.inferenceService is required")
+    model = spec.get("model")
+    if not model:
+        errs.append("spec.model is required")
+    elif not model.get("modelFormat"):
+        errs.append("spec.model.modelFormat is required")
+    return errs
+
+
+class TrainedModelController(Controller):
+    kind = TRAINEDMODEL_KIND
+
+    def __init__(self, cluster):
+        super().__init__(cluster)
+        # one puller per host predictor server (keyed like the ISVC
+        # controller's instances)
+        self._agents: dict[tuple[str, str], MultiModelAgent] = {}
+
+    def _isvc_controller(self) -> InferenceServiceController | None:
+        for c in self.cluster.controllers:
+            if isinstance(c, InferenceServiceController):
+                return c
+        return None
+
+    def _agent(self, ns: str, isvc_name: str,
+               isvc: dict[str, Any]) -> MultiModelAgent | None:
+        ctrl = self._isvc_controller()
+        if ctrl is None:
+            return None
+        inst = ctrl._instances.get((ns, isvc_name, "predictor"))
+        if inst is None:
+            return None
+        key = (ns, isvc_name)
+        agent = self._agents.get(key)
+        if agent is None or agent.repository is not inst.server.repository:
+            # (re)build on first use and after ISVC revision restarts
+            agent = MultiModelAgent(
+                inst.server.repository,
+                max_loaded=isvc["spec"].get("predictor", {}).get(
+                    "maxLoadedModels", 8))
+            self._agents[key] = agent
+        return agent
+
+    def reconcile(self, tm: dict[str, Any]) -> float | None:
+        name = tm["metadata"]["name"]
+        ns = tm["metadata"].get("namespace", "default")
+
+        errs = validate_trainedmodel(tm)
+        if errs:
+            self._set(tm, JobConditionType.FAILED, "InvalidSpec",
+                      "; ".join(errs))
+            return None
+        isvc_name = tm["spec"]["inferenceService"]
+        isvc = self.store.try_get(ISVC_KIND, isvc_name, ns)
+        if isvc is None:
+            # drop any agent for a deleted host so its repository (and the
+            # model weights it holds) can be collected
+            self._agents.pop((ns, isvc_name), None)
+            self._set(tm, JobConditionType.FAILED, "HostNotFound",
+                      f"InferenceService {isvc_name!r} not found")
+            return 2.0   # keep checking: the host may appear later
+        agent = self._agent(ns, isvc_name, isvc)
+        if agent is None:
+            return 0.5   # host predictor not serving yet
+        digest = json_digest(tm["spec"]["model"])
+        if name in agent.loaded():
+            agent.touch(name)
+            self._set(tm, JobConditionType.RUNNING, "ModelReady",
+                      f"serving on {isvc_name}", pulledRevision=digest)
+            return None
+        if tm["status"].get("pulledRevision") == digest:
+            # was serving with this exact spec and is gone now: the agent
+            # LRU-evicted it for capacity. Re-pulling here would evict a
+            # sibling whose reconcile would pull IT back — perpetual
+            # thrash. Evicted is sticky until the spec changes (digest
+            # moves) or capacity frees up via deletes.
+            self._set(tm, "Evicted", "CapacityExceeded",
+                      f"evicted from {isvc_name} "
+                      f"(maxLoadedModels reached)")
+            return None
+        model = tm["spec"]["model"]
+        try:
+            agent.pull(name, model["modelFormat"], model.get("uri"),
+                       **(model.get("config") or {}))
+        except (ModelError, StorageError, TypeError, ValueError,
+                ImportError) as e:
+            self._set(tm, JobConditionType.FAILED, "ModelLoadFailed", str(e))
+            return None
+        self._set(tm, JobConditionType.RUNNING, "ModelReady",
+                  f"serving on {isvc_name}", pulledRevision=digest)
+        return None
+
+    def reconcile_deleted(self, name: str, namespace: str) -> float | None:
+        for (ns, _isvc), agent in self._agents.items():
+            if ns == namespace and name in agent.loaded():
+                agent.unload(name)
+        return None
+
+    def _set(self, tm: dict[str, Any], ctype: str, reason: str,
+             message: str, **extra: Any) -> None:
+        """Write status ONLY when it actually changes: an unconditional
+        mutate emits a MODIFIED watch event that re-enqueues this very
+        object — a self-triggering hot reconcile loop."""
+        st = tm.get("status", {})
+        conds = st.get("conditions", [])
+        last = conds[-1] if conds else {}
+        if (last.get("type") == ctype and last.get("reason") == reason
+                and last.get("message") == message
+                and all(st.get(k) == v for k, v in extra.items())):
+            return
+        ns = tm["metadata"].get("namespace", "default")
+        self.store.mutate(
+            TRAINEDMODEL_KIND, tm["metadata"]["name"],
+            lambda o: (o["status"].update(lastUpdateTime=time.time(),
+                                          **extra),
+                       set_condition(o["status"], ctype, reason, message)),
+            ns)

@@ -1,0 +1,140 @@
+"""TrainedModel CRD: multi-model serving on a host InferenceService
+(kserve TrainedModel/ModelMesh analog, SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import serving
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import JobConditionType, has_condition
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(n_devices=2)
+    c.add(serving.InferenceServiceController)
+    c.add(serving.TrainedModelController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "host", spec={
+            "predictor": {"model": {"modelFormat": "echo"},
+                          "minReplicas": 1,
+                          "maxLoadedModels": 2},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "host",
+            lambda o: has_condition(o["status"], "Ready"), timeout=30)
+        yield c, isvc["status"]["url"]
+
+
+def _tm(name, fmt="mean", isvc="host", config=None):
+    return new_resource(serving.TRAINEDMODEL_KIND, name, spec={
+        "inferenceService": isvc,
+        "model": {"modelFormat": fmt, **({"config": config} if config
+                                         else {})},
+    })
+
+
+def wait_ready(c, name, timeout=30):
+    return c.wait_for(
+        serving.TRAINEDMODEL_KIND, name,
+        lambda o: any(cc.get("reason") in ("ModelReady", "InvalidSpec",
+                                           "ModelLoadFailed", "HostNotFound")
+                      for cc in o["status"].get("conditions", [])),
+        timeout=timeout)
+
+
+def test_trainedmodel_serves_on_host_dataplane(cluster):
+    c, url = cluster
+    c.store.create(_tm("avg"))
+    tm = wait_ready(c, "avg")
+    assert has_condition(tm["status"], JobConditionType.RUNNING)
+    # the new model answers by name on the HOST's URL
+    out = _post(url + "/v1/models/avg:predict", {"instances": [2, 4, 6]})
+    assert out["predictions"] == 4.0
+    # the host's own model still serves
+    out = _post(url + "/v1/models/host:predict", {"instances": [1, 2]})
+    assert out["predictions"] == [1, 2]
+
+
+def test_trainedmodel_delete_unloads(cluster):
+    c, url = cluster
+    c.store.create(_tm("gone"))
+    wait_ready(c, "gone")
+    _post(url + "/v1/models/gone:predict", {"instances": [1]})
+    c.store.delete(serving.TRAINEDMODEL_KIND, "gone")
+    deadline = 50
+    while deadline:
+        deadline -= 1
+        try:
+            _post(url + "/v1/models/gone:predict", {"instances": [1]})
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            break
+        import time
+
+        time.sleep(0.1)
+    else:
+        pytest.fail("model still serving after TrainedModel deletion")
+
+
+def test_trainedmodel_lru_eviction(cluster):
+    c, url = cluster
+    for name in ("m1", "m2", "m3"):   # maxLoadedModels=2
+        c.store.create(_tm(name))
+        wait_ready(c, name)
+    serving_now = []
+    for name in ("m1", "m2", "m3", "host"):
+        try:
+            _post(url + f"/v1/models/{name}:predict", {"instances": [2]})
+            serving_now.append(name)
+        except urllib.error.HTTPError:
+            pass
+    # capacity applies only to pulled models; the HOST's own predictor
+    # model must never be evicted to make room for TrainedModels
+    assert "host" in serving_now
+    assert "m3" in serving_now
+    assert len([n for n in serving_now if n != "host"]) == 2
+    # the evicted model is STICKY-evicted (no pull/evict thrash): its
+    # status says so and it stays out until capacity frees or spec changes
+    evicted = [n for n in ("m1", "m2") if n not in serving_now]
+    assert len(evicted) == 1
+    tm = c.wait_for(
+        serving.TRAINEDMODEL_KIND, evicted[0],
+        lambda o: any(cc.get("reason") == "CapacityExceeded"
+                      for cc in o["status"].get("conditions", [])),
+        timeout=15)
+    assert tm is not None
+
+
+def test_trainedmodel_bad_specs(cluster):
+    c, _url = cluster
+    c.store.create(_tm("nohost", isvc="missing"))
+    tm = wait_ready(c, "nohost")
+    assert any(cc["reason"] == "HostNotFound"
+               for cc in tm["status"]["conditions"])
+    c.store.create(_tm("badfmt", fmt="no-such-runtime"))
+    tm = wait_ready(c, "badfmt")
+    assert any(cc["reason"] == "ModelLoadFailed"
+               for cc in tm["status"]["conditions"])
+    # a TM must not shadow the host's own model name
+    c.store.create(_tm("host"))
+    tm = wait_ready(c, "host")
+    assert any(cc["reason"] == "ModelLoadFailed"
+               and "already in use" in cc["message"]
+               for cc in tm["status"]["conditions"])
+    from kubeflow_tpu.serving.trainedmodel import validate_trainedmodel
+
+    assert validate_trainedmodel({"spec": {}}) != []
