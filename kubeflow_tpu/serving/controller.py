@@ -97,17 +97,22 @@ class _Transformer(Model):
 
 
 class _Instance:
-    """One running revision: model + server (the Knative revision analog)."""
+    """One running revision: model + server (the Knative revision analog).
+    Optionally also an Open Inference Protocol gRPC server sharing the
+    same repository (the kserve dual REST+gRPC dataplane)."""
 
     def __init__(self, isvc_name: str, component: str, revision: str,
-                 server: ModelServer):
+                 server: ModelServer, grpc_server=None):
         self.isvc_name = isvc_name
         self.component = component
         self.revision = revision
         self.server = server
+        self.grpc_server = grpc_server
 
     def stop(self) -> None:
         self.server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
 
 
 class InferenceServiceController(Controller):
@@ -190,6 +195,12 @@ class InferenceServiceController(Controller):
 
         def write(o):
             o["status"]["url"] = router.url
+            if default.get("grpcAddress"):
+                o["status"]["grpcUrl"] = default["grpcAddress"]
+            else:
+                # spec dropped grpc (or scaled to zero): a stale address
+                # would point at a torn-down server
+                o["status"].pop("grpcUrl", None)
             o["status"]["components"] = components
             o["status"]["traffic"] = {"canaryPercent": pct}
             if default.get("ready") or (scale_to_zero
@@ -238,13 +249,29 @@ class InferenceServiceController(Controller):
             lg = comp_spec["logger"]
             logger = PayloadLogger(path=lg.get("path"), url=lg.get("url"),
                                    mode=lg.get("mode", "all"))
+        batch_cfg = {model.name: batching} if batching else None
         server = ModelServer(
             repo, name=f"{name}-{component}",
-            batching={model.name: batching} if batching else None,
-            payload_logger=logger)
+            batching=batch_cfg, payload_logger=logger)
         server.start()
+        grpc_server = None
+        if comp_spec.get("grpc"):
+            try:
+                # same repository + batching config on the OIP gRPC dataplane
+                from kubeflow_tpu.serving.grpc_server import \
+                    GrpcInferenceServer
+
+                grpc_server = GrpcInferenceServer(repo, batching=batch_cfg)
+                grpc_server.start()
+            except BaseException:
+                # the HTTP server is already running but not yet registered
+                # in _instances — stop it or every failed reconcile leaks one
+                server.stop()
+                if grpc_server is not None:
+                    grpc_server.stop()
+                raise
         inst = _Instance(name, component, self._revision_of(comp_spec),
-                         server)
+                         server, grpc_server)
         with self._lock:
             self._instances[(ns, name, component)] = inst
         return inst
@@ -265,8 +292,11 @@ class InferenceServiceController(Controller):
                 return {"ready": False, "scaledToZero": True,
                         "revision": revision}
             inst = self._start_instance(isvc, component, comp_spec)
-        return {"ready": True, "port": inst.server.port,
-                "revision": inst.revision}
+        out = {"ready": True, "port": inst.server.port,
+               "revision": inst.revision}
+        if inst.grpc_server is not None:
+            out["grpcAddress"] = inst.grpc_server.address
+        return out
 
     def _stop_instance(self, ns: str, name: str, component: str) -> None:
         with self._lock:
@@ -319,6 +349,10 @@ class InferenceServiceController(Controller):
             self._stop_instance(ns, name, "predictor")
             default.update(ready=False, scaledToZero=True)
             default.pop("port", None)
+            # NOTE: reactivation rides the HTTP router (the activator); a
+            # scaled-to-zero service has no gRPC endpoint until an HTTP
+            # request wakes it
+            default.pop("grpcAddress", None)
 
     # -- queries --------------------------------------------------------------
 

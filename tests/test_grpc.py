@@ -244,3 +244,34 @@ def test_suggestion_grpc_maximize_negates(suggestion):
     t.has_objective = True
     hist = _history_from_pb(space, req.experiment, req.trials)
     assert hist[0].value == -3.0
+
+
+def test_isvc_grpc_dataplane():
+    """spec.predictor.grpc: true exposes the OIP gRPC server next to HTTP,
+    sharing the same repository; status carries grpcUrl."""
+    import numpy as np
+
+    from kubeflow_tpu import serving
+    from kubeflow_tpu.control import Cluster, new_resource
+    from kubeflow_tpu.control.conditions import has_condition
+
+    c = Cluster(n_devices=2)
+    c.add(serving.InferenceServiceController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "g1", spec={
+            "predictor": {"model": {"modelFormat": "echo"},
+                          "grpc": True, "minReplicas": 1},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "g1",
+            lambda o: has_condition(o["status"], "Ready"), timeout=30)
+        addr = isvc["status"].get("grpcUrl")
+        assert addr
+        client = GrpcInferenceClient(addr)
+        try:
+            assert client.server_live()
+            out = client.infer("g1", {"x": np.array([1.5, 2.5], np.float32)})
+            np.testing.assert_allclose(
+                next(iter(out.values())), [1.5, 2.5])
+        finally:
+            client.close()
