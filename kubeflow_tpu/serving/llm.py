@@ -121,15 +121,32 @@ class LLMEngine:
         return req_id
 
     def step(self) -> bool:
-        """One engine iteration: a prefill or a batched decode. False = idle."""
+        """One engine iteration: a prefill wave or a batched decode.
+        False = idle.
+
+        All queued prefills dispatch back-to-back BEFORE any token fetch:
+        jax's async dispatch overlaps prefill k+1's compute with prefill
+        k's device->host round-trip, so a burst of n arrivals pays ~one
+        RTT instead of n (the same chaining trick as _do_decode)."""
         with self._submit_lock:
             action = self.scheduler.next()
         if action is None:
             return False
-        if isinstance(action, PrefillAction):
-            self._do_prefill(action)
-        elif isinstance(action, DecodeAction):
+        if isinstance(action, DecodeAction):
             self._do_decode()
+            return True
+        actions = [action]
+        while len(actions) < self.n_slots:
+            with self._submit_lock:
+                nxt = self.scheduler.next()
+            if not isinstance(nxt, PrefillAction):
+                break   # Decode/None: dropping is safe — the decode pass
+                        # re-derives from slot state on the next step()
+            actions.append(nxt)
+        dispatched = [(a, self._dispatch_prefill(a)) for a in actions]
+        for a, tok in dispatched:
+            self._host_lengths[a.slot] = a.prompt_len
+            self._record_token(a.req_id, a.slot, int(tok), first_token=True)
         return True
 
     def run_until_idle(self) -> None:
@@ -178,7 +195,9 @@ class LLMEngine:
 
     # -- internals -----------------------------------------------------------
 
-    def _do_prefill(self, a: PrefillAction) -> None:
+    def _dispatch_prefill(self, a: PrefillAction):
+        """Dispatch one prefill; returns the (device) next-token array
+        WITHOUT fetching, so callers can pipeline several prefills."""
         prompt = self._prompts[a.req_id]
         tokens = np.zeros((1, a.bucket_len), np.int32)
         tokens[0, :len(prompt)] = prompt
@@ -186,9 +205,7 @@ class LLMEngine:
             self._prefill_fn(a.bucket_len)(
                 self.params, self.cache, self.lengths, self.last_tokens,
                 jnp.asarray(tokens), a.slot, a.prompt_len)
-        self._host_lengths[a.slot] = a.prompt_len
-        self._record_token(a.req_id, a.slot, int(next_tok),
-                           first_token=True)
+        return next_tok
 
     def _do_decode(self) -> None:
         """Chained decode: dispatch K steps back-to-back WITHOUT fetching
