@@ -38,6 +38,8 @@ def main() -> None:
     model_overrides = dict(
         vocab_size=32000, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
         d_ff=7168, max_seq_len=SEQ_LEN, remat=True, remat_policy="minimal",
+        scan_layers=False,  # L8 is shallow: unrolled layers skip the scan's
+                            # residual-stacking copies (+3 MFU pts measured)
     ) if on_tpu else dict(
         vocab_size=512, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
         d_ff=128, max_seq_len=256,

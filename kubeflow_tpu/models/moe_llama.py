@@ -115,8 +115,15 @@ def apply(
             "none": jax.checkpoint_policies.everything_saveable,
         }[cfg.remat_policy]
         body = jax.checkpoint(body, policy=policy)
-    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                               params["layers"])
+    if cfg.scan_layers:
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   params["layers"])
+    else:
+        carry = (x, jnp.zeros((), jnp.float32))
+        for i in range(cfg.n_layers):
+            layer = jax.tree.map(lambda p: p[i], params["layers"])
+            carry, _ = body(carry, layer)
+        x, aux = carry
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),

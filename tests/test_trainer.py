@@ -1,4 +1,5 @@
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -69,3 +70,24 @@ def test_restore_or_init_fresh(tmp_path):
     state, resumed = restore_or_init(tr, str(tmp_path / "none"))
     assert not resumed
     assert int(state["step"]) == 0
+
+
+def test_llama_scan_vs_unrolled_layers_identical():
+    """cfg.scan_layers only changes scheduling (scan vs python loop):
+    numerically equivalent within fusion-reassociation tolerance."""
+    import dataclasses
+
+    from kubeflow_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=32, n_layers=3,
+                            n_heads=4, n_kv_heads=2, d_ff=64, max_seq_len=32,
+                            attention_impl="xla", remat=True,
+                            dtype=jnp.float32, scan_layers=True)
+    params = llama.init(jax.random.key(0), cfg)
+    tokens = np.array([[3, 17, 42, 9, 55, 2, 8, 11]], np.int32)
+    a = jax.jit(lambda p, t: llama.apply(p, t, cfg))(params, tokens)
+    cfg2 = dataclasses.replace(cfg, scan_layers=False)
+    b = jax.jit(lambda p, t: llama.apply(p, t, cfg2))(params, tokens)
+    # fp32: identical math; fusion reassociation may flip last ulps only
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
