@@ -67,7 +67,7 @@ def _populate() -> None:
 
 def _do_populate() -> None:
     from kubeflow_tpu.models import (bert, llama, mnist_cnn, moe_llama,
-                                     nas_cnn, resnet)
+                                     nas_cnn, resnet, vit)
 
     register("llama", ModelDef(llama.LlamaConfig, llama.init, llama.apply,
                                llama.loss_fn, llama.logical_axes))
@@ -87,3 +87,5 @@ def _do_populate() -> None:
     register("darts_supernet", ModelDef(
         nas_cnn.NasCnnConfig, nas_cnn.darts_init, nas_cnn.darts_apply,
         nas_cnn.darts_loss_fn, nas_cnn.darts_logical_axes))
+    register("vit", ModelDef(vit.ViTConfig, vit.init, vit.apply,
+                             vit.loss_fn, vit.logical_axes))

@@ -1,0 +1,168 @@
+"""Vision Transformer — the image-classification transformer family
+(SURVEY.md §2.2/L7: the reference's users train ViTs through TFJob/
+PyTorchJob; here it is a built-in model on the same pjit/mesh stack as
+llama).
+
+TPU-first like the rest of `models/`: pure-functional param pytrees with
+logical sharding axes, layers stacked for ``lax.scan``, bf16 compute with
+fp32 statistics, bidirectional flash attention (the same kernel llama
+uses, ``causal=False``), patchify as one strided conv (a single MXU-friendly
+matmul per image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.flash_attention import flash_attention
+from kubeflow_tpu.ops.norms import rms_norm
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    in_channels: int = 3
+    n_classes: int = 10
+    d_model: int = 192
+    n_layers: int = 6
+    n_heads: int = 3
+    d_ff: int = 768
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    norm_eps: float = 1e-6
+    remat: bool = False
+    attention_impl: str = "flash"   # flash | xla
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError("patch_size must divide image_size")
+        if self.d_model % self.n_heads:
+            raise ValueError("n_heads must divide d_model")
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def init(rng: jax.Array, cfg: ViTConfig) -> Params:
+    L, d, f = cfg.n_layers, cfg.d_model, cfg.d_ff
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.in_channels
+    ks = jax.random.split(rng, 8)
+
+    def norm(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (2.0 / fan_in) ** 0.5).astype(cfg.param_dtype)
+
+    return {
+        "patch_embed": {"w": norm(ks[0], patch_dim, d, fan_in=patch_dim),
+                        "b": jnp.zeros((d,), cfg.param_dtype)},
+        "pos_embed": 0.02 * jax.random.normal(
+            ks[1], (cfg.n_patches + 1, d), cfg.param_dtype),
+        "cls": jnp.zeros((d,), cfg.param_dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.param_dtype),
+            "wq": norm(ks[2], L, d, d, fan_in=d),
+            "wk": norm(ks[3], L, d, d, fan_in=d),
+            "wv": norm(ks[4], L, d, d, fan_in=d),
+            "wo": norm(ks[5], L, d, d, fan_in=d),
+            "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
+            "w_up": norm(ks[6], L, d, f, fan_in=d),
+            "w_down": norm(ks[7], L, f, d, fan_in=f),
+        },
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+        "head": {"w": jnp.zeros((d, cfg.n_classes), cfg.param_dtype),
+                 "b": jnp.zeros((cfg.n_classes,), cfg.param_dtype)},
+    }
+
+
+def logical_axes(cfg: ViTConfig) -> Params:
+    return {
+        "patch_embed": {"w": (None, "embed"), "b": ("embed",)},
+        "pos_embed": (None, "embed"),
+        "cls": ("embed",),
+        "layers": {
+            # leading [L] dim tagged "layers" like llama/bert: stage-sharded
+            # slabs under a pipeline mesh instead of full replication
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", None),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+        "head": {"w": ("embed", None), "b": (None,)},
+    }
+
+
+def _patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B,H,W,C] -> [B, n_patches, patch_dim] (reshape-only, no conv)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def _layer_body(cfg: ViTConfig, x: jax.Array, layer: Params) -> jax.Array:
+    b, s, d = x.shape
+    nh, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, nh, hd)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, nh, hd)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, nh, hd)
+    if cfg.attention_impl == "flash":
+        out = flash_attention(q, k, v, causal=False)
+    else:
+        scale = hd ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+    x = x + out.reshape(b, s, d) @ layer["wo"].astype(cfg.dtype)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h = jax.nn.gelu(h @ layer["w_up"].astype(cfg.dtype))
+    return x + h @ layer["w_down"].astype(cfg.dtype)
+
+
+def apply(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] float images -> [B, n_classes] fp32 logits."""
+    b = images.shape[0]
+    x = _patchify(images.astype(cfg.dtype), cfg)
+    x = x @ params["patch_embed"]["w"].astype(cfg.dtype) \
+        + params["patch_embed"]["b"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(cfg.dtype),
+                           (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+
+    body = partial(_layer_body, cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(carry, layer):
+        return body(carry, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head"]["w"].astype(jnp.float32) \
+        + params["head"]["b"]
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: ViTConfig):
+    logits = apply(params, batch["image"], cfg)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
