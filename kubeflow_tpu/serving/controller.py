@@ -125,18 +125,28 @@ class InferenceServiceController(Controller):
         self._lock = threading.RLock()
         # keys carry the namespace: two ISVCs named alike in different
         # namespaces must never share a router or a model server
-        self._instances: dict[tuple[str, str, str], _Instance] = {}
+        self._instances: dict[tuple[str, str, str], list[_Instance]] = {}
         self._routers: dict[tuple[str, str], Router] = {}
+        self._last_scale: dict[tuple[str, str, str], float] = {}
+        # serializes scale-from-zero activations per service (model load is
+        # slow; N concurrent first-requests must not start N replicas)
+        self._activation_locks: dict[tuple[str, str], threading.Lock] = {}
+        # replicas dropped by a scale-down, stopped only AFTER the router's
+        # backend list is updated (no routing to dead ports)
+        self._pending_stop: list[_Instance] = []
 
     def stop(self) -> None:
         super().stop()
         with self._lock:
-            for inst in self._instances.values():
-                inst.stop()
+            for replicas in self._instances.values():
+                for inst in replicas:
+                    inst.stop()
             self._instances.clear()
             for r in self._routers.values():
                 r.stop()
             self._routers.clear()
+            self._last_scale.clear()
+            self._activation_locks.clear()
 
     # -- reconcile ------------------------------------------------------------
 
@@ -145,6 +155,9 @@ class InferenceServiceController(Controller):
             self._stop_instance(namespace, name, component)
         with self._lock:
             router = self._routers.pop((namespace, name), None)
+            self._activation_locks.pop((namespace, name), None)
+            for component in ("predictor", "canary"):
+                self._last_scale.pop((namespace, name, component), None)
         if router is not None:
             router.stop()
         return None
@@ -190,8 +203,14 @@ class InferenceServiceController(Controller):
 
         self._scale_to_zero_check(isvc, default)
         router.set_backends(
-            default.get("port"),
-            canary.get("port") if canary else None, pct)
+            default.get("ports") or default.get("port"),
+            (canary.get("ports") or canary.get("port")) if canary else None,
+            pct)
+        # the router no longer references scaled-down replicas: stop them
+        with self._lock:
+            drain, self._pending_stop = self._pending_stop, []
+        for inst in drain:
+            inst.stop()
 
         def write(o):
             o["status"]["url"] = router.url
@@ -235,7 +254,8 @@ class InferenceServiceController(Controller):
         return model
 
     def _start_instance(self, isvc: dict[str, Any], component: str,
-                        comp_spec: dict[str, Any]) -> _Instance:
+                        comp_spec: dict[str, Any],
+                        with_grpc: bool = True) -> _Instance:
         name = isvc["metadata"]["name"]
         ns = isvc["metadata"].get("namespace", "default")
         model = self._build_model(isvc, comp_spec)
@@ -255,7 +275,7 @@ class InferenceServiceController(Controller):
             batching=batch_cfg, payload_logger=logger)
         server.start()
         grpc_server = None
-        if comp_spec.get("grpc"):
+        if comp_spec.get("grpc") and with_grpc:
             try:
                 # same repository + batching config on the OIP gRPC dataplane
                 from kubeflow_tpu.serving.grpc_server import \
@@ -273,8 +293,48 @@ class InferenceServiceController(Controller):
         inst = _Instance(name, component, self._revision_of(comp_spec),
                          server, grpc_server)
         with self._lock:
-            self._instances[(ns, name, component)] = inst
+            self._instances.setdefault((ns, name, component), []).append(inst)
         return inst
+
+    def _desired_replicas(self, isvc: dict[str, Any], component: str,
+                          comp_spec: dict[str, Any], current: int) -> int:
+        """Concurrency-target autoscaling (the Knative autoscaler analog):
+        scale up immediately when peak in-flight concurrency exceeds the
+        target per replica; scale down one replica at a time after a
+        cooldown. Canary stays at one replica."""
+        if component != "predictor":
+            return 1
+        base = max(1, comp_spec.get("minReplicas", 1))
+        max_r = max(base, comp_spec.get("maxReplicas", base))
+        if max_r == base:
+            return base
+        name = isvc["metadata"]["name"]
+        ns = isvc["metadata"].get("namespace", "default")
+        if self._has_trained_models(ns, name):
+            # attached TrainedModels live in one replica's repository;
+            # scaling out would 404 their traffic on the other replicas
+            return max(base, min(current, max_r)) or base
+        key = (ns, name, component)
+        with self._lock:
+            router = self._routers.get((ns, name))
+        peak = router.take_peak_inflight() if router else 0
+        target = max(1, comp_spec.get("targetConcurrency", 8))
+        want = max(base, min(max_r, -(-peak // target)))
+        now = time.time()
+        if want > current:
+            self._last_scale[key] = now
+            return want
+        cooldown = float(comp_spec.get("scaleDownDelaySeconds", 30))
+        if want < current and now - self._last_scale.get(key, 0) > cooldown:
+            self._last_scale[key] = now
+            return current - 1   # gentle scale-down
+        return current
+
+    def _has_trained_models(self, ns: str, name: str) -> bool:
+        from kubeflow_tpu.serving.trainedmodel import TRAINEDMODEL_KIND
+
+        return any(tm["spec"].get("inferenceService") == name
+                   for tm in self.store.list(TRAINEDMODEL_KIND, ns))
 
     def _reconcile_component(self, isvc: dict[str, Any], component: str,
                              comp_spec: dict[str, Any],
@@ -282,26 +342,43 @@ class InferenceServiceController(Controller):
         name = isvc["metadata"]["name"]
         ns = isvc["metadata"].get("namespace", "default")
         revision = self._revision_of(comp_spec)
+        key = (ns, name, component)
         with self._lock:
-            inst = self._instances.get((ns, name, component))
-        if inst is not None and inst.revision != revision:
+            replicas = list(self._instances.get(key, []))
+        if replicas and replicas[0].revision != revision:
             self._stop_instance(ns, name, component)   # rollout: replace
-            inst = None
-        if inst is None:
-            if lazy:
-                return {"ready": False, "scaledToZero": True,
-                        "revision": revision}
-            inst = self._start_instance(isvc, component, comp_spec)
-        out = {"ready": True, "port": inst.server.port,
-               "revision": inst.revision}
-        if inst.grpc_server is not None:
-            out["grpcAddress"] = inst.grpc_server.address
+            replicas = []
+        if not replicas and lazy:
+            return {"ready": False, "scaledToZero": True,
+                    "revision": revision}
+        desired = self._desired_replicas(isvc, component, comp_spec,
+                                         len(replicas))
+        while len(replicas) < desired:
+            # the OIP gRPC server rides the FIRST replica only (that is the
+            # only address status publishes; extras would serve nothing)
+            replicas.append(self._start_instance(
+                isvc, component, comp_spec,
+                with_grpc=len(replicas) == 0))
+        if len(replicas) > desired:
+            with self._lock:
+                keep = self._instances.get(key, [])[:desired]
+                drop = self._instances.get(key, [])[desired:]
+                self._instances[key] = keep
+            # defer the actual stop until after the router's backend list
+            # no longer contains these ports (reconcile drains _pending_stop)
+            self._pending_stop.extend(drop)
+            replicas = keep
+        out = {"ready": True, "port": replicas[0].server.port,
+               "ports": [r.server.port for r in replicas],
+               "replicas": len(replicas), "revision": revision}
+        if replicas[0].grpc_server is not None:
+            out["grpcAddress"] = replicas[0].grpc_server.address
         return out
 
     def _stop_instance(self, ns: str, name: str, component: str) -> None:
         with self._lock:
-            inst = self._instances.pop((ns, name, component), None)
-        if inst is not None:
+            replicas = self._instances.pop((ns, name, component), None)
+        for inst in replicas or ():
             inst.stop()
 
     # -- scale to zero --------------------------------------------------------
@@ -319,15 +396,24 @@ class InferenceServiceController(Controller):
             return router
 
     def _activate(self, ns: str, name: str) -> int | None:
-        """Router callback on scale-from-zero: start the predictor now."""
+        """Router callback on scale-from-zero: start the predictor now.
+        Serialized per service: N concurrent first-requests get ONE
+        replica, not N (model load is slow; the check-then-start must not
+        interleave)."""
         isvc = self.store.try_get(ISVC_KIND, name, ns)
         if isvc is None:
             return None
         with self._lock:
-            inst = self._instances.get((ns, name, "predictor"))
-            if inst is None:
+            act_lock = self._activation_locks.setdefault(
+                (ns, name), threading.Lock())
+        with act_lock:
+            with self._lock:
+                replicas = self._instances.get((ns, name, "predictor"))
+            if not replicas:
                 inst = self._start_instance(isvc, "predictor",
                                             isvc["spec"]["predictor"])
+            else:
+                inst = replicas[0]
         self.queue.add(self.key_of(isvc))   # refresh status.components
         return inst.server.port
 
@@ -341,14 +427,15 @@ class InferenceServiceController(Controller):
         ns = isvc["metadata"].get("namespace", "default")
         with self._lock:
             router = self._routers.get((ns, name))
-            inst = self._instances.get((ns, name, "predictor"))
-        if inst is None or router is None:
+            replicas = self._instances.get((ns, name, "predictor"))
+        if not replicas or router is None:
             return
         last = router.last_request_time
         if last and time.time() - last > idle:
             self._stop_instance(ns, name, "predictor")
             default.update(ready=False, scaledToZero=True)
             default.pop("port", None)
+            default.pop("ports", None)
             # NOTE: reactivation rides the HTTP router (the activator); a
             # scaled-to-zero service has no gRPC endpoint until an HTTP
             # request wakes it

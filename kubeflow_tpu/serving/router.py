@@ -28,13 +28,21 @@ class Router:
         self.activator = activator
         self.activation_timeout = activation_timeout
         self._lock = threading.Lock()
-        self._default_port: int | None = None
-        self._canary_port: int | None = None
+        self._default_ports: list[int] = []
+        self._canary_ports: list[int] = []
         self._canary_percent = 0
         self._count = 0
+        # separate round-robin cursors per pool: a shared cursor plus a
+        # deterministic canary schedule can phase-lock and starve a replica
+        self._rr_default = 0
+        self._rr_canary = 0
         self.canary_count = 0
         self.total_count = 0
         self.last_request_time: float = 0.0
+        # concurrency tracking for the autoscaler (Knative queue-proxy
+        # reports concurrency; here the router IS the queue-proxy)
+        self.inflight = 0
+        self.peak_inflight = 0
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -65,13 +73,27 @@ class Router:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
-    def set_backends(self, default_port: int | None,
-                     canary_port: int | None = None,
+    @staticmethod
+    def _ports(value) -> list[int]:
+        if value is None:
+            return []
+        if isinstance(value, int):
+            return [value]
+        return [int(p) for p in value]
+
+    def set_backends(self, default_port, canary_port=None,
                      canary_percent: int = 0) -> None:
+        """Backends may be a single port or a list of replica ports."""
         with self._lock:
-            self._default_port = default_port
-            self._canary_port = canary_port
+            self._default_ports = self._ports(default_port)
+            self._canary_ports = self._ports(canary_port)
             self._canary_percent = max(0, min(100, int(canary_percent)))
+
+    def take_peak_inflight(self) -> int:
+        """Peak concurrency since the last call (autoscaler signal)."""
+        with self._lock:
+            peak, self.peak_inflight = self.peak_inflight, self.inflight
+            return peak
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -83,10 +105,16 @@ class Router:
         with self._lock:
             self._count += 1
             n, pct = self._count, self._canary_percent
-            use_canary = (self._canary_port is not None and pct > 0
+            use_canary = (bool(self._canary_ports) and pct > 0
                           and (n * pct) // 100 > ((n - 1) * pct) // 100)
-            return ((self._canary_port, True) if use_canary
-                    else (self._default_port, False))
+            pool = self._canary_ports if use_canary else self._default_ports
+            if not pool:
+                return None, use_canary
+            if use_canary:
+                self._rr_canary += 1
+                return pool[self._rr_canary % len(pool)], True
+            self._rr_default += 1
+            return pool[self._rr_default % len(pool)], False
 
     def forward(self, method: str, path: str, body: bytes
                 ) -> tuple[int, bytes]:
@@ -107,6 +135,8 @@ class Router:
             self.total_count += 1
             if is_canary:
                 self.canary_count += 1
+            self.inflight += 1
+            self.peak_inflight = max(self.peak_inflight, self.inflight)
         try:
             conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
             conn.request(method, path, body=body or None,
@@ -118,6 +148,9 @@ class Router:
         except OSError as e:
             return 502, json.dumps(
                 {"error": f"backend unreachable: {e}"}).encode()
+        finally:
+            with self._lock:
+                self.inflight -= 1
 
     def _activate(self) -> int | None:
         """Scale-from-zero: ask the controller to start the backend, then
@@ -129,5 +162,5 @@ class Router:
             port = self.activator()
         if port is not None:
             with self._lock:
-                self._default_port = port
+                self._default_ports = self._ports(port)
         return port

@@ -70,9 +70,15 @@ class TrainedModelController(Controller):
         ctrl = self._isvc_controller()
         if ctrl is None:
             return None
-        inst = ctrl._instances.get((ns, isvc_name, "predictor"))
-        if inst is None:
+        replicas = ctrl._instances.get((ns, isvc_name, "predictor"))
+        if not replicas:
             return None
+        if len(replicas) > 1:
+            raise ModelError(
+                "TrainedModels require a single-replica host (pulled models "
+                "live in one replica's repository; scale-out would 404 on "
+                "the other replicas)")
+        inst = replicas[0]
         key = (ns, isvc_name)
         agent = self._agents.get(key)
         if agent is None or agent.repository is not inst.server.repository:
@@ -102,7 +108,11 @@ class TrainedModelController(Controller):
             self._set(tm, JobConditionType.FAILED, "HostNotFound",
                       f"InferenceService {isvc_name!r} not found")
             return 2.0   # keep checking: the host may appear later
-        agent = self._agent(ns, isvc_name, isvc)
+        try:
+            agent = self._agent(ns, isvc_name, isvc)
+        except ModelError as e:
+            self._set(tm, JobConditionType.FAILED, "HostUnsupported", str(e))
+            return None
         if agent is None:
             return 0.5   # host predictor not serving yet
         digest = json_digest(tm["spec"]["model"])

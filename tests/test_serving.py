@@ -351,3 +351,75 @@ class TestInferenceServiceE2E:
         for t in threads:
             t.join()
         assert all(c == 200 and p == [4.0] for c, p in codes)
+
+
+# -- replica scale-out (Knative autoscaler analog) ---------------------------
+
+def test_autoscale_replicas_up_and_down():
+    """maxReplicas + targetConcurrency: concurrent load scales the
+    predictor out (round-robin over replica ports); idle + cooldown scales
+    back toward minReplicas."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    from kubeflow_tpu.control import Cluster, new_resource
+    from kubeflow_tpu.control.conditions import has_condition
+    from kubeflow_tpu import serving
+
+    hits = []
+
+    @serving.serving_runtime("slowecho")
+    def _slow(name, uri=None, **cfg):
+        def fn(xs):
+            time.sleep(0.15)
+            hits.append(1)
+            return xs
+        return serving.FunctionModel(name, fn)
+
+    c = Cluster(n_devices=2)
+    c.add(serving.InferenceServiceController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "auto", spec={
+            "predictor": {"model": {"modelFormat": "slowecho"},
+                          "minReplicas": 1, "maxReplicas": 3,
+                          "targetConcurrency": 2,
+                          "scaleDownDelaySeconds": 1}}))
+        isvc = c.wait_for(serving.ISVC_KIND, "auto",
+                          lambda o: has_condition(o["status"], "Ready"),
+                          timeout=30)
+        url = isvc["status"]["url"]
+
+        def call():
+            req = urllib.request.Request(
+                url + "/v1/models/auto:predict",
+                data=_json.dumps({"instances": [1]}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30).read()
+
+        # sustained burst of 8 concurrent requests (> 2x target of 2)
+        for _ in range(3):
+            ts = [threading.Thread(target=call) for _ in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        scaled = c.wait_for(
+            serving.ISVC_KIND, "auto",
+            lambda o: o["status"].get("components", {})
+                       .get("predictor", {}).get("replicas", 1) > 1,
+            timeout=20)
+        pred = scaled["status"]["components"]["predictor"]
+        assert pred["replicas"] >= 2
+        assert len(pred["ports"]) == pred["replicas"]
+        # requests succeed while scaled out
+        call()
+        # idle past the cooldown: shrinks back toward 1
+        shrunk = c.wait_for(
+            serving.ISVC_KIND, "auto",
+            lambda o: o["status"].get("components", {})
+                       .get("predictor", {}).get("replicas", 3) == 1,
+            timeout=30)
+        assert shrunk["status"]["components"]["predictor"]["replicas"] == 1
+        call()  # still serving after scale-down
