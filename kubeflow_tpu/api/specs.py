@@ -168,13 +168,16 @@ def experiment(name: str, *, objective_metric: str,
                max_trials: int = 12, parallel_trials: int = 3,
                max_failed_trials: int = 3,
                trial_parameters: list[dict[str, str]] | None = None,
+               trial_kind: str = "JAXJob",
                early_stopping: dict[str, Any] | None = None,
                namespace: str = "default") -> dict[str, Any]:
     """Build an Experiment — the KatibClient.create_experiment analog.
 
     `parameters` entries: {name, parameterType: double|int|categorical|
     discrete, feasibleSpace: {min,max,step}|{list}}.
-    `trial_spec` is a JAXJob spec with ${trialParameters.X} placeholders.
+    `trial_spec` is a training-job spec (of `trial_kind` — JAXJob by
+    default, or any framework kind like PyTorchJob/TFJob) with
+    ${trialParameters.X} placeholders.
     """
     spec: dict[str, Any] = {
         "objective": {"type": direction,
@@ -185,7 +188,7 @@ def experiment(name: str, *, objective_metric: str,
         "parallelTrialCount": parallel_trials,
         "maxTrialCount": max_trials,
         "maxFailedTrialCount": max_failed_trials,
-        "trialTemplate": {"spec": trial_spec},
+        "trialTemplate": {"spec": trial_spec, "kind": trial_kind},
     }
     if goal is not None:
         spec["objective"]["goal"] = goal

@@ -31,9 +31,9 @@ from kubeflow_tpu.control.conditions import is_finished
 from kubeflow_tpu.version import __version__
 
 # kinds whose status reaches a terminal Succeeded/Failed condition
-from kubeflow_tpu.control.frameworks import FRAMEWORK_KINDS
+from kubeflow_tpu.control.frameworks import ALL_JOB_KINDS
 
-_JOB_KINDS = ("JAXJob",) + FRAMEWORK_KINDS
+_JOB_KINDS = ALL_JOB_KINDS
 WAITABLE_KINDS = _JOB_KINDS + ("Experiment", "PipelineRun", "Trial")
 
 

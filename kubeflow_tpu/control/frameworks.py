@@ -237,6 +237,10 @@ TRAINING_CONTROLLERS: tuple[type[JAXJobController], ...] = (
 FRAMEWORK_KINDS: tuple[str, ...] = tuple(
     c.kind for c in TRAINING_CONTROLLERS)
 
+# every training job kind, JAXJob first (the canonical list — cli.py and
+# hpo/trial.py must agree on what exists)
+ALL_JOB_KINDS: tuple[str, ...] = (JAXJobController.kind,) + FRAMEWORK_KINDS
+
 
 def add_training_controllers(cluster) -> None:
     """Register every framework job kind on a Cluster — the unified

@@ -38,6 +38,8 @@ from typing import Any
 from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
                                              is_finished, set_condition)
 from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.frameworks import ALL_JOB_KINDS
+from kubeflow_tpu.control.jobs import JOB_KIND
 from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
 from kubeflow_tpu.hpo import algorithms as alg
 from kubeflow_tpu.hpo import nas as _nas
@@ -50,7 +52,11 @@ EXPERIMENT_KIND = "Experiment"
 SUGGESTION_KIND = "Suggestion"
 
 
-def validate_experiment(exp: dict[str, Any]) -> list[str]:
+def validate_experiment(exp: dict[str, Any],
+                        extra_job_kinds: tuple[str, ...] = ()) -> list[str]:
+    """`extra_job_kinds` lets a cluster-aware caller accept custom job
+    controllers registered beyond the built-in ALL_JOB_KINDS (the static
+    admission layer passes nothing and rejects unknown kinds)."""
     errs = []
     spec = exp.get("spec", {})
     obj = spec.get("objective", {})
@@ -71,6 +77,10 @@ def validate_experiment(exp: dict[str, Any]) -> list[str]:
     tt = spec.get("trialTemplate", {})
     if "spec" not in tt:
         errs.append("trialTemplate.spec is required")
+    known_kinds = ALL_JOB_KINDS + tuple(extra_job_kinds)
+    if tt.get("kind", JOB_KIND) not in known_kinds:
+        errs.append(f"trialTemplate.kind {tt.get('kind')!r} unknown "
+                    f"(known: {', '.join(known_kinds)})")
     for key in ("parallelTrialCount", "maxTrialCount", "maxFailedTrialCount"):
         v = spec.get(key)
         if v is not None and (not isinstance(v, int) or v < 1):
@@ -156,7 +166,12 @@ class ExperimentController(Controller):
         if is_finished(status):
             return None
 
-        errs = validate_experiment(exp)
+        from kubeflow_tpu.control.jobs import JAXJobController
+
+        custom = tuple(c.kind for c in self.cluster.controllers
+                       if isinstance(c, JAXJobController)
+                       and c.kind not in ALL_JOB_KINDS)
+        errs = validate_experiment(exp, extra_job_kinds=custom)
         if errs:
             self._finish(exp, JobConditionType.FAILED, "InvalidSpec",
                          "; ".join(errs))
@@ -278,6 +293,7 @@ class ExperimentController(Controller):
             "substitutions": substitutions,
             "objective": spec.get("objective", {}),
             "template": tt["spec"],
+            "templateKind": tt.get("kind", JOB_KIND),
             "earlyStopping": spec.get("earlyStopping"),
         }
 

@@ -31,6 +31,7 @@ from typing import Any
 from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
                                              is_finished, set_condition)
 from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.control.frameworks import ALL_JOB_KINDS
 from kubeflow_tpu.control.jobs import JOB_KIND, JOB_NAME_LABEL
 from kubeflow_tpu.control.store import AlreadyExistsError, new_resource
 from kubeflow_tpu.hpo.collector import FileTail, collect_text
@@ -69,7 +70,9 @@ def substitute(node: Any, assignments: dict[str, Any]) -> Any:
 
 class TrialController(Controller):
     kind = TRIAL_KIND
-    owned_kinds = (JOB_KIND,)
+    # a trialTemplate may instantiate ANY training job kind (the reference's
+    # trials launch batch Jobs / TFJobs / PyTorchJobs, SURVEY.md §2.3)
+    owned_kinds = ALL_JOB_KINDS
     resync_period = 0.5   # early stopping needs a frequent look
 
     def __init__(self, cluster, db: ObservationDB | None = None,
@@ -98,8 +101,23 @@ class TrialController(Controller):
                 f"Trial {name} created."), ns)
             return 0.0
 
-        job = self.store.try_get(JOB_KIND, name, ns)
+        job_kind = self._job_kind(trial)
+        job = self.store.try_get(job_kind, name, ns)
         if job is None:
+            # the kind must be reconciled by a TRAINING-JOB controller
+            # (JAXJobController engine or a subclass): a job nobody
+            # reconciles — or a non-job kind like 'Trial' itself — would
+            # hang the trial (and the experiment) forever
+            from kubeflow_tpu.control.jobs import JAXJobController
+
+            job_controllers = {c.kind for c in self.cluster.controllers
+                               if isinstance(c, JAXJobController)}
+            if job_kind not in job_controllers:
+                self.store.mutate(TRIAL_KIND, name, lambda o: set_condition(
+                    o["status"], JobConditionType.FAILED, "NoController",
+                    f"no training-job controller registered for "
+                    f"trialTemplate kind {job_kind!r}"), ns)
+                return None
             self._create_job(trial)
             return 0.1
 
@@ -132,6 +150,10 @@ class TrialController(Controller):
         names += list(obj.get("additionalMetricNames", ()))
         return names
 
+    @staticmethod
+    def _job_kind(trial: dict[str, Any]) -> str:
+        return trial["spec"].get("templateKind", JOB_KIND)
+
     def _create_job(self, trial: dict[str, Any]) -> None:
         ns = trial["metadata"].get("namespace", "default")
         name = trial["metadata"]["name"]
@@ -144,7 +166,7 @@ class TrialController(Controller):
             env.setdefault("KTPU_TRIAL_NAME", name)
             env.setdefault("KTPU_METRICS_FILE", self._metrics_path(trial))
         job = new_resource(
-            JOB_KIND, name, spec=spec, namespace=ns,
+            self._job_kind(trial), name, spec=spec, namespace=ns,
             labels={EXPERIMENT_LABEL:
                     trial["spec"].get("experiment", ""),
                     "kubeflow-tpu/trial": name},
@@ -265,7 +287,7 @@ class TrialController(Controller):
         self._stop_collector(trial, final=True)
         observation = self.observation(trial)
         value = self.objective_value(trial)
-        self.store.try_delete(JOB_KIND, name, ns)
+        self.store.try_delete(self._job_kind(trial), name, ns)
 
         def write(o):
             if observation:

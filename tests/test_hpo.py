@@ -392,3 +392,64 @@ class TestExperimentE2E:
         exp = wait_exp(cluster, "tpe-e2e", timeout=120)
         assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
         assert exp["status"]["currentOptimalTrial"]["objectiveValue"] < 0.5
+
+
+def test_trial_template_framework_kind(tmp_path):
+    """trialTemplate.kind launches trials as any training job kind (the
+    reference's batch-Job/TFJob/PyTorchJob trialTemplates): a PyTorchJob
+    trial gets MASTER_ADDR/RANK env injected by its own controller."""
+    from kubeflow_tpu.control import PyTorchJobController
+
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    c.add(PyTorchJobController)
+    hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path))
+    exp = make_experiment("pt-sweep", max_trials=3, parallel=2)
+    exp["spec"]["trialTemplate"] = {
+        "kind": "PyTorchJob",
+        "spec": {"replicaSpecs": {"master": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"backend": "thread", "target": "hpo_quad",
+                         "env": {"X": "${trialParameters.x}",
+                                 "Y": "${trialParameters.y}"}},
+        }}},
+    }
+    with c:
+        c.store.create(exp)
+        done = wait_exp(c, "pt-sweep")
+        jobs = c.store.list("PyTorchJob")
+        envs = [p["spec"]["env"] for p in c.store.list("Pod")]
+    hpo.set_default_db(None)
+    assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+    assert done["status"]["trials"]["succeeded"] >= 3
+    assert jobs and all(j["kind"] == "PyTorchJob" for j in jobs)
+    # the PyTorchJob controller injected its rendezvous env into trial pods
+    assert any("MASTER_ADDR" in e for e in envs)
+
+
+def test_trial_template_unknown_kind_rejected():
+    exp = make_experiment("bad-kind")
+    exp["spec"]["trialTemplate"]["kind"] = "SparkJob"
+    from kubeflow_tpu.hpo.experiment import validate_experiment
+
+    errs = validate_experiment(exp)
+    assert any("trialTemplate.kind" in e for e in errs)
+
+
+def test_trial_without_controller_fails_fast(tmp_path):
+    """A trialTemplate kind with no registered controller fails the trial
+    (and the experiment) instead of hanging forever."""
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)   # deliberately NO TFJobController
+    hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path))
+    exp = make_experiment("orphan", max_trials=2, parallel=1)
+    exp["spec"]["maxFailedTrialCount"] = 1
+    exp["spec"]["trialTemplate"]["kind"] = "TFJob"
+    with c:
+        c.store.create(exp)
+        done = wait_exp(c, "orphan", timeout=30)
+        trials = c.store.list("Trial")
+    hpo.set_default_db(None)
+    assert has_condition(done["status"], JobConditionType.FAILED)
+    assert any(cc.get("reason") == "NoController"
+               for t in trials for cc in t["status"].get("conditions", []))
