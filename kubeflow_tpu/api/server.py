@@ -15,6 +15,7 @@ client/server split:
     DELETE /apis/{kind}/{ns}/{name}
     GET    /logs/{ns}/{pod}
     GET    /joblogs/{ns}/{job}
+    GET    /lineage/{ns}/{run}         MLMD-analog run lineage (executions)
 
 JSON in/out; errors: {"error": ..., "reason": NotFound|Invalid|...}.
 """
@@ -120,6 +121,16 @@ class ApiServer:
                   and len(parts) == 3):
                 h._send(200,
                         {"logs": self.platform.job_logs(parts[2], parts[1])})
+            elif (method == "GET" and parts[:1] == ["lineage"]
+                  and len(parts) == 3):
+                # MLMD-analog lineage query: execution records for one
+                # pipeline run (⊘ KFP UI's run-detail view)
+                if self.platform.pipelines is None:
+                    h._error(404, "NotFound", "pipelines component disabled")
+                else:
+                    md = self.platform.pipelines.metadata
+                    h._send(200, {"executions": md.executions_for_run(
+                        f"{parts[1]}/{parts[2]}")})
             elif method == "GET" and parts[:1] == ["dashboard"]:
                 from kubeflow_tpu.platform import dashboard as _dash
 
@@ -236,6 +247,12 @@ class ApiClient:
 
     def job_logs(self, name: str, namespace: str = "default") -> str:
         return self._request("GET", f"/joblogs/{namespace}/{name}")["logs"]
+
+    def lineage(self, run_name: str,
+                namespace: str = "default") -> list[dict[str, Any]]:
+        """Execution records of a pipeline run (MLMD-analog)."""
+        return self._request(
+            "GET", f"/lineage/{namespace}/{run_name}")["executions"]
 
     def wait(self, kind: str, name: str,
              predicate: Callable[[dict[str, Any]], bool] | None = None,

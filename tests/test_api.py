@@ -330,3 +330,39 @@ class TestPlatformRoutes:
             method="POST")
         with urllib.request.urlopen(req) as r:
             assert _json.loads(r.read())["touched"] is True
+
+
+def test_lineage_endpoint():
+    """GET /lineage/{ns}/{run}: the MLMD-analog executions record for a
+    pipeline run over HTTP."""
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu import pipelines as kfp
+    from kubeflow_tpu.api.platform import Platform
+    from kubeflow_tpu.api.server import ApiServer
+    from kubeflow_tpu.control.store import new_resource
+    from kubeflow_tpu.pipelines import dsl
+
+    @dsl.component
+    def emit_one() -> int:
+        return 1
+
+    @dsl.pipeline
+    def tiny():
+        emit_one()
+
+    with Platform(components=("training", "pipelines")) as p:
+        p.apply(new_resource(kfp.RUN_KIND, "lin", spec={
+            "pipelineSpec": kfp.compile_pipeline(tiny)}))
+        p.wait(kfp.RUN_KIND, "lin")
+        server = ApiServer(p).start()
+        try:
+            with urllib.request.urlopen(
+                    server.url + "/lineage/default/lin") as r:
+                out = _json.loads(r.read())
+        finally:
+            server.stop()
+    execs = out["executions"]
+    assert execs and execs[0]["task"] == "emit_one"
+    assert execs[0]["state"] in ("COMPLETE", "CACHED")
