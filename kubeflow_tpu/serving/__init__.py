@@ -24,7 +24,9 @@ from kubeflow_tpu.serving.trainedmodel import (TRAINEDMODEL_KIND,
                                                TrainedModelController,
                                                validate_trainedmodel)
 from kubeflow_tpu.serving import llm_runtime as _llm_runtime  # noqa: F401
-# ^ imported for its @serving_runtime("llama") registration side effect
+from kubeflow_tpu.serving import trainer_runtime as _tr  # noqa: F401
+# ^ imported for their @serving_runtime registration side effects
+#   ("llama" continuous batching; "trainer" = any registry model checkpoint)
 
 __all__ = [
     "DynamicBatcher", "FunctionModel", "ISVC_KIND", "InferRequest",

@@ -82,18 +82,18 @@ class LLMModel(Model):
 
         if self._checkpoint:
             # orbax trainer checkpoint: restore the params subtree against
-            # the model's abstract shapes (opt_state is not needed to serve)
-            import orbax.checkpoint as ocp
+            # the model's abstract shapes (opt_state is not needed to
+            # serve). A configured-but-empty checkpoint dir raises rather
+            # than silently serving random weights.
+            from kubeflow_tpu.serving.model import ModelError
+            from kubeflow_tpu.training.checkpoint import restore_params
 
             abstract = jax.eval_shape(
                 lambda: llama.init(jax.random.key(0), cfg))
-            with ocp.CheckpointManager(self._checkpoint) as mngr:
-                step = mngr.latest_step()
-                if step is not None:
-                    restored = mngr.restore(
-                        step, args=ocp.args.StandardRestore(
-                            {"params": abstract}))
-                    return restored["params"]
+            try:
+                return restore_params(self._checkpoint, abstract)
+            except FileNotFoundError as e:
+                raise ModelError(str(e)) from e
         return llama.init(jax.random.key(self._seed), cfg)
 
     def _loop(self) -> None:

@@ -1,0 +1,91 @@
+"""Trainer-checkpoint serving runtime — the train→serve bridge (SURVEY.md
+§2.4 storage-initializer + §5.4: the reference's serving pulls user-saved
+model files; here ANY registered model family's orbax checkpoint serves
+directly).
+
+    kind: InferenceService
+    spec:
+      predictor:
+        model:
+          modelFormat: trainer
+          uri: /path/to/orbax/checkpoint-dir     # a Trainer checkpoint_dir
+          config:
+            model: mnist_cnn                     # registry name
+            model_overrides: {...}
+            output: logits | argmax              # default logits
+            batch_input: image                   # informational
+
+V1 payload: {"instances": [<input array>, ...]} — the model's natural
+input (images for vision models, token id lists for LMs). V2 works too
+(single input tensor). The checkpoint's `params` subtree is restored
+against the current config's abstract shapes; no optimizer state is
+loaded.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import Model, ModelError, serving_runtime
+
+
+class TrainerCheckpointModel(Model):
+    def __init__(self, name: str, uri: str | None = None, *,
+                 model: str, model_overrides: dict[str, Any] | None = None,
+                 checkpoint: str | None = None, output: str = "logits",
+                 seed: int = 0, **_ignored: Any):
+        super().__init__(name)
+        if output not in ("logits", "argmax"):
+            raise ModelError(f"output {output!r} invalid (logits|argmax)")
+        self._model_name = model
+        self._overrides = dict(model_overrides or {})
+        self._checkpoint = checkpoint or uri
+        self._output = output
+        self._seed = seed
+        self._apply = None
+        self._params = None
+        self._cfg = None
+
+    def load(self) -> None:
+        import jax
+
+        from kubeflow_tpu.models import registry
+
+        mdef = registry.get(self._model_name)
+        self._cfg = mdef.config_cls(**self._overrides)
+        if self._checkpoint:
+            from kubeflow_tpu.training.checkpoint import restore_params
+
+            abstract = jax.eval_shape(
+                lambda: mdef.init(jax.random.key(0), self._cfg))
+            try:
+                self._params = restore_params(self._checkpoint, abstract)
+            except FileNotFoundError as e:
+                raise ModelError(str(e)) from e
+        else:
+            self._params = mdef.init(jax.random.key(self._seed), self._cfg)
+        cfg = self._cfg
+        self._apply = jax.jit(lambda p, x: mdef.apply(p, x, cfg))
+        self._mark_ready()
+
+    def predict(self, payload: Any) -> Any:
+        if isinstance(payload, dict):
+            # V2 path: single named tensor
+            if len(payload) != 1:
+                raise ModelError(
+                    "trainer runtime expects one input tensor "
+                    f"(got {sorted(payload)})")
+            payload = next(iter(payload.values()))
+        x = np.asarray(payload)
+        out = np.asarray(self._apply(self._params, x))
+        if self._output == "argmax":
+            return np.argmax(out, axis=-1)
+        return out
+
+
+@serving_runtime("trainer")
+def _trainer_runtime(name: str, uri: str | None = None,
+                     **config: Any) -> Model:
+    return TrainerCheckpointModel(name, uri, **config)

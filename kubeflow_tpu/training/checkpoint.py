@@ -71,3 +71,28 @@ def restore_or_init(trainer, directory: str | None):
             return restored, True
         mngr.close()
     return trainer.init_state(), False
+
+
+def restore_params(directory: str, abstract_params, *, step: int | None = None):
+    """Restore ONLY the `params` subtree of a trainer checkpoint, placed on
+    THIS process's devices (the serving-side restore: no optimizer state,
+    and the current topology rather than the training mesh's shardings —
+    orbax would otherwise read the training-time sharding file, which is
+    unsafe on a different topology).
+
+    Raises FileNotFoundError when the directory holds no checkpoint — a
+    configured-but-empty checkpoint must never silently serve random
+    weights."""
+    import orbax.checkpoint as ocp
+
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
+        abstract_params)
+    with ocp.CheckpointManager(os.path.abspath(directory)) as mngr:
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory!r}")
+        restored = mngr.restore(step, args=ocp.args.PyTreeRestore(
+            {"params": abstract}, partial_restore=True))
+    return restored["params"]
