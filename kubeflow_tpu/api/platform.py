@@ -78,6 +78,9 @@ class Platform:
                 TrainedModelController
 
             self.cluster.add(TrainedModelController)
+            from kubeflow_tpu.serving.graph import InferenceGraphController
+
+            self.cluster.add(InferenceGraphController)
         if "platform" in components:
             # L2 platform glue (SURVEY.md §2.1): multi-tenancy, workspaces,
             # PodDefault admission

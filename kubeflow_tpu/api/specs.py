@@ -54,6 +54,10 @@ def _register_framework_validators() -> None:
 
     VALIDATORS[TRAINEDMODEL_KIND] = validate_trainedmodel
 
+    from kubeflow_tpu.serving.graph import GRAPH_KIND, validate_graph
+
+    VALIDATORS[GRAPH_KIND] = validate_graph
+
 
 _register_framework_validators()
 

@@ -10,6 +10,9 @@ from kubeflow_tpu.serving.batching import DynamicBatcher
 from kubeflow_tpu.serving.controller import (ISVC_KIND,
                                              InferenceServiceController,
                                              validate_isvc)
+from kubeflow_tpu.serving.graph import (GRAPH_KIND, GraphRouter,
+                                        InferenceGraphController,
+                                        validate_graph)
 from kubeflow_tpu.serving.model import (FunctionModel, Model, ModelError,
                                         ModelRepository, load_model,
                                         serving_runtime)
@@ -29,11 +32,14 @@ from kubeflow_tpu.serving import trainer_runtime as _tr  # noqa: F401
 #   ("llama" continuous batching; "trainer" = any registry model checkpoint)
 
 __all__ = [
-    "DynamicBatcher", "FunctionModel", "ISVC_KIND", "InferRequest",
-    "InferResponse", "InferTensor", "InferenceServiceController", "Model",
+    "DynamicBatcher", "FunctionModel", "GRAPH_KIND", "GraphRouter",
+    "ISVC_KIND", "InferRequest",
+    "InferResponse", "InferTensor", "InferenceGraphController",
+    "InferenceServiceController", "Model",
     "ModelError", "ModelRepository", "ModelServer", "MultiModelAgent",
     "PayloadLogger", "ProtocolError",
     "Router", "StorageError", "TRAINEDMODEL_KIND", "TrainedModelController",
     "download", "load_model", "serving_runtime",
-    "v1_decode", "v1_encode", "validate_isvc", "validate_trainedmodel",
+    "v1_decode", "v1_encode", "validate_graph", "validate_isvc",
+    "validate_trainedmodel",
 ]

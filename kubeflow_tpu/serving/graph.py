@@ -1,0 +1,391 @@
+"""InferenceGraph — the kserve inference-graph analog (SURVEY.md §2.4;
+⊘ kserve `pkg/apis/serving/v1alpha1/inference_graph_types.go` +
+`pkg/router/main.go`).
+
+kserve's InferenceGraph CRD composes InferenceServices into a routing
+graph served by a dedicated router Deployment. Four node types, same
+semantics here:
+
+    Sequence — run steps in order; each step receives the original request
+               (`data: $request`) or the previous step's response
+               (`data: $response`, the default for non-first steps).
+    Switch   — route to the FIRST step whose `condition` matches the
+               request body; 404 if none match.
+    Ensemble — fan out to all steps in parallel, merge full responses as
+               {stepName: response} (e.g. {"a": {"predictions": [...]}}).
+    Splitter — pick exactly one step by `weight` (deterministic modular
+               schedule like the canary Router — no RNG flakes in tests).
+
+Spec (kserve shape):
+
+    kind: InferenceGraph
+    spec:
+      nodes:
+        root:                               # execution starts at "root"
+          routerType: Sequence
+          steps:
+            - name: step-1
+              serviceName: my-isvc          # leaf: an InferenceService
+              data: $request
+              dependency: Hard              # Hard fails the graph; Soft skips
+            - name: step-2
+              nodeName: other-node          # or recurse into another node
+              condition: instances.0.kind == "x"   # Switch only
+              weight: 60                    # Splitter only
+
+Conditions are a GJSON-lite dotted path into the request JSON, with an
+optional `== <json literal>` comparison (bare path = truthy existence).
+
+The controller materializes one GraphRouter HTTP server per graph (the
+router-Deployment analog); leaf steps POST to the member InferenceService's
+v1 dataplane. Chained `$response` data converts `{"predictions": P}` into
+`{"instances": P}` so the v1 contract holds along the chain.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from kubeflow_tpu.control.conditions import (JobConditionType, set_condition)
+from kubeflow_tpu.control.controller import Controller
+from kubeflow_tpu.pipelines.artifacts import json_digest
+from kubeflow_tpu.serving.controller import ISVC_KIND
+
+GRAPH_KIND = "InferenceGraph"
+ROUTER_TYPES = ("Sequence", "Switch", "Ensemble", "Splitter")
+
+
+def validate_graph(graph: dict[str, Any]) -> list[str]:
+    errs: list[str] = []
+    nodes = graph.get("spec", {}).get("nodes")
+    if not isinstance(nodes, dict) or not nodes:
+        return ["spec.nodes must be a non-empty mapping"]
+    if "root" not in nodes:
+        errs.append('spec.nodes must contain a "root" node')
+    for node_name, node in nodes.items():
+        rt = node.get("routerType")
+        if rt not in ROUTER_TYPES:
+            errs.append(f"nodes.{node_name}.routerType invalid: {rt!r} "
+                        f"(one of {ROUTER_TYPES})")
+        steps = node.get("steps")
+        if not isinstance(steps, list) or not steps:
+            errs.append(f"nodes.{node_name}.steps must be a non-empty list")
+            continue
+        names = [s.get("name") for s in steps
+                 if isinstance(s, dict) and s.get("name")]
+        for dup in sorted({n for n in names if names.count(n) > 1}):
+            # Ensemble responses merge by step name; a duplicate would
+            # silently shadow its sibling's response
+            errs.append(f"nodes.{node_name}: duplicate step name {dup!r}")
+        for i, step in enumerate(steps):
+            where = f"nodes.{node_name}.steps[{i}]"
+            has_svc = bool(step.get("serviceName"))
+            has_node = bool(step.get("nodeName"))
+            if has_svc == has_node:
+                errs.append(f"{where}: exactly one of serviceName|nodeName")
+            if has_node and step["nodeName"] not in nodes:
+                errs.append(f"{where}: unknown nodeName "
+                            f"{step['nodeName']!r}")
+            if step.get("data") not in (None, "$request", "$response"):
+                errs.append(f"{where}.data must be $request or $response")
+            if step.get("dependency", "Hard") not in ("Hard", "Soft"):
+                errs.append(f"{where}.dependency must be Hard or Soft")
+            if rt == "Splitter" and (
+                    not isinstance(step.get("weight"), int)
+                    or step.get("weight", 0) <= 0):
+                errs.append(f"{where}: Splitter steps need a positive "
+                            "int weight")
+            if rt == "Switch" and i < len(steps) - 1 \
+                    and not step.get("condition"):
+                # a condition-less step matches everything; only the last
+                # step may omit it (the default branch)
+                errs.append(f"{where}: non-final Switch steps need a "
+                            "condition")
+    # cycle check: recursing into an ancestor node would loop forever
+    def walk(name: str, stack: tuple[str, ...]) -> None:
+        if name in stack:
+            errs.append("node cycle: " + " -> ".join(stack + (name,)))
+            return
+        for step in nodes.get(name, {}).get("steps") or ():
+            if isinstance(step, dict) and step.get("nodeName"):
+                walk(step["nodeName"], stack + (name,))
+
+    if not errs:
+        walk("root", ())
+    return errs
+
+
+def _json_path(obj: Any, path: str) -> Any:
+    """GJSON-lite: dotted path, integer segments index into lists."""
+    cur = obj
+    for seg in path.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(seg)]
+            except (ValueError, IndexError):
+                return None
+        elif isinstance(cur, dict):
+            cur = cur.get(seg)
+        else:
+            return None
+    return cur
+
+
+def eval_condition(cond: str, body: Any) -> bool:
+    """`path == <json literal>` comparison, or bare-path truthiness."""
+    if "==" in cond:
+        path, _, lit = cond.partition("==")
+        try:
+            want = json.loads(lit.strip())
+        except json.JSONDecodeError:
+            want = lit.strip()
+        return _json_path(body, path.strip()) == want
+    return bool(_json_path(body, cond.strip()))
+
+
+class GraphExecutionError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        super().__init__(message)
+
+
+class GraphRouter:
+    """HTTP server executing one InferenceGraph — the kserve router
+    Deployment analog. `resolve` maps serviceName → base URL (looked up
+    live, so member ISVC rollouts/reschedules are picked up per request)."""
+
+    def __init__(self, name: str, nodes: dict[str, Any], resolve,
+                 port: int = 0):
+        self.name = name
+        self.nodes = nodes
+        self.resolve = resolve
+        self._splitter_count: dict[str, int] = {}
+        self._lock = threading.Lock()
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    result = router.execute("root", body)
+                    code, payload = 200, result
+                except GraphExecutionError as e:
+                    code, payload = e.status, {"error": str(e)}
+                except Exception as e:  # defensive: malformed JSON etc.
+                    code, payload = 400, {"error": f"{type(e).__name__}: {e}"}
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=f"graph-{name}").start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- execution ------------------------------------------------------------
+
+    def execute(self, node_name: str, request: Any) -> Any:
+        node = self.nodes[node_name]
+        rt = node["routerType"]
+        steps = node["steps"]
+        if rt == "Sequence":
+            return self._run_sequence(steps, request)
+        if rt == "Switch":
+            for step in steps:
+                cond = step.get("condition")
+                if cond is None or eval_condition(cond, request):
+                    out = self._try_step(step, request)
+                    if out is not None:
+                        return out
+                    # Soft failure: fall through to the next matching branch
+            raise GraphExecutionError(404, "no Switch condition matched")
+        if rt == "Ensemble":
+            # one thread per step and per request (not a shared bounded
+            # pool: nested Ensemble nodes executing inside pool workers
+            # would deadlock waiting on children that can never schedule)
+            with ThreadPoolExecutor(
+                    max_workers=len(steps),
+                    thread_name_prefix=f"graph-{self.name}") as pool:
+                futures = {step.get("name", f"step-{i}"):
+                           pool.submit(self._try_step, step, request)
+                           for i, step in enumerate(steps)}
+                merged: dict[str, Any] = {}
+                for sname, fut in futures.items():
+                    out = fut.result()
+                    if out is not None:
+                        merged[sname] = out
+            if not merged:
+                raise GraphExecutionError(502, "all Ensemble steps failed")
+            return merged
+        # Splitter
+        total = sum(s["weight"] for s in steps)
+        with self._lock:
+            n = self._splitter_count[node_name] = (
+                self._splitter_count.get(node_name, 0) + 1)
+        # deterministic weighted schedule: request n maps to point
+        # (n * 7919) mod total; the prime stride walks every residue class
+        # so each cumulative-weight bucket receives exactly its share
+        point = (n * 7919) % total
+        acc = 0
+        for step in steps:
+            acc += step["weight"]
+            if point < acc:
+                return self._run_step(step, request)
+        return self._run_step(steps[-1], request)
+
+    def _run_sequence(self, steps: list[dict], request: Any) -> Any:
+        original, current = request, request
+        for i, step in enumerate(steps):
+            data = step.get("data") or ("$request" if i == 0
+                                        else "$response")
+            payload = original if data == "$request" else current
+            if data == "$response" and isinstance(payload, dict) \
+                    and "predictions" in payload:
+                # keep the v1 contract along the chain: the previous hop's
+                # predictions become this hop's instances
+                payload = {"instances": payload["predictions"]}
+            out = self._try_step(step, payload)
+            if out is not None:
+                current = out
+        return current
+
+    def _try_step(self, step: dict, payload: Any) -> Any:
+        """Run one step honoring its dependency mode: Hard failures
+        propagate; Soft failures return None (caller keeps going)."""
+        try:
+            return self._run_step(step, payload)
+        except GraphExecutionError:
+            if step.get("dependency", "Hard") == "Hard":
+                raise
+            return None
+
+    def _run_step(self, step: dict, payload: Any) -> Any:
+        if step.get("nodeName"):
+            return self.execute(step["nodeName"], payload)
+        svc = step["serviceName"]
+        url = self.resolve(svc)
+        if url is None:
+            raise GraphExecutionError(
+                503, f"InferenceService {svc!r} is not ready")
+        host, port = url.replace("http://", "").split(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=60)
+            conn.request("POST", f"/v1/models/{svc}:predict",
+                         body=json.dumps(payload),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+        except OSError as e:
+            raise GraphExecutionError(502, f"{svc}: unreachable: {e}") \
+                from None
+        if resp.status != 200:
+            raise GraphExecutionError(
+                resp.status, f"{svc}: {data.decode(errors='replace')}")
+        return json.loads(data)
+
+
+class InferenceGraphController(Controller):
+    """Reconciles InferenceGraph → one GraphRouter, resolving member
+    InferenceServices through the store (⊘ kserve
+    `pkg/controller/v1alpha1/inferencegraph/controller.go`)."""
+
+    kind = GRAPH_KIND
+    resync_period = 2.0
+
+    def __init__(self, cluster):
+        super().__init__(cluster)
+        self._lock = threading.RLock()
+        self._routers: dict[tuple[str, str], tuple[str, GraphRouter]] = {}
+
+    def stop(self) -> None:
+        super().stop()
+        with self._lock:
+            for _, router in self._routers.values():
+                router.stop()
+            self._routers.clear()
+
+    def reconcile_deleted(self, name: str, namespace: str) -> float | None:
+        with self._lock:
+            entry = self._routers.pop((namespace, name), None)
+        if entry is not None:
+            entry[1].stop()
+        return None
+
+    def reconcile(self, graph: dict[str, Any]) -> float | None:
+        name = graph["metadata"]["name"]
+        ns = graph["metadata"].get("namespace", "default")
+        errs = validate_graph(graph)
+        if errs:
+            def fail(o):
+                # an edited-to-invalid spec must not keep advertising Ready
+                o["status"]["conditions"] = [
+                    c for c in o["status"].get("conditions", ())
+                    if c["type"] != "Ready"]
+                set_condition(o["status"], JobConditionType.FAILED,
+                              "InvalidSpec", "; ".join(errs))
+            self.store.mutate(GRAPH_KIND, name, fail, ns)
+            return None
+        nodes = graph["spec"]["nodes"]
+        revision = json_digest(nodes)[:12]
+
+        def resolve(svc: str) -> str | None:
+            isvc = self.store.try_get(ISVC_KIND, svc, ns)
+            if isvc is None:
+                return None
+            return isvc.get("status", {}).get("url")
+
+        with self._lock:
+            entry = self._routers.get((ns, name))
+            if entry is not None and entry[0] != revision:
+                entry[1].stop()   # spec changed: replace the router
+                entry = None
+            if entry is None:
+                entry = (revision, GraphRouter(f"{ns}/{name}", nodes,
+                                               resolve))
+                self._routers[(ns, name)] = entry
+            else:
+                entry[1].nodes = nodes
+        router = entry[1]
+
+        members = sorted({s["serviceName"]
+                          for node in nodes.values()
+                          for s in node["steps"] if s.get("serviceName")})
+        missing = [m for m in members if resolve(m) is None]
+
+        def write(o):
+            o["status"]["url"] = router.url
+            o["status"]["members"] = members
+            o["status"]["pendingMembers"] = missing
+            if missing:
+                # a member went away: Ready must drop with it
+                o["status"]["conditions"] = [
+                    c for c in o["status"].get("conditions", ())
+                    if c["type"] != "Ready"]
+            else:
+                set_condition(o["status"], "Ready", "RouterReady",
+                              "graph router is ready")
+        self.store.mutate(GRAPH_KIND, name, write, ns)
+        return 2.0 if missing else None

@@ -1,0 +1,341 @@
+"""InferenceGraph tests — the kserve graph-router e2e analog (SURVEY.md
+§2.4): validation tables, then real HTTP through a GraphRouter composed of
+live InferenceServices (Sequence chaining, Switch conditions, Ensemble
+fan-out, Splitter weights, Soft/Hard dependencies, nested nodes).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import serving
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import has_condition
+from kubeflow_tpu.serving.graph import eval_condition, validate_graph
+from kubeflow_tpu.serving.model import FunctionModel, unwrap_single_tensor
+
+# arithmetic runtimes make chained dataflow assertable exactly
+if "double" not in serving.model._RUNTIMES:
+    @serving.serving_runtime("double")
+    def _double(name, uri=None, **cfg):
+        return FunctionModel(name, lambda x: (
+            np.asarray(unwrap_single_tensor(x), dtype=np.float64) * 2))
+
+    @serving.serving_runtime("inc")
+    def _inc(name, uri=None, **cfg):
+        return FunctionModel(name, lambda x: (
+            np.asarray(unwrap_single_tensor(x), dtype=np.float64) + 1))
+
+
+def http_json(url: str, body):
+    host, port = url.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request("POST", "/", body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp.status, data
+
+
+def make_isvc(name, fmt):
+    return new_resource(serving.ISVC_KIND, name,
+                        spec={"predictor": {"model": {"modelFormat": fmt}}})
+
+
+def make_graph(name, nodes):
+    return new_resource(serving.GRAPH_KIND, name, spec={"nodes": nodes})
+
+
+@pytest.fixture()
+def graph_cluster():
+    c = Cluster(n_devices=8)
+    c.add(serving.InferenceServiceController)
+    c.add(serving.InferenceGraphController)
+    with c:
+        yield c
+
+
+def ready_graph(cluster, name, timeout=30):
+    return cluster.wait_for(
+        serving.GRAPH_KIND, name,
+        lambda o: has_condition(o["status"], "Ready"), timeout=timeout)
+
+
+def seed(cluster, *pairs):
+    for name, fmt in pairs:
+        cluster.store.create(make_isvc(name, fmt))
+
+
+# -- validation ---------------------------------------------------------------
+
+
+class TestValidation:
+    def test_requires_root_and_router_type(self):
+        errs = validate_graph(make_graph("g", {
+            "n": {"routerType": "Bogus", "steps": [{"serviceName": "a"}]}}))
+        assert any("root" in e for e in errs)
+        assert any("routerType" in e for e in errs)
+
+    def test_step_target_exclusivity_and_unknown_node(self):
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Sequence", "steps": [
+                {"serviceName": "a", "nodeName": "also"},
+                {"nodeName": "ghost"},
+                {}]}}))
+        assert any("exactly one of" in e for e in errs)
+        assert any("ghost" in e for e in errs)
+
+    def test_splitter_needs_weights_and_switch_needs_conditions(self):
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Splitter",
+                     "steps": [{"serviceName": "a"}]}}))
+        assert any("weight" in e for e in errs)
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Switch", "steps": [
+                {"serviceName": "a"}, {"serviceName": "b"}]}}))
+        assert any("condition" in e for e in errs)
+
+    def test_rejects_nonpositive_weights_and_duplicate_names(self):
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "a", "weight": 0},
+                {"serviceName": "b", "weight": 1}]}}))
+        assert any("positive" in e for e in errs)
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Ensemble", "steps": [
+                {"name": "x", "serviceName": "a"},
+                {"name": "x", "serviceName": "b"}]}}))
+        assert any("duplicate step name" in e for e in errs)
+
+    def test_cycle_detected(self):
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Sequence",
+                     "steps": [{"nodeName": "a"}]},
+            "a": {"routerType": "Sequence",
+                  "steps": [{"nodeName": "root"}]}}))
+        assert any("cycle" in e for e in errs)
+
+    def test_valid_graph_passes(self):
+        assert validate_graph(make_graph("g", {
+            "root": {"routerType": "Sequence",
+                     "steps": [{"serviceName": "a"}]}})) == []
+
+    def test_condition_eval(self):
+        body = {"instances": [[5.0]], "parameters": {"lang": "en"}}
+        assert eval_condition('parameters.lang == "en"', body)
+        assert not eval_condition('parameters.lang == "fr"', body)
+        assert eval_condition("instances.0.0 == 5.0", body)
+        assert eval_condition("parameters", body)
+        assert not eval_condition("missing.path", body)
+
+
+# -- execution ----------------------------------------------------------------
+
+
+class TestGraphE2E:
+    def test_sequence_chains_responses(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("seq", {
+            "root": {"routerType": "Sequence", "steps": [
+                {"name": "s1", "serviceName": "dbl"},
+                {"name": "s2", "serviceName": "inc"}]}}))
+        g = ready_graph(c, "seq")
+        assert g["status"]["members"] == ["dbl", "inc"]
+        code, out = http_json(g["status"]["url"], {"instances": [1.0, 4.0]})
+        # (x*2)+1: the second step consumed the first step's predictions
+        assert code == 200 and out["predictions"] == [3.0, 9.0]
+
+    def test_sequence_data_request_resends_original(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("seq2", {
+            "root": {"routerType": "Sequence", "steps": [
+                {"serviceName": "dbl"},
+                {"serviceName": "inc", "data": "$request"}]}}))
+        g = ready_graph(c, "seq2")
+        code, out = http_json(g["status"]["url"], {"instances": [1.0]})
+        assert code == 200 and out["predictions"] == [2.0]  # 1+1, not 2+1
+
+    def test_switch_routes_by_condition(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("sw", {
+            "root": {"routerType": "Switch", "steps": [
+                {"serviceName": "dbl",
+                 "condition": 'parameters.mode == "double"'},
+                {"serviceName": "inc"}]}}))   # default branch
+        g = ready_graph(c, "sw")
+        url = g["status"]["url"]
+        code, out = http_json(url, {"instances": [3.0],
+                                    "parameters": {"mode": "double"}})
+        assert code == 200 and out["predictions"] == [6.0]
+        code, out = http_json(url, {"instances": [3.0]})
+        assert code == 200 and out["predictions"] == [4.0]
+
+    def test_switch_soft_branch_falls_through(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("inc", "inc"))
+        # first branch matches everything but its service is down (Soft):
+        # the request falls through to the default branch
+        c.store.create(make_graph("swsoft", {
+            "root": {"routerType": "Switch", "steps": [
+                {"serviceName": "ghost", "condition": "instances",
+                 "dependency": "Soft"},
+                {"serviceName": "inc"}]}}))
+        c.wait_for(
+            serving.GRAPH_KIND, "swsoft",
+            lambda o: o.get("status", {}).get("pendingMembers") == ["ghost"],
+            timeout=30)
+        g = c.store.get(serving.GRAPH_KIND, "swsoft")
+        code, out = http_json(g["status"]["url"], {"instances": [1.0]})
+        assert code == 200 and out["predictions"] == [2.0]
+
+    def test_switch_no_match_404(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        c.store.create(make_graph("sw404", {
+            "root": {"routerType": "Switch", "steps": [
+                {"serviceName": "dbl", "condition": "parameters.never"}]}}))
+        g = ready_graph(c, "sw404")
+        code, out = http_json(g["status"]["url"], {"instances": [1.0]})
+        assert code == 404 and "no Switch condition" in out["error"]
+
+    def test_ensemble_merges_parallel_responses(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("ens", {
+            "root": {"routerType": "Ensemble", "steps": [
+                {"name": "a", "serviceName": "dbl"},
+                {"name": "b", "serviceName": "inc"}]}}))
+        g = ready_graph(c, "ens")
+        code, out = http_json(g["status"]["url"], {"instances": [2.0]})
+        assert code == 200
+        assert out["a"]["predictions"] == [4.0]
+        assert out["b"]["predictions"] == [3.0]
+
+    def test_splitter_exact_weighted_split(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("spl", {
+            "root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "dbl", "weight": 3},
+                {"serviceName": "inc", "weight": 1}]}}))
+        g = ready_graph(c, "spl")
+        url = g["status"]["url"]
+        outs = [http_json(url, {"instances": [10.0]})[1]["predictions"][0]
+                for _ in range(100)]
+        # deterministic schedule: exactly 75% to weight-3, 25% to weight-1
+        assert outs.count(20.0) == 75 and outs.count(11.0) == 25
+
+    def test_nested_node(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"), ("inc", "inc"))
+        c.store.create(make_graph("nest", {
+            "root": {"routerType": "Sequence", "steps": [
+                {"serviceName": "dbl"},
+                {"nodeName": "fan"}]},
+            "fan": {"routerType": "Ensemble", "steps": [
+                {"name": "x", "serviceName": "dbl"},
+                {"name": "y", "serviceName": "inc"}]}}))
+        g = ready_graph(c, "nest")
+        code, out = http_json(g["status"]["url"], {"instances": [1.0]})
+        # dbl → predictions [2] → instances [2] → ensemble over dbl/inc
+        assert code == 200
+        assert out["x"]["predictions"] == [4.0]
+        assert out["y"]["predictions"] == [3.0]
+
+    def test_soft_dependency_skips_failed_member(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        # "ghost" never becomes ready; Soft lets the ensemble proceed
+        c.store.create(make_graph("soft", {
+            "root": {"routerType": "Ensemble", "steps": [
+                {"name": "ok", "serviceName": "dbl"},
+                {"name": "gone", "serviceName": "ghost",
+                 "dependency": "Soft"}]}}))
+        g = c.wait_for(
+            serving.GRAPH_KIND, "soft",
+            lambda o: o.get("status", {}).get("pendingMembers") == ["ghost"],
+            timeout=30)
+        code, out = http_json(g["status"]["url"], {"instances": [2.0]})
+        assert code == 200 and list(out) == ["ok"]
+
+    def test_hard_dependency_fails_graph(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        c.store.create(make_graph("hard", {
+            "root": {"routerType": "Ensemble", "steps": [
+                {"name": "ok", "serviceName": "dbl"},
+                {"name": "gone", "serviceName": "ghost"}]}}))
+        g = c.wait_for(
+            serving.GRAPH_KIND, "hard",
+            lambda o: o.get("status", {}).get("pendingMembers") == ["ghost"],
+            timeout=30)
+        code, out = http_json(g["status"]["url"], {"instances": [2.0]})
+        assert code == 503 and "ghost" in out["error"]
+
+    def test_becomes_ready_when_member_arrives(self, graph_cluster):
+        c = graph_cluster
+        c.store.create(make_graph("late", {
+            "root": {"routerType": "Sequence",
+                     "steps": [{"serviceName": "dbl"}]}}))
+        c.wait_for(serving.GRAPH_KIND, "late",
+                   lambda o: o.get("status", {}).get("pendingMembers"),
+                   timeout=30)
+        seed(c, ("dbl", "double"))
+        g = ready_graph(c, "late")
+        assert g["status"]["pendingMembers"] == []
+        code, out = http_json(g["status"]["url"], {"instances": [8.0]})
+        assert code == 200 and out["predictions"] == [16.0]
+
+    def test_ready_drops_when_member_deleted(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        c.store.create(make_graph("dropm", {
+            "root": {"routerType": "Sequence",
+                     "steps": [{"serviceName": "dbl"}]}}))
+        ready_graph(c, "dropm")
+        c.store.delete(serving.ISVC_KIND, "dbl")
+        g = c.wait_for(
+            serving.GRAPH_KIND, "dropm",
+            lambda o: o.get("status", {}).get("pendingMembers") == ["dbl"],
+            timeout=30)
+        assert not has_condition(g["status"], "Ready")
+
+    def test_invalid_spec_sets_failed(self, graph_cluster):
+        c = graph_cluster
+        c.store.create(make_graph("bad", {
+            "root": {"routerType": "Nope",
+                     "steps": [{"serviceName": "a"}]}}))
+        g = c.wait_for(serving.GRAPH_KIND, "bad",
+                       lambda o: has_condition(o["status"], "Failed"),
+                       timeout=30)
+        assert "routerType" in g["status"]["conditions"][0]["message"]
+
+    def test_delete_stops_router(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        c.store.create(make_graph("del", {
+            "root": {"routerType": "Sequence",
+                     "steps": [{"serviceName": "dbl"}]}}))
+        g = ready_graph(c, "del")
+        url = g["status"]["url"]
+        c.store.delete(serving.GRAPH_KIND, "del")
+        ctrl = next(ct for ct in c.controllers
+                    if isinstance(ct, serving.InferenceGraphController))
+        deadline_ok = False
+        for _ in range(100):
+            if ("default", "del") not in ctrl._routers:
+                deadline_ok = True
+                break
+            import time as _t
+            _t.sleep(0.05)
+        assert deadline_ok
+        with pytest.raises(OSError):
+            http_json(url, {"instances": [1.0]})
