@@ -40,6 +40,15 @@ class Algorithm:
     `algorithmSettings` string map; subclasses read what they need."""
 
     name = ""
+    # an empty suggest() batch normally means the algorithm enumerated its
+    # whole space (grid) and the experiment may complete; generation-gated
+    # algorithms (PBT) set False: empty means "waiting on running trials"
+    exhaustible = True
+    # set by the suggestion controller before each suggest() call: total
+    # assignments already handed out (>= finished history, since handed-out
+    # trials may still be running). Generation-gated algorithms need it to
+    # avoid re-emitting in-flight population slots after a restart.
+    issued: int | None = None
 
     def __init__(self, space: SearchSpace,
                  settings: dict[str, Any] | None = None, seed: int = 0):

@@ -1,0 +1,132 @@
+"""Population Based Training (Jaderberg et al. 2017) — ⊘ katib
+pkg/suggestion/v1beta1/pbt/service.py.
+
+Katib's PBT service evolves a fixed-size population generation by
+generation: when a generation of trials completes, the bottom
+`truncation_threshold` fraction is replaced by copies of uniformly-drawn
+top performers (exploit) whose hyperparameters are then perturbed or
+resampled (explore); survivors carry their parameters forward unchanged.
+
+Checkpoint lineage: each suggested assignment carries a `pbt_parent` key —
+the 0-based index (into the experiment's finished-trial history) of the
+trial whose weights this member should warm-start from, or -1 for a fresh
+start. Trial templates can reference it via trialParameters (e.g. to build
+a restore path), exactly how Katib's PBT passes checkpoint directories
+through annotations. Extra assignment keys ride along without being part
+of the search space.
+
+algorithmSettings (Katib names):
+    n_population          population / generation size       (default 8)
+    truncation_threshold  fraction exploited each generation (default 0.2)
+    resample_probability  P(resample a param from scratch vs perturb) (0.25)
+    perturb_factors       comma-separated multipliers        ("0.8,1.2")
+
+Like all algorithms here, state reconstructs from history alone
+(resumePolicy: FromVolume): generations are consecutive chunks of the
+finished-trial list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import Algorithm, TrialResult, register
+
+
+@register("pbt")
+class PopulationBasedTraining(Algorithm):
+    exhaustible = False   # an empty batch means "generation in flight"
+
+    def __init__(self, space, settings=None, seed=0):
+        super().__init__(space, settings, seed)
+        self.n_pop = int(self._setting("n_population", 8))
+        if self.n_pop < 2:
+            raise ValueError("pbt needs n_population >= 2")
+        self.truncation = self._setting("truncation_threshold", 0.2)
+        if not 0.0 < self.truncation <= 0.5:
+            raise ValueError("truncation_threshold must be in (0, 0.5]")
+        self.resample_p = self._setting("resample_probability", 0.25)
+        factors = str(self.settings.get("perturb_factors", "0.8,1.2"))
+        self.factors = tuple(float(f) for f in factors.split(","))
+        # suggestions handed out but not yet reflected in finished history
+        self._queue: list[dict[str, Any]] = []
+        self._generations_emitted = 0
+
+    # -- explore --------------------------------------------------------------
+
+    def _explore(self, params: dict[str, Any]) -> dict[str, Any]:
+        """Perturb each space parameter: numeric values are multiplied by a
+        random perturb factor (clamped to bounds, re-quantized through the
+        unit embedding so int/step constraints hold); categoricals and any
+        param hit by resample_probability draw fresh."""
+        out = dict(params)
+        for p in self.space.parameters:
+            if self.rng.uniform() < self.resample_p \
+                    or p.type in ("categorical", "discrete"):
+                out[p.name] = p.sample(self.rng)
+                continue
+            factor = self.factors[self.rng.integers(len(self.factors))]
+            x = float(params[p.name]) * factor
+            x = min(max(x, float(p.min)), float(p.max))
+            out[p.name] = p.from_unit(p.to_unit(x))
+        return out
+
+    # -- generation advance ---------------------------------------------------
+
+    def _next_generation(self, gen: list[TrialResult],
+                         base_index: int) -> list[dict[str, Any]]:
+        """gen = one finished generation (history order); base_index = index
+        of gen[0] in the full finished history (for pbt_parent lineage)."""
+        ranked = sorted(range(len(gen)), key=lambda i: (
+            gen[i].value if gen[i].ok else np.inf))
+        k = max(1, int(np.ceil(self.truncation * len(gen))))
+        top, bottom = ranked[:k], set(ranked[-k:])
+        members = []
+        for i, t in enumerate(gen):
+            if i in bottom or not t.ok:
+                # exploit: clone a uniformly-drawn top performer, explore
+                src = top[self.rng.integers(len(top))]
+                params = self._explore(gen[src].params)
+                parent = base_index + src
+            else:
+                # survivor: same hyperparameters, continue from own weights
+                params = {p.name: t.params[p.name]
+                          for p in self.space.parameters}
+                parent = base_index + i
+            members.append({**params, "pbt_parent": parent})
+        return members
+
+    def suggest(self, count: int,
+                history: Sequence[TrialResult]) -> list[dict[str, Any]]:
+        finished = list(history)   # includes failed: they occupy a slot
+        # generations are consecutive n_pop-sized chunks of history; the
+        # frontier generation is the one currently being filled
+        frontier = len(finished) // self.n_pop
+        if self._generations_emitted <= frontier:
+            if self._generations_emitted < frontier:
+                # restart / missed generations: anything queued is stale
+                self._queue.clear()
+            # members still owed for the frontier = population size minus
+            # slots already handed out (handed-out > finished when trials
+            # are in flight — those slots must NOT be re-emitted)
+            issued = self.issued if self.issued is not None \
+                else len(finished)
+            taken = max(issued, frontier * self.n_pop)
+            n_missing = max(0, (frontier + 1) * self.n_pop - taken)
+            if n_missing and frontier == 0:
+                members = [{**self.space.sample(self.rng), "pbt_parent": -1}
+                           for _ in range(n_missing)]
+            elif n_missing:
+                base = (frontier - 1) * self.n_pop
+                gen = finished[base:base + self.n_pop]
+                # position-wise generation build; the tail slice holds the
+                # positions nothing has been handed out for yet
+                members = self._next_generation(gen, base)[-n_missing:]
+            else:
+                members = []
+            self._queue.extend(members)
+            self._generations_emitted = frontier + 1
+        out, self._queue = self._queue[:count], self._queue[count:]
+        return out

@@ -139,8 +139,13 @@ class SuggestionController(Controller):
         if need <= 0:
             return None
         algorithm = self._algorithm(sug)
+        algorithm.issued = len(assignments)
         batch = algorithm.suggest(need, self._history(sug))
         if not batch:
+            if not algorithm.exhaustible:
+                # generation-gated (PBT): the next batch unlocks when the
+                # in-flight generation finishes; poll, don't complete
+                return 0.5
             # algorithm exhausted (e.g. full grid enumerated)
             self.store.mutate(
                 SUGGESTION_KIND, sug["metadata"]["name"],

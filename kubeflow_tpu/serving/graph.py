@@ -84,6 +84,9 @@ def validate_graph(graph: dict[str, Any]) -> list[str]:
             errs.append(f"nodes.{node_name}: duplicate step name {dup!r}")
         for i, step in enumerate(steps):
             where = f"nodes.{node_name}.steps[{i}]"
+            if not isinstance(step, dict):
+                errs.append(f"{where} must be a mapping")
+                continue
             has_svc = bool(step.get("serviceName"))
             has_node = bool(step.get("nodeName"))
             if has_svc == has_node:
@@ -106,14 +109,21 @@ def validate_graph(graph: dict[str, Any]) -> list[str]:
                 # step may omit it (the default branch)
                 errs.append(f"{where}: non-final Switch steps need a "
                             "condition")
-    # cycle check: recursing into an ancestor node would loop forever
+    # cycle check: recursing into an ancestor node would loop forever.
+    # `safe` memoizes nodes proven cycle-free so diamond-shaped DAGs stay
+    # linear instead of enumerating every root-to-leaf path
+    safe: set[str] = set()
+
     def walk(name: str, stack: tuple[str, ...]) -> None:
+        if name in safe:
+            return
         if name in stack:
             errs.append("node cycle: " + " -> ".join(stack + (name,)))
             return
         for step in nodes.get(name, {}).get("steps") or ():
             if isinstance(step, dict) and step.get("nodeName"):
                 walk(step["nodeName"], stack + (name,))
+        safe.add(name)
 
     if not errs:
         walk("root", ())
@@ -379,12 +389,13 @@ class InferenceGraphController(Controller):
             o["status"]["url"] = router.url
             o["status"]["members"] = members
             o["status"]["pendingMembers"] = missing
-            if missing:
-                # a member went away: Ready must drop with it
-                o["status"]["conditions"] = [
-                    c for c in o["status"].get("conditions", ())
-                    if c["type"] != "Ready"]
-            else:
+            # a fixed spec must shed the stale Failed from its invalid past
+            drop = ("Ready", JobConditionType.FAILED) if missing \
+                else (JobConditionType.FAILED,)
+            o["status"]["conditions"] = [
+                c for c in o["status"].get("conditions", ())
+                if c["type"] not in drop]
+            if not missing:
                 set_condition(o["status"], "Ready", "RouterReady",
                               "graph router is ready")
         self.store.mutate(GRAPH_KIND, name, write, ns)

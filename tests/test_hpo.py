@@ -159,6 +159,70 @@ class TestAlgorithms:
         later = algo.suggest(6, history)
         assert any(p["epochs"] >= 3 for p in later)
 
+    def test_pbt_generation_structure(self):
+        algo = make_algorithm("pbt", QUAD_SPACE,
+                              {"n_population": 4}, seed=3)
+        gen0 = algo.suggest(4, [])
+        assert len(gen0) == 4
+        assert all(m["pbt_parent"] == -1 for m in gen0)
+        # generation in flight: empty batch, but NOT exhausted semantics
+        assert algo.suggest(4, []) == []
+        assert not algo.exhaustible
+        history = [TrialResult(params=p, value=v)
+                   for p, v in zip(gen0, [0.1, 0.2, 0.3, 0.4])]
+        gen1 = algo.suggest(4, history)
+        assert len(gen1) == 4
+        # survivors (positions 0-2) keep their params and own lineage
+        for i in range(3):
+            assert gen1[i]["pbt_parent"] == i
+            assert gen1[i]["x"] == gen0[i]["x"]
+            assert gen1[i]["y"] == gen0[i]["y"]
+        # the worst member exploits the best and explores around it
+        assert gen1[3]["pbt_parent"] == 0
+        assert -1.0 <= gen1[3]["x"] <= 1.0
+        assert -1.0 <= gen1[3]["y"] <= 1.0
+
+    def test_pbt_improves(self):
+        best = run_optimizer("pbt", budget=48, batch=4,
+                             settings={"n_population": 8,
+                                       "truncation_threshold": 0.25})
+        assert best < 0.15
+
+    def test_pbt_resume_emits_frontier_tail_only(self):
+        algo = make_algorithm("pbt", QUAD_SPACE,
+                              {"n_population": 4}, seed=3)
+        gen0 = algo.suggest(4, [])
+        history = [TrialResult(params=p, value=v)
+                   for p, v in zip(gen0, [0.4, 0.1, 0.3, 0.2])]
+        # finish gen0 plus 2 members of gen1, then "restart" the service
+        history += [TrialResult(params=m, value=0.5)
+                    for m in algo.suggest(4, history)[:2]]
+        fresh = make_algorithm("pbt", QUAD_SPACE,
+                               {"n_population": 4}, seed=3)
+        tail = fresh.suggest(10, history)
+        assert len(tail) == 2   # only the frontier's unfinished slots
+        assert all(0 <= m["pbt_parent"] < 4 for m in tail)
+
+    def test_pbt_restart_skips_inflight_slots(self):
+        """Handed-out-but-running slots must not be re-emitted: the
+        controller reports issued assignments, which exceed finished
+        history while trials are in flight."""
+        algo = make_algorithm("pbt", QUAD_SPACE,
+                              {"n_population": 4}, seed=3)
+        gen0 = algo.suggest(4, [])
+        history = [TrialResult(params=p, value=v)
+                   for p, v in zip(gen0, [0.4, 0.1, 0.3, 0.2])]
+        algo.suggest(4, history)   # whole gen1 handed out
+        fresh = make_algorithm("pbt", QUAD_SPACE,
+                               {"n_population": 4}, seed=3)
+        fresh.issued = 8           # all 8 slots assigned, 4 still running
+        assert fresh.suggest(10, history) == []
+        # once gen1 finishes, gen2 unlocks with a full population
+        history += [TrialResult(params={"x": 0.0, "y": 0.0}, value=0.5)
+                    for _ in range(4)]
+        fresh.issued = 8
+        assert len(fresh.suggest(10, history)) == 4
+
     def test_unknown_algorithm_raises(self):
         with pytest.raises(ValueError, match="unknown algorithm"):
             make_algorithm("annealing", QUAD_SPACE)
@@ -383,6 +447,21 @@ class TestExperimentE2E:
         # assignments stay space-keyed so model-based history works
         opt = done["status"]["currentOptimalTrial"]
         assert set(opt["parameterAssignments"]) == {"x", "y"}
+
+    def test_pbt_experiment_evolves_population(self, hpo_cluster):
+        cluster, _ = hpo_cluster
+        cluster.store.create(make_experiment(
+            "pbt-e2e", algorithm="pbt", max_trials=8, parallel=4,
+            settings={"n_population": 4}))
+        exp = wait_exp(cluster, "pbt-e2e", timeout=120)
+        assert has_condition(exp["status"], JobConditionType.SUCCEEDED)
+        trials = cluster.store.list("Trial", "default")
+        gen1_parents = [
+            t["spec"]["parameterAssignments"]["pbt_parent"]
+            for t in trials
+            if t["spec"]["parameterAssignments"]["pbt_parent"] >= 0]
+        # the second generation exists and its lineage points into gen 0
+        assert gen1_parents and all(0 <= p < 4 for p in gen1_parents)
 
     def test_tpe_experiment_improves_over_first_trials(self, hpo_cluster):
         cluster, _ = hpo_cluster

@@ -112,6 +112,11 @@ class TestValidation:
                 {"name": "x", "serviceName": "b"}]}}))
         assert any("duplicate step name" in e for e in errs)
 
+    def test_non_dict_step_is_an_error_not_a_crash(self):
+        errs = validate_graph(make_graph("g", {
+            "root": {"routerType": "Sequence", "steps": ["my-isvc"]}}))
+        assert any("must be a mapping" in e for e in errs)
+
     def test_cycle_detected(self):
         errs = validate_graph(make_graph("g", {
             "root": {"routerType": "Sequence",
@@ -317,6 +322,22 @@ class TestGraphE2E:
                        lambda o: has_condition(o["status"], "Failed"),
                        timeout=30)
         assert "routerType" in g["status"]["conditions"][0]["message"]
+
+    def test_fixed_spec_sheds_failed_condition(self, graph_cluster):
+        c = graph_cluster
+        seed(c, ("dbl", "double"))
+        c.store.create(make_graph("heal", {
+            "root": {"routerType": "Nope",
+                     "steps": [{"serviceName": "dbl"}]}}))
+        c.wait_for(serving.GRAPH_KIND, "heal",
+                   lambda o: has_condition(o["status"], "Failed"),
+                   timeout=30)
+        c.store.mutate(
+            serving.GRAPH_KIND, "heal",
+            lambda o: o["spec"]["nodes"]["root"].update(
+                routerType="Sequence"))
+        g = ready_graph(c, "heal")
+        assert not has_condition(g["status"], "Failed")
 
     def test_delete_stops_router(self, graph_cluster):
         c = graph_cluster
