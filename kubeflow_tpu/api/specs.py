@@ -23,8 +23,10 @@ import yaml
 from kubeflow_tpu.control.jobs import JOB_KIND, validate_job
 from kubeflow_tpu.control.store import new_resource
 from kubeflow_tpu.hpo.experiment import EXPERIMENT_KIND, validate_experiment
-from kubeflow_tpu.pipelines.controllers import (RUN_KIND, SCHEDULED_KIND,
-                                                validate_run)
+from kubeflow_tpu.pipelines.controllers import (PIPELINE_EXPERIMENT_KIND,
+                                                PIPELINE_EXPERIMENT_LABEL,
+                                                PIPELINE_KIND, RUN_KIND,
+                                                SCHEDULED_KIND, validate_run)
 from kubeflow_tpu.serving.controller import ISVC_KIND, validate_isvc
 
 
@@ -233,14 +235,63 @@ def inference_service(name: str, *, model_format: str,
     return new_resource(ISVC_KIND, name, namespace=namespace, spec=spec)
 
 
-def pipeline_run(name: str, pipeline_spec: dict[str, Any],
+def pipeline_run(name: str, pipeline_spec: dict[str, Any] | None = None,
                  parameters: dict[str, Any] | None = None,
-                 namespace: str = "default") -> dict[str, Any]:
-    """Build a PipelineRun from a compiled pipeline spec."""
-    return new_resource(RUN_KIND, name, namespace=namespace, spec={
-        "pipelineSpec": pipeline_spec,
-        "parameters": dict(parameters or {}),
+                 namespace: str = "default", *,
+                 pipeline_ref: str | None = None,
+                 version: str | None = None,
+                 experiment: str | None = None) -> dict[str, Any]:
+    """Build a PipelineRun from a compiled spec OR an uploaded Pipeline
+    reference (optionally pinned to a version). `experiment` groups the
+    run under a PipelineExperiment (⊘ KFP run→experiment association)."""
+    if pipeline_spec is not None and pipeline_ref is not None:
+        raise ValueError("pass pipeline_spec OR pipeline_ref, not both")
+    if version is not None and pipeline_ref is None:
+        raise ValueError("version requires pipeline_ref")
+    spec: dict[str, Any] = {"parameters": dict(parameters or {})}
+    if pipeline_spec is not None:
+        spec["pipelineSpec"] = pipeline_spec
+    if pipeline_ref is not None:
+        spec["pipelineRef"] = ({"name": pipeline_ref, "version": version}
+                               if version else pipeline_ref)
+    labels = ({PIPELINE_EXPERIMENT_LABEL: experiment} if experiment
+              else None)
+    return new_resource(RUN_KIND, name, namespace=namespace, spec=spec,
+                        labels=labels)
+
+
+def uploaded_pipeline(name: str, pipeline_spec: dict[str, Any],
+                      version: str = "v1",
+                      namespace: str = "default") -> dict[str, Any]:
+    """Build a versioned Pipeline resource (⊘ KFP upload_pipeline).
+    Append further versions with `add_pipeline_version`."""
+    return new_resource(PIPELINE_KIND, name, namespace=namespace, spec={
+        "versions": [{"name": version, "pipelineSpec": pipeline_spec}],
+        "defaultVersion": version,
     })
+
+
+def add_pipeline_version(pipeline: dict[str, Any], version: str,
+                         pipeline_spec: dict[str, Any],
+                         make_default: bool = True) -> dict[str, Any]:
+    """Append a version to an uploaded Pipeline resource in place
+    (⊘ KFP upload_pipeline_version)."""
+    versions = pipeline["spec"].setdefault("versions", [])
+    if any(v["name"] == version for v in versions):
+        raise ValueError(f"pipeline {pipeline['metadata']['name']!r} "
+                         f"already has version {version!r}")
+    versions.append({"name": version, "pipelineSpec": pipeline_spec})
+    if make_default:
+        pipeline["spec"]["defaultVersion"] = version
+    return pipeline
+
+
+def pipeline_experiment(name: str, description: str = "",
+                        namespace: str = "default") -> dict[str, Any]:
+    """Build a PipelineExperiment: a grouping bucket for runs
+    (⊘ KFP experiments API)."""
+    return new_resource(PIPELINE_EXPERIMENT_KIND, name, namespace=namespace,
+                        spec={"description": description})
 
 
 def scheduled_run(name: str, pipeline_spec: dict[str, Any], *,

@@ -22,7 +22,9 @@ ScheduledRun.
 from kubeflow_tpu.pipelines import dsl
 from kubeflow_tpu.pipelines.artifacts import (Artifact, ArtifactStore,
                                               json_digest)
-from kubeflow_tpu.pipelines.controllers import (PIPELINE_KIND, RUN_KIND,
+from kubeflow_tpu.pipelines.controllers import (PIPELINE_EXPERIMENT_KIND,
+                                                PIPELINE_EXPERIMENT_LABEL,
+                                                PIPELINE_KIND, RUN_KIND,
                                                 SCHEDULED_KIND,
                                                 PipelineRunController,
                                                 ScheduledRunController,
@@ -35,6 +37,7 @@ from kubeflow_tpu.pipelines.metadata import MetadataStore
 
 __all__ = [
     "Artifact", "ArtifactStore", "Component", "DSLError", "MetadataStore",
+    "PIPELINE_EXPERIMENT_KIND", "PIPELINE_EXPERIMENT_LABEL",
     "PIPELINE_KIND", "Pipeline", "PipelineRunController", "RUN_KIND",
     "SCHEDULED_KIND", "ScheduledRunController", "compile_pipeline",
     "component", "dsl", "json_digest", "pipeline", "run_task",

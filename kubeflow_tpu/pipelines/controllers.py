@@ -57,7 +57,12 @@ from kubeflow_tpu.utils import cron
 PIPELINE_KIND = "Pipeline"
 RUN_KIND = "PipelineRun"
 SCHEDULED_KIND = "ScheduledRun"
+# run-grouping resource (⊘ KFP api-server "experiments"; renamed so it
+# cannot collide with the Katib-analog Experiment kind in the one store)
+PIPELINE_EXPERIMENT_KIND = "PipelineExperiment"
 RUN_LABEL = "kubeflow-tpu/pipeline-run"
+# runs carry this label to associate with a PipelineExperiment
+PIPELINE_EXPERIMENT_LABEL = "kubeflow-tpu/pipeline-experiment"
 
 
 @worker_target("pipeline_task")
@@ -71,8 +76,13 @@ def _pipeline_task(env, cancel):
 
 def validate_run(run: dict[str, Any]) -> list[str]:
     spec = run.get("spec", {})
-    if not spec.get("pipelineSpec") and not spec.get("pipelineRef"):
+    ref = spec.get("pipelineRef")
+    if not spec.get("pipelineSpec") and not ref:
         return ["spec.pipelineSpec or spec.pipelineRef is required"]
+    if ref is not None and not isinstance(ref, (str, dict)):
+        return ["spec.pipelineRef must be a name or {name, version}"]
+    if isinstance(ref, dict) and not ref.get("name"):
+        return ["spec.pipelineRef.name is required"]
     return []
 
 
@@ -111,7 +121,9 @@ class PipelineRunController(Controller):
             return None
         if not status.get("conditions"):
             self.metadata.get_or_create_context(self._run_id(run))
+            pinned = self._pin_version(run)
             self.store.mutate(RUN_KIND, name, lambda o: (
+                o["spec"].update(pipelineRef=pinned) if pinned else None,
                 o["status"].update(startTime=time.time(), tasks={}),
                 set_condition(o["status"], JobConditionType.CREATED,
                               "RunCreated", "pipeline run created")), ns)
@@ -271,16 +283,49 @@ class PipelineRunController(Controller):
         return (f"{run['metadata'].get('namespace', 'default')}/"
                 f"{run['metadata']['name']}")
 
+    def _pin_version(self, run: dict[str, Any]) -> dict[str, Any] | None:
+        """Resolve an unpinned pipelineRef to an explicit version at run
+        start (⊘ KFP pins the version at run creation): later default-
+        version changes must not swap the DAG under an in-flight run.
+        Returns the pinned ref dict, or None if nothing to pin."""
+        ref = run["spec"].get("pipelineRef")
+        if ref is None or (isinstance(ref, dict) and ref.get("version")):
+            return None
+        name = ref["name"] if isinstance(ref, dict) else ref
+        obj = self.store.try_get(
+            PIPELINE_KIND, name, run["metadata"].get("namespace", "default"))
+        if obj is None or "versions" not in obj["spec"]:
+            return None   # missing → fails later; unversioned → spec is IR
+        pspec = obj["spec"]
+        version = pspec.get("defaultVersion") or (
+            pspec["versions"][-1]["name"] if pspec["versions"] else None)
+        return {"name": name, "version": version} if version else None
+
     def _pipeline_spec(self, run: dict[str, Any]) -> dict[str, Any]:
         spec = run["spec"]
         if spec.get("pipelineSpec"):
             return spec["pipelineSpec"]
         ref = spec["pipelineRef"]
+        version = None
+        if isinstance(ref, dict):   # {name, version?} — KFP pipeline-version
+            ref, version = ref["name"], ref.get("version")
         obj = self.store.try_get(
             PIPELINE_KIND, ref, run["metadata"].get("namespace", "default"))
         if obj is None:
             raise KeyError(f"Pipeline {ref!r} not found")
-        return obj["spec"]
+        pspec = obj["spec"]
+        if "versions" not in pspec:
+            return pspec            # unversioned upload: spec IS the IR
+        versions = pspec["versions"]
+        if not versions:
+            raise KeyError(f"Pipeline {ref!r} has no versions")
+        if version is None:
+            version = pspec.get("defaultVersion") or versions[-1]["name"]
+        for v in versions:
+            if v["name"] == version:
+                return v["pipelineSpec"]
+        raise KeyError(f"Pipeline {ref!r} has no version {version!r}; "
+                       f"known: {[v['name'] for v in versions]}")
 
     def _params(self, run: dict[str, Any],
                 spec: dict[str, Any]) -> dict[str, Any]:

@@ -173,19 +173,85 @@ class PipelineClient(_ClientBase):
 
     def create_run_from_pipeline_func(
             self, pipeline: dsl.Pipeline | Callable, *,
-            run_name: str, parameters: dict[str, Any] | None = None
-    ) -> dict[str, Any]:
+            run_name: str, parameters: dict[str, Any] | None = None,
+            experiment: str | None = None) -> dict[str, Any]:
         spec = dsl.compile_pipeline(
             pipeline if isinstance(pipeline, dsl.Pipeline)
             else dsl.pipeline()(pipeline))
         return self.backend.apply(specs.pipeline_run(
-            run_name, spec, parameters, namespace=self.namespace))
+            run_name, spec, parameters, namespace=self.namespace,
+            experiment=experiment))
 
     def create_run_from_spec(self, spec: dict[str, Any], *, run_name: str,
-                             parameters: dict[str, Any] | None = None
+                             parameters: dict[str, Any] | None = None,
+                             experiment: str | None = None
                              ) -> dict[str, Any]:
         return self.backend.apply(specs.pipeline_run(
-            run_name, spec, parameters, namespace=self.namespace))
+            run_name, spec, parameters, namespace=self.namespace,
+            experiment=experiment))
+
+    # -- uploaded pipelines + versions (⊘ kfp.Client.upload_pipeline) --------
+
+    def upload_pipeline(self, pipeline: dsl.Pipeline | dict[str, Any], *,
+                        name: str, version: str = "v1") -> dict[str, Any]:
+        from kubeflow_tpu.api.server import ApiError
+        from kubeflow_tpu.control.store import NotFoundError
+
+        try:
+            self.backend.get(specs.PIPELINE_KIND, name, self.namespace)
+        except NotFoundError:
+            pass
+        except ApiError as e:
+            if e.reason != "NotFound":
+                raise
+        else:
+            # kfp.Client rejects duplicate pipeline names; replacing would
+            # silently drop every previously uploaded version
+            raise ValueError(
+                f"pipeline {name!r} already exists; use "
+                "upload_pipeline_version to add a version")
+        spec = (dsl.compile_pipeline(pipeline)
+                if isinstance(pipeline, dsl.Pipeline) else pipeline)
+        return self.backend.apply(specs.uploaded_pipeline(
+            name, spec, version=version, namespace=self.namespace))
+
+    def upload_pipeline_version(
+            self, pipeline: dsl.Pipeline | dict[str, Any], *,
+            name: str, version: str,
+            make_default: bool = True) -> dict[str, Any]:
+        spec = (dsl.compile_pipeline(pipeline)
+                if isinstance(pipeline, dsl.Pipeline) else pipeline)
+        cur = self.backend.get(specs.PIPELINE_KIND, name, self.namespace)
+        specs.add_pipeline_version(cur, version, spec,
+                                   make_default=make_default)
+        return self.backend.apply(cur)
+
+    def get_pipeline(self, name: str) -> dict[str, Any]:
+        return self.backend.get(specs.PIPELINE_KIND, name, self.namespace)
+
+    def list_pipelines(self) -> list[dict[str, Any]]:
+        return self.backend.list(specs.PIPELINE_KIND, self.namespace)
+
+    def create_run_from_pipeline_ref(
+            self, pipeline_name: str, *, run_name: str,
+            version: str | None = None,
+            parameters: dict[str, Any] | None = None,
+            experiment: str | None = None) -> dict[str, Any]:
+        return self.backend.apply(specs.pipeline_run(
+            run_name, None, parameters, namespace=self.namespace,
+            pipeline_ref=pipeline_name, version=version,
+            experiment=experiment))
+
+    # -- experiments (⊘ kfp.Client.create_experiment / list_runs) ------------
+
+    def create_experiment(self, name: str,
+                          description: str = "") -> dict[str, Any]:
+        return self.backend.apply(specs.pipeline_experiment(
+            name, description, namespace=self.namespace))
+
+    def list_experiments(self) -> list[dict[str, Any]]:
+        return self.backend.list(specs.PIPELINE_EXPERIMENT_KIND,
+                                 self.namespace)
 
     def create_recurring_run(self, pipeline: dsl.Pipeline, *, name: str,
                              cron: str | None = None,
@@ -201,8 +267,11 @@ class PipelineClient(_ClientBase):
     def get_run(self, run_name: str) -> dict[str, Any]:
         return self.backend.get(RUN_KIND, run_name, self.namespace)
 
-    def list_runs(self) -> list[dict[str, Any]]:
-        return self.backend.list(RUN_KIND, self.namespace)
+    def list_runs(self, experiment: str | None = None
+                  ) -> list[dict[str, Any]]:
+        labels = ({specs.PIPELINE_EXPERIMENT_LABEL: experiment}
+                  if experiment else None)
+        return self.backend.list(RUN_KIND, self.namespace, labels)
 
     def wait_for_run_completion(self, run_name: str,
                                 timeout: float = 600.0) -> dict[str, Any]:

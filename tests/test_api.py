@@ -179,6 +179,62 @@ class TestPlatformSDK:
         run = pc.wait_for_run_completion("sdk-run", timeout=60)
         assert has_condition(run["status"], JobConditionType.SUCCEEDED)
 
+    def test_uploaded_pipeline_versions_and_experiments(self, platform):
+        @dsl.component
+        def double(n: int) -> int:
+            return n * 2
+
+        @dsl.component
+        def triple(n: int) -> int:
+            return n * 3
+
+        @dsl.pipeline(name="v1p")
+        def v1p(n: int = 2):
+            return double(n=n)
+
+        @dsl.pipeline(name="v2p")
+        def v2p(n: int = 2):
+            return triple(n=n)
+
+        pc = PipelineClient(platform)
+        pc.upload_pipeline(v1p, name="calc")            # version v1
+        pc.upload_pipeline_version(v2p, name="calc", version="v2")
+        assert [v["name"] for v in
+                pc.get_pipeline("calc")["spec"]["versions"]] == ["v1", "v2"]
+        # duplicate version names and duplicate pipeline names are rejected
+        with pytest.raises(ValueError):
+            pc.upload_pipeline_version(v2p, name="calc", version="v2")
+        with pytest.raises(ValueError):
+            pc.upload_pipeline(v1p, name="calc")
+
+        pc.create_experiment("calc-exp", "version comparison")
+        # default = latest version (v2: triple); pinned = v1 (double)
+        pc.create_run_from_pipeline_ref("calc", run_name="run-v2",
+                                        parameters={"n": 4},
+                                        experiment="calc-exp")
+        pc.create_run_from_pipeline_ref("calc", run_name="run-v1",
+                                        version="v1", parameters={"n": 4},
+                                        experiment="calc-exp")
+        pc.create_run_from_pipeline_func(v1p, run_name="ungrouped")
+        r2 = pc.wait_for_run_completion("run-v2", timeout=60)
+        r1 = pc.wait_for_run_completion("run-v1", timeout=60)
+        assert has_condition(r1["status"], JobConditionType.SUCCEEDED)
+        assert has_condition(r2["status"], JobConditionType.SUCCEEDED)
+        ctrl = platform.pipelines
+        assert ctrl.task_output("run-v2", "triple") == 12
+        assert ctrl.task_output("run-v1", "double") == 8
+        # an unpinned ref is pinned to the then-default version at run
+        # start, so later default changes cannot swap the DAG mid-run
+        assert pc.get_run("run-v2")["spec"]["pipelineRef"] == {
+            "name": "calc", "version": "v2"}
+        # experiment grouping filters runs; ungrouped run stays outside
+        grouped = {r["metadata"]["name"]
+                   for r in pc.list_runs(experiment="calc-exp")}
+        assert grouped == {"run-v1", "run-v2"}
+        assert len(pc.list_runs()) == 3
+        assert [e["metadata"]["name"]
+                for e in pc.list_experiments()] == ["calc-exp"]
+
 
 # -- HTTP API server ----------------------------------------------------------
 

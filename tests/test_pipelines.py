@@ -294,6 +294,36 @@ class TestRunE2E:
                 if c["type"] == JobConditionType.FAILED][0]
         assert cond["reason"] == "PipelineNotFound"
 
+    def test_pipeline_ref_bad_shapes_fail_cleanly(self, pipe_cluster):
+        cluster, _ = pipe_cluster
+        # a list ref must fail admission-style, not wedge the reconciler
+        cluster.store.create(new_resource(kfp.RUN_KIND, "listref", spec={
+            "pipelineRef": ["ver-pl"]}))
+        run = wait_run(cluster, "listref")
+        assert has_condition(run["status"], JobConditionType.FAILED)
+        # a versionless Pipeline with an empty versions list fails the run
+        cluster.store.create(new_resource(kfp.PIPELINE_KIND, "empty-pl",
+                                          spec={"versions": []}))
+        cluster.store.create(new_resource(kfp.RUN_KIND, "emptyver", spec={
+            "pipelineRef": "empty-pl"}))
+        run = wait_run(cluster, "emptyver")
+        cond = [c for c in run["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert "no versions" in cond["message"]
+
+    def test_pipeline_ref_unknown_version_fails(self, pipe_cluster):
+        cluster, _ = pipe_cluster
+        cluster.store.create(new_resource(kfp.PIPELINE_KIND, "ver-pl", spec={
+            "versions": [{"name": "v1",
+                          "pipelineSpec": kfp.compile_pipeline(demo)}],
+            "defaultVersion": "v1"}))
+        cluster.store.create(new_resource(kfp.RUN_KIND, "badver", spec={
+            "pipelineRef": {"name": "ver-pl", "version": "v9"}}))
+        run = wait_run(cluster, "badver")
+        cond = [c for c in run["status"]["conditions"]
+                if c["type"] == JobConditionType.FAILED][0]
+        assert "v9" in cond["message"]
+
     def test_scheduled_run_interval(self, pipe_cluster):
         cluster, _ = pipe_cluster
 
