@@ -116,9 +116,10 @@ def serving_bench(on_tpu: bool) -> dict:
     # slots sized to the burst: with fewer slots than the burst width, the
     # second wave queues behind full 16-token decodes (~2.7x worse p50 TTFT)
     engine = LLMEngine(params, cfg, n_slots=8, max_len=256, buckets=(128,))
+    engine.warmup()   # compile the full program menu (all wave widths)
     prompt = list(range(1, 100))
     new_tokens = 16
-    engine.generate(prompt, new_tokens)  # warmup: compiles prefill + decode
+    engine.generate(prompt, new_tokens)  # exercise the live path once
 
     n_req = 8
     t0 = time.perf_counter()

@@ -141,6 +141,15 @@ class Platform:
         cur = self.store.try_get(obj["kind"], obj["metadata"]["name"], ns)
         if cur is None:
             return self.store.create(obj)
+        if obj["metadata"].get("resourceVersion") is not None:
+            # client did read-modify-write: honor optimistic concurrency
+            # (stale resourceVersion → ConflictError → HTTP 409), kube
+            # update semantics. Status stays the store's, not the client's.
+            cur["spec"] = obj.get("spec", {})
+            cur["metadata"]["labels"] = obj["metadata"].get("labels", {})
+            cur["metadata"]["resourceVersion"] = \
+                obj["metadata"]["resourceVersion"]
+            return self.store.update(cur)
         return self.store.mutate(
             obj["kind"], obj["metadata"]["name"],
             lambda o: (o.__setitem__("spec", obj.get("spec", {})),

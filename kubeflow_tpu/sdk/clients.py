@@ -219,12 +219,27 @@ class PipelineClient(_ClientBase):
             self, pipeline: dsl.Pipeline | dict[str, Any], *,
             name: str, version: str,
             make_default: bool = True) -> dict[str, Any]:
+        from kubeflow_tpu.api.server import ApiError
+        from kubeflow_tpu.control.store import ConflictError
+
         spec = (dsl.compile_pipeline(pipeline)
                 if isinstance(pipeline, dsl.Pipeline) else pipeline)
-        cur = self.backend.get(specs.PIPELINE_KIND, name, self.namespace)
-        specs.add_pipeline_version(cur, version, spec,
-                                   make_default=make_default)
-        return self.backend.apply(cur)
+        # read-modify-apply rides the store's optimistic concurrency (the
+        # fetched resourceVersion makes apply conditional): a concurrent
+        # version upload conflicts and we re-read instead of erasing it
+        for _ in range(10):
+            cur = self.backend.get(specs.PIPELINE_KIND, name, self.namespace)
+            specs.add_pipeline_version(cur, version, spec,
+                                       make_default=make_default)
+            try:
+                return self.backend.apply(cur)
+            except ConflictError:
+                continue
+            except ApiError as e:
+                if e.reason != "Conflict":
+                    raise
+        raise RuntimeError(
+            f"pipeline {name!r}: persistent version-upload conflict")
 
     def get_pipeline(self, name: str) -> dict[str, Any]:
         return self.backend.get(specs.PIPELINE_KIND, name, self.namespace)

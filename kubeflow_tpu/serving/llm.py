@@ -20,6 +20,7 @@ Prefill priority keeps TTFT low; decode always re-batches every step
 from __future__ import annotations
 
 import collections
+import functools
 import threading
 import time
 from typing import Any, Sequence
@@ -69,8 +70,8 @@ class LLMEngine:
         # (also taken under it) can never hand out a prefill whose request
         # dicts aren't populated yet.
         self._submit_lock = threading.Lock()
-        self._prefill_fns: dict[int, Any] = {}
-        self._decode_fn = jax.jit(self._decode, donate_argnums=(1, 2, 3))
+        self._prefill_fns: dict[tuple[int, int], Any] = {}
+        self._decode_fns: dict[int, Any] = {}
 
     # -- compiled programs ---------------------------------------------------
     # params are an explicit argument, never a closure: a closed-over pytree
@@ -81,32 +82,66 @@ class LLMEngine:
     # iteration (the new tokens), which is what keeps per-step latency at
     # dispatch cost instead of several tunnel round-trips.
 
-    def _prefill(self, params, cache, lengths, last_tokens, tokens, slot,
-                 prompt_len):
-        """tokens [1, bucket] right-padded; writes KV into `slot`."""
+    def _prefill(self, params, cache, lengths, last_tokens, wave):
+        """Batched prefill wave. `wave` is ONE packed int32 array
+        [W, bucket+2] — row i = prompt tokens (right-padded) ++ [slot,
+        prompt_len] — because on a tunneled device every host->device
+        transfer costs a full RTT: one packed transfer + one dispatch
+        covers a whole burst of arrivals. Padded wave rows duplicate a
+        real row (same slot, same data), so their writes are idempotent."""
+        tokens, slots, prompt_lens = (wave[:, :-2], wave[:, -2],
+                                      wave[:, -1])
         logits, ks, vs = llama.prefill(params, tokens, self.cfg)
         bucket = tokens.shape[1]
-        k = cache["k"].at[:, slot, :bucket].set(ks[:, 0])
-        v = cache["v"].at[:, slot, :bucket].set(vs[:, 0])
-        last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1,
-                                            keepdims=False)
-        tok = jnp.argmax(last, -1).astype(jnp.int32)
-        return ({"k": k, "v": v}, lengths.at[slot].set(prompt_len),
-                last_tokens.at[slot].set(tok), tok)
+        k, v = cache["k"], cache["v"]
+        toks = []
+        for i in range(tokens.shape[0]):   # W is static: unrolled updates
+            k = k.at[:, slots[i], :bucket].set(ks[:, i])
+            v = v.at[:, slots[i], :bucket].set(vs[:, i])
+            lengths = lengths.at[slots[i]].set(prompt_lens[i])
+            last = jax.lax.dynamic_index_in_dim(
+                logits[i], prompt_lens[i] - 1, keepdims=False)
+            tok = jnp.argmax(last, -1).astype(jnp.int32)
+            last_tokens = last_tokens.at[slots[i]].set(tok)
+            toks.append(tok)
+        return ({"k": k, "v": v}, lengths, last_tokens, jnp.stack(toks))
 
-    def _decode(self, params, cache, lengths, last_tokens, active):
-        logits, cache = llama.decode_step(params, last_tokens, cache,
-                                          lengths, self.cfg)
-        toks = jnp.argmax(logits, -1).astype(jnp.int32)
-        lengths = lengths + active.astype(jnp.int32)
-        last_tokens = jnp.where(active, toks, last_tokens)
-        return cache, lengths, last_tokens, toks
+    def _decode(self, params, cache, lengths, last_tokens, active, *,
+                steps: int):
+        """`steps` chained decode iterations inside ONE program (lax.scan):
+        a K-token chunk costs one dispatch round-trip instead of K. Slots
+        that finish (EOS) mid-chunk keep decoding on device; the host drops
+        their surplus tokens, and the slot's next prefill resets its
+        state."""
+        def body(carry, _):
+            cache, lengths, last_tokens = carry
+            logits, cache = llama.decode_step(params, last_tokens, cache,
+                                              lengths, self.cfg)
+            toks = jnp.argmax(logits, -1).astype(jnp.int32)
+            lengths = lengths + active.astype(jnp.int32)
+            last_tokens = jnp.where(active, toks, last_tokens)
+            return (cache, lengths, last_tokens), toks
 
-    def _prefill_fn(self, bucket: int):
-        if bucket not in self._prefill_fns:
-            self._prefill_fns[bucket] = jax.jit(
+        (cache, lengths, last_tokens), toks = jax.lax.scan(
+            body, (cache, lengths, last_tokens), None, length=steps)
+        return cache, lengths, last_tokens, toks   # toks [steps, n_slots]
+
+    def _prefill_fn(self, bucket: int, width: int):
+        """One compiled program per (bucket, wave-width) pair; widths are
+        powers of two so a burst of any size maps onto a tiny program menu."""
+        if (bucket, width) not in self._prefill_fns:
+            self._prefill_fns[bucket, width] = jax.jit(
                 self._prefill, donate_argnums=(1, 2, 3))
-        return self._prefill_fns[bucket]
+        return self._prefill_fns[bucket, width]
+
+    def _decode_fn(self, steps: int):
+        """One compiled program per chunk length (powers of two up to
+        decode_chunk, chosen by _do_decode)."""
+        if steps not in self._decode_fns:
+            self._decode_fns[steps] = jax.jit(
+                functools.partial(self._decode, steps=steps),
+                donate_argnums=(1, 2, 3))
+        return self._decode_fns[steps]
 
     # -- public API ----------------------------------------------------------
 
@@ -124,10 +159,10 @@ class LLMEngine:
         """One engine iteration: a prefill wave or a batched decode.
         False = idle.
 
-        All queued prefills dispatch back-to-back BEFORE any token fetch:
-        jax's async dispatch overlaps prefill k+1's compute with prefill
-        k's device->host round-trip, so a burst of n arrivals pays ~one
-        RTT instead of n (the same chaining trick as _do_decode)."""
+        All queued prefills drain into per-bucket BATCHED programs (one
+        dispatch per bucket group) and every wave dispatches before any
+        token fetch, so a burst of n arrivals pays ~one program dispatch +
+        one RTT instead of n of each."""
         with self._submit_lock:
             action = self.scheduler.next()
         if action is None:
@@ -143,15 +178,58 @@ class LLMEngine:
                 break   # Decode/None: dropping is safe — the decode pass
                         # re-derives from slot state on the next step()
             actions.append(nxt)
-        dispatched = [(a, self._dispatch_prefill(a)) for a in actions]
-        for a, tok in dispatched:
-            self._host_lengths[a.slot] = a.prompt_len
-            self._record_token(a.req_id, a.slot, int(tok), first_token=True)
+        # group by bucket; each group prefills as ONE batched program
+        groups: dict[int, list[PrefillAction]] = {}
+        for a in actions:
+            groups.setdefault(a.bucket_len, []).append(a)
+        dispatched = [(wave, self._dispatch_prefill_wave(bucket, wave))
+                      for bucket, wave in groups.items()]
+        for wave, toks in dispatched:
+            toks_np = np.asarray(toks)   # one fetch per wave
+            for i, a in enumerate(wave):
+                self._host_lengths[a.slot] = a.prompt_len
+                self._record_token(a.req_id, a.slot, int(toks_np[i]),
+                                   first_token=True)
         return True
 
     def run_until_idle(self) -> None:
         while self.step():
             pass
+
+    def warmup(self) -> None:
+        """Execute every program in the menu once (each bucket × each
+        power-of-two wave width, plus decode) so no request ever pays XLA
+        compile time. Must run before serving traffic: a cold width means
+        a whole burst waits ~seconds on the compiler. Slot state is junk
+        during warmup and reset after; call only while idle."""
+        for bucket in self.buckets:
+            width = 1
+            while True:   # every power of two through next-pow2(n_slots):
+                # a wave of n_slots actions pads UP to that width, so for
+                # e.g. n_slots=6 width 8 must be warm too
+                packed = np.zeros((width, bucket + 2), np.int32)
+                packed[:, :2] = 1   # token + prompt_len floor
+                packed[:, -2] = np.arange(width) % self.n_slots
+                packed[:, -1] = 1
+                self.cache, self.lengths, self.last_tokens, _ = \
+                    self._prefill_fn(bucket, width)(
+                        self.params, self.cache, self.lengths,
+                        self.last_tokens, jnp.asarray(packed))
+                if width >= self.n_slots:
+                    break
+                width *= 2
+        k = 1
+        toks = None
+        while k <= self.decode_chunk:
+            self.cache, self.lengths, self.last_tokens, toks = \
+                self._decode_fn(k)(self.params, self.cache, self.lengths,
+                                   self.last_tokens,
+                                   jnp.zeros((self.n_slots,), bool))
+            k *= 2
+        float(toks[0, 0])   # sync: compile + execute finished (axon-safe)
+        self.lengths = jnp.zeros_like(self.lengths)
+        self.last_tokens = jnp.zeros_like(self.last_tokens)
+        self._host_lengths[:] = 0
 
     def is_done(self, req_id: int) -> bool:
         return req_id in self._done
@@ -195,58 +273,71 @@ class LLMEngine:
 
     # -- internals -----------------------------------------------------------
 
-    def _dispatch_prefill(self, a: PrefillAction):
-        """Dispatch one prefill; returns the (device) next-token array
-        WITHOUT fetching, so callers can pipeline several prefills."""
-        prompt = self._prompts[a.req_id]
-        tokens = np.zeros((1, a.bucket_len), np.int32)
-        tokens[0, :len(prompt)] = prompt
-        self.cache, self.lengths, self.last_tokens, next_tok = \
-            self._prefill_fn(a.bucket_len)(
+    def _dispatch_prefill_wave(self, bucket: int,
+                               wave: list[PrefillAction]):
+        """Dispatch one batched prefill over `wave`; returns the (device)
+        next-token array [W] WITHOUT fetching, so several waves can
+        pipeline. The wave is padded up to a power-of-two width by
+        repeating its last action (idempotent duplicate writes), keeping
+        the compiled-program menu small."""
+        width = 1
+        while width < len(wave):
+            width *= 2
+        padded = wave + [wave[-1]] * (width - len(wave))
+        # one packed transfer: [tokens ++ slot ++ prompt_len] per row (a
+        # tunneled device pays ~an RTT per transfer; 3 arrays would be 3)
+        packed = np.zeros((width, bucket + 2), np.int32)
+        for i, a in enumerate(padded):
+            prompt = self._prompts[a.req_id]
+            packed[i, :len(prompt)] = prompt
+            packed[i, -2] = a.slot
+            packed[i, -1] = a.prompt_len
+        self.cache, self.lengths, self.last_tokens, next_toks = \
+            self._prefill_fn(bucket, width)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                jnp.asarray(tokens), a.slot, a.prompt_len)
-        return next_tok
+                jnp.asarray(packed))
+        return next_toks
 
     def _do_decode(self) -> None:
-        """Chained decode: dispatch K steps back-to-back WITHOUT fetching
-        between them (device state is self-contained), then drain the K
-        token arrays. JAX's async dispatch overlaps the host<->device
-        round-trip with device compute — on a tunneled/remote device this
-        is the difference between RTT-bound and compute-bound decode.
+        """Scan-fused decode: K steps execute inside ONE compiled program
+        (one dispatch + one token fetch for the whole chunk). On a
+        tunneled/remote device the per-call round-trip (~100ms-class)
+        dwarfs the per-token compute, so K-in-one-program is the
+        difference between RTT-per-token and RTT-per-chunk.
 
-        K = min remaining tokens across active slots (no overrun), capped
-        by cache headroom and a scheduling-latency bound: new arrivals wait
-        at most K steps for their prefill."""
+        K = largest power of two <= decode_chunk that fits cache headroom
+        (chunk writes KV rows L..L+K-1 for the fullest slot, which must
+        stay < max_len). Slots may finish (EOS / max_new) mid-chunk: their
+        surplus tokens are dropped host-side, and new arrivals wait at
+        most one chunk for their prefill — decode_chunk bounds scheduling
+        latency."""
         slot_req = [self.scheduler.slot_request(s) for s in range(self.n_slots)]
         active = np.array([r >= 0 for r in slot_req], bool)
-        remaining = [self._max_new[r] - len(self._results[r])
-                     for r in slot_req if r >= 0]
-        # k chained steps write KV rows L..L+k-1 for the fullest slot, so
-        # k <= max_len - L keeps every write in bounds
+        remaining = max(self._max_new[r] - len(self._results[r])
+                        for r in slot_req if r >= 0)
         headroom = self.max_len - int(
             max(self._host_lengths[s] for s in range(self.n_slots)
                 if active[s]))
-        k = max(1, min(min(remaining), headroom, self.decode_chunk))
-        active_dev = jnp.asarray(active)
+        k = 1
+        while (k * 2 <= self.decode_chunk and k * 2 <= headroom
+               and k < remaining):
+            k *= 2
 
-        tok_batches = []
-        for _ in range(k):
-            self.cache, self.lengths, self.last_tokens, toks = \
-                self._decode_fn(self.params, self.cache, self.lengths,
-                                self.last_tokens, active_dev)
-            tok_batches.append(toks)
+        self.cache, self.lengths, self.last_tokens, toks = \
+            self._decode_fn(k)(self.params, self.cache, self.lengths,
+                               self.last_tokens, jnp.asarray(active))
+        toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk
         done_slots: set[int] = set()
-        for toks in tok_batches:
-            toks_np = np.asarray(toks)  # first fetch blocks; rest are ready
+        for row in toks_np:
             for slot, req in enumerate(slot_req):
                 if req < 0 or slot in done_slots:
                     continue
                 self._host_lengths[slot] += 1
-                if self._record_token(req, slot, int(toks_np[slot])):
-                    # finished mid-chain: later chained tokens are garbage
-                    # for this slot; drop them (its cache is reset by the
-                    # next prefill into the slot). The local return value —
-                    # not the shared _done set — decides, so a concurrent
+                if self._record_token(req, slot, int(row[slot])):
+                    # finished mid-chunk: later tokens are garbage for this
+                    # slot; drop them (its cache is reset by the next
+                    # prefill into the slot). The local return value — not
+                    # the shared _done set — decides, so a concurrent
                     # release() from a server thread can't unfinish it.
                     done_slots.add(slot)
 

@@ -69,6 +69,9 @@ class LLMModel(Model):
         self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
                                  max_len=self._max_len,
                                  buckets=self._buckets, eos_id=self._eos_id)
+        # compile the whole program menu at load (the Knative cold-start
+        # analog): no live request ever waits on XLA
+        self._engine.warmup()
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"llm-engine-{self.name}")

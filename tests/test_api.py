@@ -235,6 +235,31 @@ class TestPlatformSDK:
         assert [e["metadata"]["name"]
                 for e in pc.list_experiments()] == ["calc-exp"]
 
+    def test_version_upload_is_conflict_safe(self, platform):
+        from kubeflow_tpu.control.store import ConflictError
+
+        @dsl.component
+        def one() -> int:
+            return 1
+
+        @dsl.pipeline(name="c")
+        def c():
+            return one()
+
+        pc = PipelineClient(platform)
+        pc.upload_pipeline(c, name="c2")
+        stale = platform.get("Pipeline", "c2")   # snapshot before v2
+        pc.upload_pipeline_version(c, name="c2", version="v2")
+        # a stale read-modify-apply must conflict, not erase v2
+        specs.add_pipeline_version(stale, "v3", dsl.compile_pipeline(c))
+        with pytest.raises(ConflictError):
+            platform.apply(stale)
+        # the SDK path re-reads on conflict, so all versions survive
+        pc.upload_pipeline_version(c, name="c2", version="v3")
+        assert [v["name"] for v in
+                pc.get_pipeline("c2")["spec"]["versions"]] == \
+            ["v1", "v2", "v3"]
+
 
 # -- HTTP API server ----------------------------------------------------------
 
