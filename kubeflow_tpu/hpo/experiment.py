@@ -74,6 +74,11 @@ def validate_experiment(exp: dict[str, Any],
         SearchSpace.parse(_nas.effective_parameters(spec))
     except SpaceError as e:
         errs.append(f"parameters: {e}")
+    mc = spec.get("metricsCollector")
+    if mc is not None and mc.get("kind", "File") not in (
+            "File", "StdOut", "TensorFlowEvent"):
+        errs.append(f"metricsCollector.kind invalid: {mc.get('kind')!r} "
+                    "(File | StdOut | TensorFlowEvent)")
     tt = spec.get("trialTemplate", {})
     if "spec" not in tt:
         errs.append("trialTemplate.spec is required")
@@ -300,6 +305,9 @@ class ExperimentController(Controller):
             "template": tt["spec"],
             "templateKind": tt.get("kind", JOB_KIND),
             "earlyStopping": spec.get("earlyStopping"),
+            # ⊘ katib Experiment.spec.metricsCollectorSpec: collector kind +
+            # source, propagated to every trial
+            "metricsCollector": spec.get("metricsCollector"),
         }
 
     def _ensure_trial(self, exp: dict[str, Any], idx: int,

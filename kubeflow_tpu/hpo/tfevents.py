@@ -1,0 +1,242 @@
+"""TensorFlow event-file scalar codec + tailing collector — Katib's
+TensorFlowEvent metrics collector (SURVEY.md §2.3, ⊘ katib
+pkg/metricscollector/v1beta1/tfevent-metricscollector).
+
+The reference's collector reads trial tfevents logdirs with the TF event
+reader and reports scalars to the db-manager. Importing tensorflow costs
+tens of seconds and hundreds of MB on this 1-core box, so this module
+parses the format directly — it is small and stable:
+
+  TFRecord framing: u64 length, u32 masked-crc32c(length), payload,
+                    u32 masked-crc32c(payload)
+  Payload: an `Event` proto — step=2 (varint), summary=5 (message) with
+           repeated Value{tag=1 (string), simple_value=2 (float),
+           tensor=8 (TF2 scalars: float_val=5 / tensor_content=4)}
+
+Both the TF1-style `simple_value` and TF2-style scalar-tensor encodings
+are handled; a writer (valid masked CRCs, simple_value encoding) is
+included so trainers can emit tfevents without tensorflow installed.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterator, Sequence
+
+from kubeflow_tpu.hpo.observations import ObservationDB
+
+# -- crc32c (Castagnoli), TFRecord masking ------------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire helpers ----------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes) -> Iterator[tuple[int, int, bytes | int]]:
+    """Yields (field_number, wire_type, value) over a serialized message.
+    Length-delimited values are bytes; varints ints; fixed32/64 raw ints."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _scalar_from_tensor(buf: bytes) -> float | None:
+    """TF2 writes scalars as TensorProto: float_val=5 (packed or single)
+    or raw tensor_content=4 little-endian float32."""
+    for field, wire, val in _iter_fields(buf):
+        if field == 5 and wire == 5:
+            return struct.unpack("<f", struct.pack("<I", val))[0]
+        if field == 5 and wire == 2 and len(val) >= 4:
+            return struct.unpack_from("<f", val, 0)[0]
+        if field == 4 and wire == 2 and len(val) >= 4:
+            return struct.unpack_from("<f", val, 0)[0]
+    return None
+
+
+# -- event file read/write ----------------------------------------------------
+
+
+def read_events(path: str) -> Iterator[tuple[int, str, float]]:
+    """Yields (step, tag, scalar_value) from one tfevents file. Truncated
+    trailing records (a live writer mid-append) stop iteration cleanly."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + 12 <= len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        end = pos + 12 + length + 4
+        if end > len(data):
+            return   # partial tail: next poll re-reads from a clean offset
+        payload = data[pos + 12:pos + 12 + length]
+        pos = end
+        step = 0
+        values: list[tuple[str, float]] = []
+        for field, wire, val in _iter_fields(payload):
+            if field == 2 and wire == 0:
+                step = val
+            elif field == 5 and wire == 2:   # summary
+                for f2, w2, v2 in _iter_fields(val):
+                    if f2 != 1 or w2 != 2:
+                        continue
+                    tag, scalar = None, None
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            tag = v3.decode("utf-8", "replace")
+                        elif f3 == 2 and w3 == 5:   # simple_value
+                            scalar = struct.unpack(
+                                "<f", struct.pack("<I", v3))[0]
+                        elif f3 == 8 and w3 == 2:   # tensor (TF2 scalar)
+                            scalar = _scalar_from_tensor(v3)
+                    if tag is not None and scalar is not None:
+                        values.append((tag, scalar))
+        for tag, scalar in values:
+            yield step, tag, scalar
+
+
+def event_files(logdir: str) -> list[str]:
+    """tfevents files under a logdir (or the file itself), sorted for
+    deterministic multi-file replay."""
+    if os.path.isfile(logdir):
+        return [logdir]
+    out = []
+    for root, _, files in os.walk(logdir):
+        for fn in files:
+            if "tfevents" in fn:
+                out.append(os.path.join(root, fn))
+    return sorted(out)
+
+
+class EventWriter:
+    """Minimal tfevents scalar writer (valid TFRecord masked CRCs +
+    simple_value summaries) — lets trainers emit TensorBoard-readable
+    logs without importing tensorflow."""
+
+    def __init__(self, logdir: str, filename: str | None = None):
+        os.makedirs(logdir, exist_ok=True)
+        self.path = os.path.join(
+            logdir, filename or "events.out.tfevents.kubeflow-tpu")
+        self._fh = open(self.path, "ab")
+
+    def write_scalar(self, step: int, tag: str, value: float) -> None:
+        tag_b = tag.encode()
+        value_msg = (bytes([0x0A]) + _varint(len(tag_b)) + tag_b
+                     + bytes([0x15]) + struct.pack("<f", float(value)))
+        summary = bytes([0x0A]) + _varint(len(value_msg)) + value_msg
+        event = (bytes([0x10]) + _varint(step)
+                 + bytes([0x2A]) + _varint(len(summary)) + summary)
+        header = struct.pack("<Q", len(event))
+        self._fh.write(header + struct.pack("<I", _masked_crc(header))
+                       + event + struct.pack("<I", _masked_crc(event)))
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+# -- tailing collector --------------------------------------------------------
+
+
+class TfEventsTail:
+    """Follows a tfevents logdir, reporting new scalar records into the
+    observation DB — the FileTail twin for TensorFlowEvent collectors.
+    Replays whole files on growth (tfevents are append-only and trial-
+    sized), deduplicating by (file, record-count) watermark."""
+
+    def __init__(self, db: ObservationDB, trial: str, logdir: str,
+                 metric_names: Sequence[str], poll: float = 0.2):
+        self.db = db
+        self.trial = trial
+        self.logdir = logdir
+        self.wanted = set(metric_names)
+        self.poll = poll
+        self._seen: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"tfevents-collector-{self.trial}")
+        self._thread.start()
+
+    def stop(self, final_pass: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if final_pass:
+            self._drain()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            self._drain()
+
+    def _drain(self) -> None:
+        for path in event_files(self.logdir):
+            seen = self._seen.get(path, 0)
+            try:
+                records = list(read_events(path))
+            except (OSError, ValueError, IndexError, struct.error):
+                # malformed/foreign file in the logdir: skip it, keep the
+                # collector thread alive for the well-formed ones
+                continue
+            for step, tag, value in records[seen:]:
+                if tag in self.wanted:
+                    self.db.report(self.trial, tag, value, step)
+            self._seen[path] = len(records)

@@ -161,10 +161,14 @@ class TrialController(Controller):
             "substitutions", trial["spec"].get("parameterAssignments", {}))
         spec = substitute(copy.deepcopy(trial["spec"]["template"]), assignments)
         # inject trial identity + metrics stream target into every replica
+        mc = trial["spec"].get("metricsCollector") or {}
         for rspec in spec.get("replicaSpecs", {}).values():
             env = rspec.setdefault("template", {}).setdefault("env", {})
             env.setdefault("KTPU_TRIAL_NAME", name)
             env.setdefault("KTPU_METRICS_FILE", self._metrics_path(trial))
+            if mc.get("kind") == "TensorFlowEvent":
+                env.setdefault("KTPU_TFEVENTS_DIR",
+                               self._tfevents_dir(trial, mc))
         job = new_resource(
             self._job_kind(trial), name, spec=spec, namespace=ns,
             labels={EXPERIMENT_LABEL:
@@ -183,11 +187,34 @@ class TrialController(Controller):
         with self._clock:
             if uid in self._collectors:
                 return
-            tail = FileTail(self.db, trial["metadata"]["name"],
-                            self._metrics_path(trial),
-                            self._metric_names(trial))
+            mc = trial["spec"].get("metricsCollector") or {}
+            if mc.get("kind") == "TensorFlowEvent":
+                # ⊘ katib tfevent-metricscollector: follow the trial's
+                # tensorboard logdir instead of the JSONL stream
+                from kubeflow_tpu.hpo.tfevents import TfEventsTail
+
+                tail = TfEventsTail(
+                    self.db, trial["metadata"]["name"],
+                    self._tfevents_dir(trial, mc),
+                    self._metric_names(trial))
+            else:
+                tail = FileTail(self.db, trial["metadata"]["name"],
+                                self._metrics_path(trial),
+                                self._metric_names(trial))
             self._collectors[uid] = tail
         tail.start()
+
+    def _tfevents_dir(self, trial: dict[str, Any],
+                      mc: dict[str, Any]) -> str:
+        """Source logdir for a TensorFlowEvent collector. A configured
+        fileSystemPath is namespaced per trial (in Katib the path is each
+        pod's own container FS; here all trials share the host FS, so a
+        shared dir would cross-contaminate sibling trials' series)."""
+        uid = trial["metadata"]["uid"]
+        path = (mc.get("source", {}).get("fileSystemPath", {}).get("path"))
+        if path:
+            return os.path.join(path, uid)
+        return os.path.join(self.metrics_dir, f"{uid}-tfevents")
 
     def _stop_collector(self, trial: dict[str, Any], final: bool) -> None:
         with self._clock:

@@ -1,0 +1,151 @@
+"""TensorFlowEvent metrics collector tests (SURVEY.md §2.3: Katib's
+tfevent-metricscollector): the dependency-free tfevents codec round-trips,
+cross-validates against a real TensorBoard writer (torch's), and an
+experiment configured with `metricsCollector: TensorFlowEvent` collects
+objectives from trial logdirs end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kubeflow_tpu import hpo
+from kubeflow_tpu.control import Cluster, JAXJobController, new_resource
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.control.executor import worker_target
+from kubeflow_tpu.hpo import tfevents
+from kubeflow_tpu.hpo.observations import ObservationDB
+
+
+class TestCodec:
+    def test_roundtrip(self, tmp_path):
+        w = tfevents.EventWriter(str(tmp_path))
+        w.write_scalar(0, "loss", 1.5)
+        w.write_scalar(1, "loss", 0.75)
+        w.write_scalar(1, "accuracy", 0.5)
+        w.close()
+        recs = list(tfevents.read_events(w.path))
+        assert recs == [(0, "loss", 1.5), (1, "loss", 0.75),
+                        (1, "accuracy", 0.5)]
+
+    def test_truncated_tail_is_ignored(self, tmp_path):
+        w = tfevents.EventWriter(str(tmp_path))
+        w.write_scalar(0, "loss", 2.0)
+        w.close()
+        with open(w.path, "ab") as f:
+            f.write(b"\x07\x00\x00")   # half a header: writer mid-append
+        assert list(tfevents.read_events(w.path)) == [(0, "loss", 2.0)]
+
+    def test_reads_real_tensorboard_writer(self, tmp_path):
+        torch_tb = pytest.importorskip("torch.utils.tensorboard")
+        writer = torch_tb.SummaryWriter(log_dir=str(tmp_path))
+        writer.add_scalar("loss", 0.25, global_step=3)
+        writer.add_scalar("val/acc", 0.9, global_step=4)
+        writer.close()
+        scalars = {}
+        for path in tfevents.event_files(str(tmp_path)):
+            for step, tag, value in tfevents.read_events(path):
+                scalars[tag] = (step, round(value, 6))
+        assert scalars["loss"] == (3, 0.25)
+        assert scalars["val/acc"] == (4, 0.9)
+
+    def test_long_tag_roundtrip(self, tmp_path):
+        w = tfevents.EventWriter(str(tmp_path))
+        tag = "metrics/" + "x" * 300   # length prefixes need real varints
+        w.write_scalar(7, tag, 1.25)
+        w.close()
+        assert list(tfevents.read_events(w.path)) == [(7, tag, 1.25)]
+
+    def test_event_files_walks_subdirs(self, tmp_path):
+        sub = tmp_path / "run1"
+        w = tfevents.EventWriter(str(sub))
+        w.write_scalar(0, "x", 1.0)
+        w.close()
+        assert tfevents.event_files(str(tmp_path)) == [w.path]
+
+
+class TestTail:
+    def test_tail_reports_incrementally(self, tmp_path):
+        db = ObservationDB()
+        w = tfevents.EventWriter(str(tmp_path))
+        tail = tfevents.TfEventsTail(db, "t1", str(tmp_path), ["loss"],
+                                     poll=0.01)
+        w.write_scalar(0, "loss", 3.0)
+        w.write_scalar(0, "ignored", 9.0)
+        tail._drain()
+        w.write_scalar(1, "loss", 2.0)
+        tail.stop()   # final pass picks up the second record exactly once
+        series = db.get("t1", "loss")
+        assert [(o.step, o.value) for o in series] == [(0, 3.0), (1, 2.0)]
+        assert db.get("t1", "ignored") == []
+
+    def test_tail_survives_malformed_file(self, tmp_path):
+        db = ObservationDB()
+        bad = tmp_path / "corrupt.tfevents.x"
+        # valid framing, malformed proto payload (overrunning length field)
+        payload = b"\x2a\x7f"
+        import struct as _s
+        bad.write_bytes(_s.pack("<Q", len(payload)) + b"\x00" * 4
+                        + payload + b"\x00" * 4)
+        w = tfevents.EventWriter(str(tmp_path))
+        w.write_scalar(0, "loss", 1.0)
+        w.close()
+        tail = tfevents.TfEventsTail(db, "t2", str(tmp_path), ["loss"])
+        tail._drain()   # must not raise; good file still collected
+        assert [(o.step, o.value) for o in db.get("t2", "loss")] == [(0, 1.0)]
+
+
+@worker_target("tfevents_quad")
+def _tfevents_quad(env, cancel):
+    """Trial workload writing its objective as tfevents scalars (the
+    TF-user path: no JSONL stream, only a tensorboard logdir)."""
+    x, y = float(env["X"]), float(env["Y"])
+    w = tfevents.EventWriter(env["KTPU_TFEVENTS_DIR"])
+    for step in range(3):
+        w.write_scalar(step, "loss",
+                       (x - 0.3) ** 2 + (y + 0.2) ** 2 + 1.0 / (step + 1))
+    w.write_scalar(3, "loss", (x - 0.3) ** 2 + (y + 0.2) ** 2)
+    w.close()
+
+
+def test_tfevent_collector_experiment_e2e(tmp_path):
+    cluster = Cluster(n_devices=8)
+    cluster.add(JAXJobController)
+    db = hpo.add_hpo_controllers(cluster, metrics_dir=str(tmp_path))
+    exp = new_resource("Experiment", "tfev-e2e", spec={
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "random"},
+        "metricsCollector": {"kind": "TensorFlowEvent"},
+        "parameters": [
+            {"name": "x", "parameterType": "double",
+             "feasibleSpace": {"min": -1.0, "max": 1.0}},
+            {"name": "y", "parameterType": "double",
+             "feasibleSpace": {"min": -1.0, "max": 1.0}},
+        ],
+        "parallelTrialCount": 2,
+        "maxTrialCount": 4,
+        "maxFailedTrialCount": 2,
+        "trialTemplate": {"spec": {
+            "replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"backend": "thread", "target": "tfevents_quad",
+                             "env": {"X": "${trialParameters.x}",
+                                     "Y": "${trialParameters.y}"},
+                             "resources": {"cpu": 1}},
+            }}}},
+    })
+    with cluster:
+        cluster.store.create(exp)
+        done = cluster.wait_for(
+            "Experiment", "tfev-e2e",
+            lambda o: is_finished(o["status"]), timeout=60)
+        assert has_condition(done["status"], JobConditionType.SUCCEEDED), \
+            done["status"]
+        opt = done["status"]["currentOptimalTrial"]
+        p = opt["parameterAssignments"]
+        assert opt["objectiveValue"] == pytest.approx(
+            (p["x"] - 0.3) ** 2 + (p["y"] + 0.2) ** 2, rel=1e-5)
+    hpo.set_default_db(None)
