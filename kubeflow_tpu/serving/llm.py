@@ -40,7 +40,8 @@ class LLMEngine:
     def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
                  max_queue: int = 1024, eos_id: int | None = None,
-                 prefer_native: bool = True, decode_chunk: int = 8):
+                 prefer_native: bool = True, decode_chunk: int = 8,
+                 mesh=None):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         self.params = params
@@ -54,6 +55,9 @@ class LLMEngine:
         self.cache = llama.init_cache(cfg, n_slots, max_len)
         self.lengths = jnp.zeros((n_slots,), jnp.int32)
         self.last_tokens = jnp.zeros((n_slots,), jnp.int32)
+        self.mesh = None
+        if mesh is not None:
+            self._shard_over(mesh)
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
         self._max_new: dict[int, int] = {}
@@ -72,6 +76,49 @@ class LLMEngine:
         self._submit_lock = threading.Lock()
         self._prefill_fns: dict[tuple[int, int], Any] = {}
         self._decode_fns: dict[int, Any] = {}
+
+    def _shard_over(self, mesh) -> None:
+        """Tensor-parallel serving (BASELINE #5 at 8B scale: one engine
+        spanning a slice). Params shard by the model's logical axes
+        (heads/mlp/vocab over `tensor`), the KV cache by kv-heads; GSPMD
+        propagates the layout through the compiled prefill/decode programs
+        and inserts the ICI collectives — the serving twin of the
+        trainer's sharding path (training/trainer.py)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_tpu.parallel import MeshConfig
+        from kubeflow_tpu.parallel.mesh import make_mesh
+        from kubeflow_tpu.parallel.sharding import (shard_tree,
+                                                    tree_logical_to_sharding)
+
+        if isinstance(mesh, MeshConfig):
+            mesh = make_mesh(mesh)
+        tp = mesh.shape.get("tensor", 1)
+        if self.cfg.n_kv_heads % max(tp, 1):
+            raise ValueError(
+                f"n_kv_heads={self.cfg.n_kv_heads} must divide by the "
+                f"tensor axis ({tp}) to shard the KV cache")
+        self.mesh = mesh
+        self.params = shard_tree(
+            self.params,
+            tree_logical_to_sharding(llama.logical_axes(self.cfg), mesh))
+        # no trailing None: GSPMD emits the trimmed spec on program outputs
+        # and the jit cache compares specs structurally — a 5-element spec
+        # here would retrace every program on its first post-warmup call
+        cache_sh = NamedSharding(mesh, P(None, None, None, "tensor"))
+        self.cache = jax.tree.map(
+            lambda x: jax.device_put(x, cache_sh), self.cache)
+        repl = NamedSharding(mesh, P())
+        self._repl = repl
+        self.lengths = jax.device_put(self.lengths, repl)
+        self.last_tokens = jax.device_put(self.last_tokens, repl)
+
+    def _put(self, x):
+        """Host array → device; replicated across the mesh when sharded
+        (uncommitted single-device inputs would fight GSPMD's layouts)."""
+        if self.mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._repl)
 
     # -- compiled programs ---------------------------------------------------
     # params are an explicit argument, never a closure: a closed-over pytree
@@ -214,7 +261,7 @@ class LLMEngine:
                 self.cache, self.lengths, self.last_tokens, _ = \
                     self._prefill_fn(bucket, width)(
                         self.params, self.cache, self.lengths,
-                        self.last_tokens, jnp.asarray(packed))
+                        self.last_tokens, self._put(packed))
                 if width >= self.n_slots:
                     break
                 width *= 2
@@ -224,11 +271,15 @@ class LLMEngine:
             self.cache, self.lengths, self.last_tokens, toks = \
                 self._decode_fn(k)(self.params, self.cache, self.lengths,
                                    self.last_tokens,
-                                   jnp.zeros((self.n_slots,), bool))
+                                   self._put(np.zeros((self.n_slots,),
+                                                      bool)))
             k *= 2
         float(toks[0, 0])   # sync: compile + execute finished (axon-safe)
-        self.lengths = jnp.zeros_like(self.lengths)
-        self.last_tokens = jnp.zeros_like(self.last_tokens)
+        # reset via _put, not zeros_like: under a mesh the reset arrays must
+        # carry the same committed replicated sharding the programs were
+        # traced with, or the first live request retraces (= recompiles)
+        self.lengths = self._put(np.zeros((self.n_slots,), np.int32))
+        self.last_tokens = self._put(np.zeros((self.n_slots,), np.int32))
         self._host_lengths[:] = 0
 
     def is_done(self, req_id: int) -> bool:
@@ -295,7 +346,7 @@ class LLMEngine:
         self.cache, self.lengths, self.last_tokens, next_toks = \
             self._prefill_fn(bucket, width)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                jnp.asarray(packed))
+                self._put(packed))
         return next_toks
 
     def _do_decode(self) -> None:
@@ -325,7 +376,7 @@ class LLMEngine:
 
         self.cache, self.lengths, self.last_tokens, toks = \
             self._decode_fn(k)(self.params, self.cache, self.lengths,
-                               self.last_tokens, jnp.asarray(active))
+                               self.last_tokens, self._put(active))
         toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk
         done_slots: set[int] = set()
         for row in toks_np:

@@ -38,9 +38,11 @@ class LLMModel(Model):
                  model: dict[str, Any] | None = None, n_slots: int = 4,
                  max_len: int = 512, buckets=(64, 128, 256),
                  eos_id: int | None = None, checkpoint: str | None = None,
-                 seed: int = 0, timeout_s: float = 300.0, **_ignored: Any):
+                 seed: int = 0, timeout_s: float = 300.0,
+                 mesh: dict[str, int] | None = None, **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
+        self._mesh = dict(mesh) if mesh else None
         self._n_slots = n_slots
         self._max_len = max_len
         self._buckets = tuple(buckets)
@@ -66,9 +68,16 @@ class LLMModel(Model):
 
         cfg = llama.LlamaConfig(**self._cfg_overrides)
         params = self._load_params(cfg)
+        mesh = None
+        if self._mesh:
+            # tensor-parallel predictor: config.mesh {tensor: N, ...}
+            from kubeflow_tpu.parallel import MeshConfig
+
+            mesh = MeshConfig(**self._mesh)
         self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
                                  max_len=self._max_len,
-                                 buckets=self._buckets, eos_id=self._eos_id)
+                                 buckets=self._buckets, eos_id=self._eos_id,
+                                 mesh=mesh)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()

@@ -208,3 +208,62 @@ def test_release_drops_request_state(tiny):
         assert rid not in d
     m = engine.metrics()  # ttft survives release via the sliding window
     assert m["ttft_p50_s"] >= 0.0 and m["completed"] == 1
+
+
+def test_sharded_engine_matches_unsharded(tiny):
+    """Tensor-parallel serving (mesh tensor=2) produces exactly the greedy
+    tokens of the single-device engine — GSPMD shards params/KV-cache, the
+    dataplane semantics must not change."""
+    from kubeflow_tpu.parallel import MeshConfig
+
+    params, cfg = tiny
+    plain = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16))
+    sharded = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16),
+                        mesh=MeshConfig(tensor=2))
+    assert sharded.mesh is not None
+    # params really are sharded over the tensor axis
+    wq = sharded.params["layers"]["wq"]
+    assert "tensor" in str(wq.sharding.spec), wq.sharding
+    prompt = [1, 5, 9, 2]
+    for n in (3, 6):
+        assert sharded.generate(prompt, n) == plain.generate(prompt, n)
+    # burst path (batched prefill wave) under the mesh
+    rids = [sharded.submit(prompt, 4) for _ in range(3)]
+    sharded.run_until_idle()
+    outs = {sharded.result(r) == plain.generate(prompt, 4) for r in rids}
+    assert outs == {True}
+
+
+def test_sharded_engine_rejects_bad_kv_split(tiny):
+    from kubeflow_tpu.parallel import MeshConfig
+
+    params, cfg = tiny   # n_kv_heads=2
+    with pytest.raises(ValueError):
+        LLMEngine(params, cfg, n_slots=1, max_len=32, buckets=(8,),
+                  mesh=MeshConfig(tensor=4))
+
+
+def test_warmup_covers_live_traffic_no_retrace(tiny):
+    """After warmup, live traffic (single + burst, sharded or not) must hit
+    only already-traced programs — a retrace means a live request would pay
+    XLA compile time (jit trace-cache sizes are the observable)."""
+    from kubeflow_tpu.parallel import MeshConfig
+
+    params, cfg = tiny
+    for mesh in (None, MeshConfig(tensor=2)):
+        engine = LLMEngine(params, cfg, n_slots=3, max_len=32,
+                           buckets=(8, 16), mesh=mesh)
+        engine.warmup()
+        sizes = {k: f._cache_size()
+                 for k, f in {**engine._prefill_fns,
+                              **engine._decode_fns}.items()}
+        engine.generate([1, 2, 3], 4)
+        rids = [engine.submit([1, 2, 3, 4, 5], 4) for _ in range(3)]
+        engine.run_until_idle()
+        assert all(engine.is_done(r) for r in rids)
+        after = {k: engine._prefill_fns.get(k, engine._decode_fns.get(k))
+                 ._cache_size() for k in sizes}
+        assert after == sizes, f"retrace under mesh={mesh}"
+        assert not (set({**engine._prefill_fns,
+                         **engine._decode_fns}) - set(sizes)), \
+            "live traffic created a program warmup never compiled"
