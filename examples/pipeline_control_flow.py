@@ -33,6 +33,11 @@ def celebrate(s: int) -> str:
 
 
 @dsl.component
+def shrug(s: int) -> str:
+    return f"mid score {s}"
+
+
+@dsl.component
 def cleanup() -> str:
     return "resources released"
 
@@ -44,6 +49,10 @@ def demo(n: int = 6, k: int = 3):
         s = score(n=n)
         with dsl.If(s.output, ">", 30):
             celebrate(s=s.output)
+        with dsl.Elif(s.output, ">", 10):
+            shrug(s=s.output)
+        with dsl.Else():
+            cleanup()
         sizes = shard_sizes(k=k)
         with dsl.ParallelFor(sizes.output) as size:
             train_shard(size=size).set_retry(2)

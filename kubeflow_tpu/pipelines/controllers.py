@@ -523,6 +523,12 @@ class PipelineRunController(Controller):
             json.dump(comp, f)
         with open(os.path.join(task_dir, "inputs.json"), "w") as f:
             json.dump(inputs, f, default=str)
+        with open(os.path.join(task_dir, "env.json"), "w") as f:
+            # exported into the task's os.environ by the launcher (the
+            # thread backend shares this process, so pod-spec env alone
+            # never reaches component code): dsl.importer/storage resolve
+            # ktpu:// content addresses through KTPU_ARTIFACT_ROOT
+            json.dump({"KTPU_ARTIFACT_ROOT": self.artifacts.root}, f)
         eid = self.metadata.create_execution(run_id, key, tir["component"],
                                              cache_key)
         for iname, ival in inputs.items():
@@ -531,7 +537,10 @@ class PipelineRunController(Controller):
         backend = run["spec"].get("backend", "thread")
         template: dict[str, Any] = {
             "resources": run["spec"].get("taskResources", {"cpu": 1}),
-            "env": {"KTPU_TASK_DIR": task_dir},
+            # KTPU_ARTIFACT_ROOT lets task code (dsl.importer, storage)
+            # resolve ktpu:// content addresses against this run's store
+            "env": {"KTPU_TASK_DIR": task_dir,
+                    "KTPU_ARTIFACT_ROOT": self.artifacts.root},
         }
         if backend == "subprocess":
             template["backend"] = "subprocess"

@@ -78,6 +78,10 @@ class LoopItem:
 
 
 _OPERATORS = ("==", "!=", ">", ">=", "<", "<=")
+# the operator set is closed under negation, so Elif/Else compile to plain
+# conjunctions of (negated) predicates — no new IR or engine semantics
+_NEGATED = {"==": "!=", "!=": "==", ">": "<=", "<=": ">",
+            "<": ">=", ">=": "<"}
 
 
 @dataclass(frozen=True)
@@ -91,6 +95,9 @@ class Predicate:
     def __post_init__(self):
         if self.operator not in _OPERATORS:
             raise DSLError(f"operator {self.operator!r} not in {_OPERATORS}")
+
+    def negated(self) -> "Predicate":
+        return Predicate(self.operand, _NEGATED[self.operator], self.value)
 
 
 def _strip_decorators(source: str) -> str:
@@ -229,6 +236,9 @@ class _PipelineContext:
         self.group_stack: list[Any] = []   # active If / ParallelFor groups
         self.exit_task: str | None = None
         self._loop_seq = 0
+        # per-nesting-depth chain of branch predicates already taken by an
+        # If/Elif sequence — what Elif/Else negate to be mutually exclusive
+        self.branch_chains: dict[int, list[Predicate]] = {}
 
     def add_task(self, component: Component, kwargs: dict[str, Any]) -> Task:
         known = self.components.get(component.name)
@@ -249,6 +259,9 @@ class _PipelineContext:
             i += 1
             name = f"{base}-{i}"
         task = Task(name, component, kwargs)
+        # like kfp, a task between branches ends the If/Elif chain: a later
+        # Elif/Else must directly follow its chain, not bind across code
+        self.branch_chains.pop(len(self.group_stack), None)
         loops = [g for g in self.group_stack if isinstance(g, ParallelFor)]
         if len(loops) > 1:
             raise DSLError("nested ParallelFor is not supported")
@@ -258,11 +271,11 @@ class _PipelineContext:
             if isinstance(loops[0].items, TaskOutput):
                 task.dependencies.add(loops[0].items.task)
         for g in self.group_stack:
-            if isinstance(g, If):
-                task.conditions.append(g.condition)
+            for cond in getattr(g, "conditions", ()):
+                task.conditions.append(cond)
                 # condition operands are implicit dependencies: the engine
                 # can only evaluate the predicate once they exist
-                for ref in (g.condition.operand, g.condition.value):
+                for ref in (cond.operand, cond.value):
                     if isinstance(ref, TaskOutput):
                         task.dependencies.add(ref.task)
         self.tasks[name] = task
@@ -270,15 +283,32 @@ class _PipelineContext:
 
 
 class _Group:
+    # branch groups (If/Elif/Else) extend the chain at their depth; any
+    # OTHER group — like any task — breaks it, enforcing kfp's rule that
+    # Elif/Else must directly follow their If
+    _breaks_chain = True
+
     def __enter__(self):
         if not _ACTIVE:
             raise DSLError(
                 f"{type(self).__name__} is only usable inside a pipeline")
-        _ACTIVE[-1].group_stack.append(self)
+        ctx = _ACTIVE[-1]
+        self._pre_push(ctx)
+        if self._breaks_chain:
+            ctx.branch_chains.pop(len(ctx.group_stack), None)
+        ctx.group_stack.append(self)
+        # opening a group starts a fresh child scope: a branch chain left
+        # by some earlier sibling's subtree at that depth must not leak
+        # into this scope's own If/Elif/Else sequence
+        ctx.branch_chains.pop(len(ctx.group_stack), None)
         return self._payload()
 
     def __exit__(self, *exc):
         _ACTIVE[-1].group_stack.pop()
+
+    def _pre_push(self, ctx: "_PipelineContext") -> None:
+        """Validation / setup before the group joins the stack. Raising
+        here is safe — the group was not pushed yet."""
 
     def _payload(self):
         return self
@@ -287,14 +317,73 @@ class _Group:
 class If(_Group):
     """Runtime-conditional group (kfp dsl.Condition/dsl.If analog): tasks
     inside run only when `operand <operator> value` holds at runtime;
-    otherwise they (and their data-dependents) are Skipped."""
+    otherwise they (and their data-dependents) are Skipped. May be followed
+    at the same nesting level by `Elif`/`Else` (kfp v2), which take the
+    first branch whose condition holds."""
+
+    _breaks_chain = False
 
     def __init__(self, operand: Any, operator: str, value: Any):
         self.condition = Predicate(operand, operator, value)
+        self.conditions = (self.condition,)
+
+    def __exit__(self, *exc):
+        super().__exit__(*exc)
+        ctx = _ACTIVE[-1]
+        # a fresh If starts a new branch chain at this depth
+        ctx.branch_chains[len(ctx.group_stack)] = [self.condition]
 
 
 # kfp v1 spells this dsl.Condition; same group, same semantics
 Condition = If
+
+
+class Elif(_Group):
+    """kfp dsl.Elif: runs only when every earlier branch in the chain did
+    NOT hold and its own condition does. Compiles to a conjunction of
+    negated prior predicates + the new one — plain `conditions` in the IR."""
+
+    _breaks_chain = False
+
+    def __init__(self, operand: Any, operator: str, value: Any):
+        self.condition = Predicate(operand, operator, value)
+        self.conditions: tuple[Predicate, ...] = ()
+
+    def _pre_push(self, ctx):
+        chain = ctx.branch_chains.get(len(ctx.group_stack))
+        if not chain:
+            raise DSLError("Elif must directly follow an If (or Elif) at "
+                           "the same nesting level")
+        self.conditions = tuple(p.negated() for p in chain) + (
+            self.condition,)
+
+    def __exit__(self, *exc):
+        super().__exit__(*exc)
+        ctx = _ACTIVE[-1]
+        ctx.branch_chains[len(ctx.group_stack)].append(self.condition)
+
+
+class Else(_Group):
+    """kfp dsl.Else: the fall-through branch — runs only when no earlier
+    branch in the If/Elif chain held. Ends the chain."""
+
+    _breaks_chain = False
+
+    def __init__(self):
+        self.conditions: tuple[Predicate, ...] = ()
+
+    def _pre_push(self, ctx):
+        chain = ctx.branch_chains.get(len(ctx.group_stack))
+        if not chain:
+            raise DSLError("Else must directly follow an If (or Elif) at "
+                           "the same nesting level")
+        self.conditions = tuple(p.negated() for p in chain)
+
+    def __exit__(self, *exc):
+        super().__exit__(*exc)
+        ctx = _ACTIVE[-1]
+        # the chain is consumed: another Elif/Else here is an error
+        ctx.branch_chains.pop(len(ctx.group_stack), None)
 
 
 class ParallelFor(_Group):
@@ -314,13 +403,11 @@ class ParallelFor(_Group):
             else items
         self._group = ""
 
-    def __enter__(self):
-        if not _ACTIVE:
-            raise DSLError("ParallelFor is only usable inside a pipeline")
-        ctx = _ACTIVE[-1]
+    def _pre_push(self, ctx):
         ctx._loop_seq += 1
         self._group = f"loop-{ctx._loop_seq}"
-        ctx.group_stack.append(self)
+
+    def _payload(self):
         return LoopItem(self._group)
 
 
@@ -334,10 +421,7 @@ class ExitHandler(_Group):
             raise DSLError("ExitHandler takes the finalizer Task")
         self.exit_task = exit_task
 
-    def __enter__(self):
-        if not _ACTIVE:
-            raise DSLError("ExitHandler is only usable inside a pipeline")
-        ctx = _ACTIVE[-1]
+    def _pre_push(self, ctx):
         if ctx.exit_task is not None:
             raise DSLError("only one ExitHandler per pipeline")
         if (self.exit_task.dependencies or self.exit_task.conditions
@@ -345,8 +429,6 @@ class ExitHandler(_Group):
             raise DSLError("the exit task must be unconditional and "
                            "dependency-free")
         ctx.exit_task = self.exit_task.name
-        ctx.group_stack.append(self)
-        return self
 
 
 class Pipeline:
@@ -367,6 +449,22 @@ class Pipeline:
 
 def component(fn: Callable) -> Component:
     return Component(fn)
+
+
+@component
+def importer(artifact_uri: str) -> str:
+    """kfp dsl.importer analog: bring an external artifact into the run.
+
+    Resolves `artifact_uri` (file://, plain path, or ktpu:// content
+    address) to a local path at task runtime; downstream tasks consume the
+    returned path. Usage inside a pipeline:
+
+        raw = dsl.importer(artifact_uri="file:///data/corpus.txt")
+        train(path=raw.output)
+    """
+    from kubeflow_tpu.serving.storage import download
+
+    return download(artifact_uri)
 
 
 def pipeline(name: str | None = None, description: str = ""):

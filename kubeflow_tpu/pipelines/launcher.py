@@ -45,6 +45,13 @@ def run_task(task_dir: str) -> dict[str, Any]:
         comp = json.load(f)
     with open(os.path.join(task_dir, "inputs.json")) as f:
         inputs = json.load(f)
+    env_file = os.path.join(task_dir, "env.json")
+    if os.path.exists(env_file):
+        # run-scoped env (e.g. KTPU_ARTIFACT_ROOT for ktpu:// resolution);
+        # same values for every task of the run, so the shared-process
+        # thread backend can safely export them globally
+        with open(env_file) as f:
+            os.environ.update({k: str(v) for k, v in json.load(f).items()})
     namespace: dict[str, Any] = {}
     exec(compile(comp["source"], f"<component {comp['functionName']}>",
                  "exec"), namespace)

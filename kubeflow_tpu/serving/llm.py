@@ -52,12 +52,12 @@ class LLMEngine:
         self.eos_id = eos_id
         self.scheduler = make_scheduler(n_slots, self.buckets, max_queue,
                                         prefer_native=prefer_native)
-        self.cache = llama.init_cache(cfg, n_slots, max_len)
-        self.lengths = jnp.zeros((n_slots,), jnp.int32)
-        self.last_tokens = jnp.zeros((n_slots,), jnp.int32)
         self.mesh = None
         if mesh is not None:
             self._shard_over(mesh)
+        self.cache = self._alloc_cache()
+        self.lengths = self._put(np.zeros((n_slots,), np.int32))
+        self.last_tokens = self._put(np.zeros((n_slots,), np.int32))
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
         self._max_new: dict[int, int] = {}
@@ -105,13 +105,27 @@ class LLMEngine:
         # no trailing None: GSPMD emits the trimmed spec on program outputs
         # and the jit cache compares specs structurally — a 5-element spec
         # here would retrace every program on its first post-warmup call
-        cache_sh = NamedSharding(mesh, P(None, None, None, "tensor"))
-        self.cache = jax.tree.map(
-            lambda x: jax.device_put(x, cache_sh), self.cache)
-        repl = NamedSharding(mesh, P())
-        self._repl = repl
-        self.lengths = jax.device_put(self.lengths, repl)
-        self.last_tokens = jax.device_put(self.last_tokens, repl)
+        self._cache_sh = NamedSharding(mesh, P(None, None, None, "tensor"))
+        self._repl = NamedSharding(mesh, P())
+
+    def _alloc_cache(self):
+        """KV cache in its final layout. Under a mesh each device allocates
+        only ITS shard (make_array_from_callback) — an 8B-scale cache that
+        only fits sharded must never be materialized whole on one device."""
+        if self.mesh is None:
+            return llama.init_cache(self.cfg, self.n_slots, self.max_len)
+        shape = (self.cfg.n_layers, self.n_slots, self.max_len,
+                 self.cfg.n_kv_heads, self.cfg.head_dim)
+
+        def zeros_shard(index):
+            shard = tuple(len(range(*sl.indices(dim)))
+                          for sl, dim in zip(index, shape))
+            return np.zeros(shard, jnp.dtype(self.cfg.dtype))
+
+        return {
+            name: jax.make_array_from_callback(shape, self._cache_sh,
+                                               zeros_shard)
+            for name in ("k", "v")}
 
     def _put(self, x):
         """Host array → device; replicated across the mesh when sharded

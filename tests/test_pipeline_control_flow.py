@@ -329,3 +329,135 @@ def test_exit_handler_honors_set_retry(pipe_cluster, tmp_path):
     assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
         run["status"]
     assert run["status"]["tasks"]["flaky_twice"]["attempt"] == 2
+
+
+# -- dsl.Elif / dsl.Else ------------------------------------------------------
+
+@dsl.pipeline
+def branched(n: int = 0):
+    a = emit(n=n)
+    with dsl.If(a.output, ">", 100):
+        mark(tag="big")
+    with dsl.Elif(a.output, ">", 10):
+        mark(tag="mid")
+    with dsl.Else():
+        mark(tag="small")
+
+
+@pytest.mark.parametrize("n,taken", [(500, "mark"), (50, "mark-2"),
+                                     (5, "mark-3")])
+def test_elif_else_takes_exactly_one_branch(pipe_cluster, n, taken):
+    cluster, ctrl = pipe_cluster
+    run = run_pipeline(cluster, branched, f"br{n}", {"n": n})
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED)
+    states = {t: s["state"] for t, s in run["status"]["tasks"].items()
+              if t.startswith("mark")}
+    assert states.pop(taken) == "Succeeded"
+    assert set(states.values()) == {"Skipped"}
+
+
+def test_elif_without_if_rejected():
+    @dsl.pipeline
+    def bad():
+        with dsl.Elif(1, "==", 1):
+            emit(n=1)
+    with pytest.raises(dsl.DSLError, match="follow an If"):
+        kfp.compile_pipeline(bad)
+
+
+def test_else_chain_is_consumed():
+    @dsl.pipeline
+    def bad(n: int = 1):
+        a = emit(n=n)
+        with dsl.If(a.output, ">", 1):
+            mark(tag="a")
+        with dsl.Else():
+            mark(tag="b")
+        with dsl.Else():      # chain already consumed
+            mark(tag="c")
+    with pytest.raises(dsl.DSLError, match="follow an If"):
+        kfp.compile_pipeline(bad)
+
+
+def test_elif_must_be_adjacent_to_its_chain():
+    """A task or unrelated group between branches ends the chain (kfp
+    rejects non-adjacent Elif/Else)."""
+    @dsl.pipeline
+    def task_between(n: int = 1):
+        a = emit(n=n)
+        with dsl.If(a.output, ">", 1):
+            mark(tag="a")
+        emit(n=2)                       # breaks the chain
+        with dsl.Elif(a.output, ">", 0):
+            mark(tag="b")
+    with pytest.raises(dsl.DSLError, match="directly follow"):
+        kfp.compile_pipeline(task_between)
+
+    @dsl.pipeline
+    def group_between(n: int = 1):
+        a = emit(n=n)
+        with dsl.If(a.output, ">", 1):
+            mark(tag="a")
+        with dsl.ParallelFor([1, 2]) as item:   # breaks the chain
+            double(n=item)
+        with dsl.Else():
+            mark(tag="b")
+    with pytest.raises(dsl.DSLError, match="directly follow"):
+        kfp.compile_pipeline(group_between)
+
+
+def test_branch_chain_does_not_leak_across_scopes():
+    """An If inside one branch must not feed a later Elif at a deeper
+    level in a sibling scope."""
+    @dsl.pipeline
+    def bad(n: int = 1):
+        a = emit(n=n)
+        with dsl.If(a.output, ">", 1):
+            with dsl.If(a.output, ">", 2):
+                mark(tag="inner")
+        with dsl.Elif(a.output, ">", 0):   # valid: follows outer If
+            with dsl.Elif(a.output, ">", 3):   # invalid: no inner chain here
+                mark(tag="leak")
+    with pytest.raises(dsl.DSLError, match="follow an If"):
+        kfp.compile_pipeline(bad)
+
+
+# -- dsl.importer -------------------------------------------------------------
+
+@dsl.component
+def read_file(path: str) -> str:
+    return open(path).read()
+
+
+def test_importer_materializes_external_artifact(pipe_cluster, tmp_path):
+    src = tmp_path / "corpus.txt"
+    src.write_text("external data")
+
+    @dsl.pipeline
+    def with_import(uri: str = ""):
+        raw = dsl.importer(artifact_uri=uri)
+        read_file(path=raw.output)
+
+    cluster, ctrl = pipe_cluster
+    run = run_pipeline(cluster, with_import, "imp",
+                       {"uri": f"file://{src}"})
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    assert ctrl.task_output("imp", "read_file") == "external data"
+
+
+def test_importer_resolves_ktpu_uri(pipe_cluster):
+    """ktpu:// content addresses (the lineage store) resolve inside task
+    pods via the run-scoped KTPU_ARTIFACT_ROOT env."""
+    cluster, ctrl = pipe_cluster
+    art = ctrl.artifacts.put_json("lineage payload")
+
+    @dsl.pipeline
+    def imp_ktpu(uri: str = ""):
+        raw = dsl.importer(artifact_uri=uri)
+        read_file(path=raw.output)
+
+    run = run_pipeline(cluster, imp_ktpu, "impk", {"uri": art.uri})
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    assert ctrl.task_output("impk", "read_file") == '"lineage payload"'
