@@ -52,6 +52,12 @@ EXPERIMENT_KIND = "Experiment"
 SUGGESTION_KIND = "Suggestion"
 
 
+def max_trial_count(spec: dict[str, Any]) -> int:
+    """One default for the trial budget: the finish check and the
+    resumePolicy check must never disagree on it."""
+    return spec.get("maxTrialCount", 12)
+
+
 def validate_experiment(exp: dict[str, Any],
                         extra_job_kinds: tuple[str, ...] = ()) -> list[str]:
     """`extra_job_kinds` lets a cluster-aware caller accept custom job
@@ -74,6 +80,10 @@ def validate_experiment(exp: dict[str, Any],
         SearchSpace.parse(_nas.effective_parameters(spec))
     except SpaceError as e:
         errs.append(f"parameters: {e}")
+    if spec.get("resumePolicy", "Never") not in ("Never", "LongRunning",
+                                                 "FromVolume"):
+        errs.append(f"resumePolicy invalid: {spec.get('resumePolicy')!r} "
+                    "(Never | LongRunning | FromVolume)")
     mc = spec.get("metricsCollector")
     if mc is not None and mc.get("kind", "File") not in (
             "File", "StdOut", "TensorFlowEvent"):
@@ -169,11 +179,51 @@ class ExperimentController(Controller):
     owned_kinds = (SUGGESTION_KIND, TRIAL_KIND)
     resync_period = 0.5
 
+    def _should_resume(self, exp: dict[str, Any]) -> bool:
+        """Resumable (⊘ katib resumePolicy) when the budget that finished
+        the experiment has since been raised. Goal-reached and failed
+        experiments stay final."""
+        if exp["spec"].get("resumePolicy", "Never") not in (
+                "LongRunning", "FromVolume"):
+            return False
+        # cheap precheck from status (maintained by reconcile, final at
+        # finish time): finished LongRunning experiments resync forever,
+        # and must not scan the store every 0.5s in steady state
+        created = exp["status"].get("trials", {}).get("created", 0)
+        if created >= max_trial_count(exp["spec"]):
+            return False
+        conds = exp["status"].get("conditions", ())
+        done = next((c for c in conds
+                     if c["type"] == JobConditionType.SUCCEEDED
+                     and c["status"] == "True"), None)
+        if done is None or done.get("reason") != "MaxTrialsReached":
+            return False
+        ns = exp["metadata"].get("namespace", "default")
+        sug = self.store.try_get(SUGGESTION_KIND,
+                                 exp["metadata"]["name"], ns)
+        if sug and sug["status"].get("exhausted"):
+            return False   # nothing left to suggest (e.g. full grid):
+                           # reopening would immediately re-finish, forever
+        return True
+
     def reconcile(self, exp: dict[str, Any]) -> float | None:
         name = exp["metadata"]["name"]
         ns = exp["metadata"].get("namespace", "default")
         status = exp["status"]
         if is_finished(status):
+            if self._should_resume(exp):
+                # ⊘ katib resumePolicy LongRunning/FromVolume: raising
+                # maxTrialCount on a MaxTrialsReached experiment reopens
+                # it; the algorithm rebuilds from trial history
+                self.store.mutate(EXPERIMENT_KIND, name, lambda o: (
+                    o["status"].__setitem__("conditions", [
+                        c for c in o["status"].get("conditions", ())
+                        if c["type"] != JobConditionType.SUCCEEDED]),
+                    o["status"].pop("completionTime", None),
+                    set_condition(o["status"], JobConditionType.RESTARTING,
+                                  "ExperimentResumed",
+                                  "maxTrialCount raised; resuming")), ns)
+                return 0.0
             return None
 
         from kubeflow_tpu.control.jobs import JAXJobController
@@ -231,7 +281,7 @@ class ExperimentController(Controller):
             self._finish(exp, JobConditionType.SUCCEEDED, "GoalReached",
                          f"objective goal reached: {optimal['observation']}")
             return None
-        max_trials = spec.get("maxTrialCount", 12)
+        max_trials = max_trial_count(spec)
         done = len(succeeded) + len(early) + len(failed)
         sug = self.store.try_get(SUGGESTION_KIND, name, ns)
         exhausted = bool(sug and sug["status"].get("exhausted"))

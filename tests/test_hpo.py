@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 
 import numpy as np
 import pytest
@@ -462,6 +463,36 @@ class TestExperimentE2E:
             if t["spec"]["parameterAssignments"]["pbt_parent"] >= 0]
         # the second generation exists and its lineage points into gen 0
         assert gen1_parents and all(0 <= p < 4 for p in gen1_parents)
+
+    def test_resume_policy_reopens_on_raised_budget(self, hpo_cluster):
+        """Katib resumePolicy LongRunning: raising maxTrialCount on a
+        MaxTrialsReached experiment resumes it; Never stays final."""
+        cluster, _ = hpo_cluster
+        exp = make_experiment("res-e2e", max_trials=4)
+        exp["spec"]["resumePolicy"] = "LongRunning"
+        cluster.store.create(exp)
+        done = wait_exp(cluster, "res-e2e")
+        assert done["status"]["trials"]["succeeded"] == 4
+        cluster.store.mutate(
+            "Experiment", "res-e2e",
+            lambda o: o["spec"].update(maxTrialCount=7))
+        done = cluster.wait_for(
+            "Experiment", "res-e2e",
+            lambda o: (is_finished(o["status"])
+                       and o["status"]["trials"]["created"] == 7),
+            timeout=60)
+        assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+        assert done["status"]["trials"]["succeeded"] == 7
+
+        # default policy (Never): raising the budget does NOT reopen
+        cluster.store.create(make_experiment("res-never", max_trials=2))
+        wait_exp(cluster, "res-never")
+        cluster.store.mutate(
+            "Experiment", "res-never",
+            lambda o: o["spec"].update(maxTrialCount=5))
+        time.sleep(1.5)   # several resync periods
+        still = cluster.store.get("Experiment", "res-never")
+        assert still["status"]["trials"]["created"] == 2
 
     def test_tpe_experiment_improves_over_first_trials(self, hpo_cluster):
         cluster, _ = hpo_cluster
