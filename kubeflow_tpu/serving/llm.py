@@ -61,6 +61,7 @@ class LLMEngine:
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
         self._max_new: dict[int, int] = {}
+        self._finish_reasons: dict[int, str] = {}
 
         self._prompts: dict[int, list[int]] = {}
         self._results: dict[int, list[int]] = {}
@@ -304,6 +305,16 @@ class LLMEngine:
             raise KeyError(f"request {req_id} not finished")
         return self._results[req_id]
 
+    def partial_result(self, req_id: int) -> list[int]:
+        """Tokens generated so far (streaming consumers poll this while
+        the request runs). Snapshot copy: the engine thread appends."""
+        return list(self._results.get(req_id, ()))
+
+    def finish_reason(self, req_id: int) -> str:
+        """Why a finished request stopped: "stop" (EOS) or "length"
+        (max-new-tokens / cache room). Read before release()."""
+        return self._finish_reasons.get(req_id, "length")
+
     def release(self, req_id: int) -> None:
         """Drop all per-request state. Long-lived servers MUST call this
         after reading result(), or per-request dicts grow without bound."""
@@ -311,6 +322,7 @@ class LLMEngine:
         self._results.pop(req_id, None)
         self._submit_t.pop(req_id, None)
         self._first_token_t.pop(req_id, None)
+        self._finish_reasons.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32) -> list[int]:
@@ -421,6 +433,9 @@ class LLMEngine:
         out_of_room = self._host_lengths[slot] >= self.max_len
         freed = self.scheduler.token_done(slot, finished=hit_eos or out_of_room)
         if freed:
+            # OpenAI finish_reason semantics: "stop" = the model chose to
+            # end (EOS); "length" = budget/cache truncation
+            self._finish_reasons[req_id] = "stop" if hit_eos else "length"
             self._done.add(req_id)
             self._prompts.pop(req_id, None)
             self._max_new.pop(req_id, None)

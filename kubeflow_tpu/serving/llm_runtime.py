@@ -39,10 +39,16 @@ class LLMModel(Model):
                  max_len: int = 512, buckets=(64, 128, 256),
                  eos_id: int | None = None, checkpoint: str | None = None,
                  seed: int = 0, timeout_s: float = 300.0,
-                 mesh: dict[str, int] | None = None, **_ignored: Any):
+                 mesh: dict[str, int] | None = None,
+                 tokenizer: str | None = None, **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
+        # text endpoints (/openai/v1/completions): byte-level fallback or
+        # a local HF tokenizer dir (config.tokenizer)
+        from kubeflow_tpu.serving.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(tokenizer)
         self._n_slots = n_slots
         self._max_len = max_len
         self._buckets = tuple(buckets)
@@ -169,25 +175,62 @@ class LLMModel(Model):
         self._wake.set()
         return rid
 
-    def _wait(self, rid: int) -> list[int]:
+    def _check_alive(self, deadline: float) -> None:
+        """One liveness/deadline gate for every waiter (buffered + stream)."""
+        if (self._stop.is_set() or self._thread is None
+                or not self._thread.is_alive()):
+            raise RuntimeError(
+                f"llm engine loop is not running ({self._loop_error!r})")
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"generation timed out after {self._timeout_s}s")
+
+    def stream(self, payload: Any, on_finish=None):
+        """Yield generated token ids as they land (the SSE-completions
+        backend). Same timeout/abandon discipline as _wait; tokens are
+        drained from the engine's partial results while it decodes.
+        `on_finish(reason)` fires before release with the OpenAI
+        finish_reason ("stop" | "length")."""
+        rid = self._submit(payload)
+        deadline = time.monotonic() + self._timeout_s
+        sent = 0
+        try:
+            while True:
+                done = self._engine.is_done(rid)   # BEFORE the drain: a
+                # token landing between drain and check is caught next loop
+                toks = self._engine.partial_result(rid)
+                while sent < len(toks):
+                    yield toks[sent]
+                    sent += 1
+                if done:
+                    break
+                self._check_alive(deadline)
+                time.sleep(0.001)
+        except BaseException:
+            self._abandoned.add(rid)
+            raise
+        if on_finish is not None:
+            on_finish(self._engine.finish_reason(rid))
+        self._engine.release(rid)
+
+    def complete(self, payload: Any) -> tuple[list[int], str]:
+        """Buffered generation returning (tokens, finish_reason)."""
+        rid = self._submit(payload)
+        return self._wait(rid, with_reason=True)
+
+    def _wait(self, rid: int, with_reason: bool = False):
         deadline = time.monotonic() + self._timeout_s
         try:
             while not self._engine.is_done(rid):
-                if (self._stop.is_set() or self._thread is None
-                        or not self._thread.is_alive()):
-                    raise RuntimeError(
-                        f"llm engine loop is not running "
-                        f"({self._loop_error!r})")
-                if time.monotonic() >= deadline:
-                    raise TimeoutError(
-                        f"generation timed out after {self._timeout_s}s")
+                self._check_alive(deadline)
                 time.sleep(0.001)
         except BaseException:
             self._abandoned.add(rid)  # engine thread releases it when done
             raise
         out = self._engine.result(rid)
+        reason = self._engine.finish_reason(rid)
         self._engine.release(rid)  # long-lived server: drop request state
-        return out
+        return (out, reason) if with_reason else out
 
     def metrics(self) -> dict[str, Any]:
         return self._engine.metrics() if self._engine else {}

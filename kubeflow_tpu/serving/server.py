@@ -32,6 +32,10 @@ from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
                                            v1_encode)
 
 
+class NotReadyError(Exception):
+    """Model exists but cannot serve yet (→ HTTP 503, retryable)."""
+
+
 class ModelServer:
     def __init__(self, repository: ModelRepository | None = None,
                  port: int = 0, name: str = "kubeflow-tpu-server",
@@ -71,6 +75,18 @@ class ModelServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(length)
+                    if self.path == "/openai/v1/completions":
+                        try:
+                            body = json.loads(raw) if raw else {}
+                        except json.JSONDecodeError as e:
+                            return self._send(400,
+                                              {"error": f"bad json: {e}"})
+                        if not isinstance(body, dict):
+                            return self._send(
+                                400, {"error": "body must be an object"})
+                        if body.get("stream"):
+                            return server._stream_completion(self, body)
+                        return self._send(*server._completion(body))
                     self._send(*server._handle_post(self.path, raw))
                 except Exception as e:
                     self._send(500, {"error": str(e)})
@@ -151,6 +167,114 @@ class ModelServer:
         except ModelError as e:
             return 404, {"error": str(e)}
         return 404, {"error": f"no route {path}"}
+
+    # -- OpenAI-compatible completions (⊘ kserve huggingfaceserver) ----------
+
+    def _completion_request(self, body: dict[str, Any]):
+        """Shared request parsing → (model, payload). Raises ProtocolError
+        (→400), ModelError (→404), or NotReadyError (→503)."""
+        name = body.get("model")
+        if not name:
+            raise ProtocolError('"model" is required')
+        m = self.repository.get(name)
+        if not hasattr(m, "stream") or not hasattr(m, "tokenizer"):
+            raise ProtocolError(
+                f"model {name!r} does not serve text completions")
+        if not m.ready:
+            raise NotReadyError(f"model {name!r} is not ready")
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            if not all(isinstance(t, int) for t in prompt):
+                raise ProtocolError(
+                    "prompt must be a string or a list of token ids "
+                    "(batched string prompts are not supported)")
+            ids = list(prompt)
+        elif isinstance(prompt, str):
+            ids = m.tokenizer.encode(prompt)
+        else:
+            raise ProtocolError("prompt must be a string or token ids")
+        if not ids:
+            raise ProtocolError("prompt must be non-empty")
+        try:
+            max_new = int(body.get("max_tokens", 16))
+        except (TypeError, ValueError):
+            raise ProtocolError("max_tokens must be an int") from None
+        return m, {"prompt_tokens": ids, "max_new_tokens": max_new}
+
+    @staticmethod
+    def _completion_error(e: Exception) -> tuple[int, dict[str, Any]]:
+        code = (400 if isinstance(e, ProtocolError)
+                else 503 if isinstance(e, NotReadyError) else 404)
+        return code, {"error": str(e)}
+
+    def _completion(self, body: dict[str, Any]
+                    ) -> tuple[int, dict[str, Any]]:
+        t0 = time.perf_counter()
+        try:
+            m, payload = self._completion_request(body)
+            tokens, reason = m.complete(payload)
+        except (ProtocolError, ModelError, NotReadyError) as e:
+            return self._completion_error(e)
+        self._observe(m.name, "completions", time.perf_counter() - t0)
+        return 200, {
+            "object": "text_completion", "model": m.name,
+            "choices": [{"index": 0, "text": m.tokenizer.decode(tokens),
+                         "token_ids": tokens, "finish_reason": reason}],
+            "usage": {"prompt_tokens": len(payload["prompt_tokens"]),
+                      "completion_tokens": len(tokens)}}
+
+    def _stream_completion(self, handler, body: dict[str, Any]) -> None:
+        """Server-sent events: one `data: {...}` chunk per token carrying
+        the incremental TEXT delta (multi-byte sequences decode across
+        chunk boundaries), a final chunk with finish_reason, then
+        `data: [DONE]`. Connection: close (progressive writes without
+        chunked framing). NOTE: through an ISVC Router this buffers — the
+        streaming dataplane is the predictor's own port."""
+        from kubeflow_tpu.serving.tokenizer import StreamDecoder
+
+        try:
+            m, payload = self._completion_request(body)
+        except (ProtocolError, ModelError, NotReadyError) as e:
+            return handler._send(*self._completion_error(e))
+        t0 = time.perf_counter()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        handler.close_connection = True
+        decoder = StreamDecoder(m.tokenizer)
+        finish: list[str] = []
+
+        def chunk_of(text: str, token_id: int | None = None,
+                     reason: str | None = None) -> bytes:
+            choice: dict[str, Any] = {"index": 0, "text": text,
+                                      "finish_reason": reason}
+            if token_id is not None:
+                choice["token_id"] = token_id
+            return ("data: " + json.dumps(
+                {"object": "text_completion.chunk", "model": m.name,
+                 "choices": [choice]}) + "\n\n").encode()
+
+        try:   # everything after the headers: a disconnect anywhere here
+               # must not fall back to do_POST's JSON 500 on this socket
+            try:
+                for tok in m.stream(payload, on_finish=finish.append):
+                    handler.wfile.write(chunk_of(decoder.push(tok),
+                                                 token_id=int(tok)))
+                    handler.wfile.flush()
+            except Exception as e:
+                handler.wfile.write(
+                    f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+            else:
+                tail = decoder.flush()
+                reason = finish[0] if finish else "length"
+                handler.wfile.write(chunk_of(tail, reason=reason))
+            handler.wfile.write(b"data: [DONE]\n\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return   # client hung up mid-stream; the generator's abandon
+                     # path (GeneratorExit) cleans up the engine request
+        self._observe(m.name, "completions", time.perf_counter() - t0)
 
     # -- dataplanes -----------------------------------------------------------
 

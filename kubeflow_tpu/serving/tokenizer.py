@@ -1,0 +1,89 @@
+"""Tokenizers for the text-facing serving endpoints (⊘ kserve
+huggingfaceserver: models expose text APIs, the runtime owns the
+tokenizer).
+
+Two implementations behind one two-method protocol (encode/decode):
+
+  - `ByteTokenizer` — dependency-free UTF-8 byte-level fallback: token id
+    = byte value (0..255). Works with any model whose vocab covers 256;
+    what the demo/test models use (no pretrained assets exist offline).
+  - HuggingFace tokenizer — `load_tokenizer("/path/to/tokenizer_dir")`
+    loads a local pretrained tokenizer via transformers (gated import;
+    this environment has no network, so only local directories work).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level: token id == byte value. Lossless for any text;
+    ids outside 0..255 (e.g. a model's EOS) decode to nothing."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode(
+            "utf-8", errors="replace")
+
+
+class StreamDecoder:
+    """Incremental detokenizer for streaming: decodes the RUNNING token
+    sequence and emits the stable text delta, holding back trailing
+    replacement characters that may be an incomplete multi-byte/multi-token
+    sequence still being generated (decoding tokens one at a time would
+    corrupt any non-ASCII output)."""
+
+    def __init__(self, tokenizer: Any):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._emitted = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        text = self._tok.decode(self._ids)
+        safe = len(text)
+        while safe > 0 and text[safe - 1] == "�":
+            safe -= 1
+        delta, self._emitted = text[self._emitted:safe], max(self._emitted,
+                                                            safe)
+        return delta
+
+    def flush(self) -> str:
+        """Whatever is still held back once the stream ends (a genuinely
+        malformed tail decodes to its replacement characters here)."""
+        text = self._tok.decode(self._ids)
+        delta, self._emitted = text[self._emitted:], len(text)
+        return delta
+
+
+def load_tokenizer(spec: str | None) -> Any:
+    """None → ByteTokenizer; a path → local HF tokenizer directory."""
+    if spec is None:
+        return ByteTokenizer()
+    try:
+        from transformers import AutoTokenizer
+    except ImportError as e:  # pragma: no cover - transformers is baked in
+        raise RuntimeError(
+            f"tokenizer {spec!r} needs transformers: {e}") from e
+    tok = AutoTokenizer.from_pretrained(spec)
+
+    class _HF:
+        vocab_size = tok.vocab_size
+
+        def encode(self, text: str) -> list[int]:
+            return tok.encode(text, add_special_tokens=False)
+
+        def decode(self, ids: Sequence[int]) -> str:
+            return tok.decode(list(ids), skip_special_tokens=True)
+
+    return _HF()

@@ -267,3 +267,129 @@ def test_warmup_covers_live_traffic_no_retrace(tiny):
         assert not (set({**engine._prefill_fns,
                          **engine._decode_fns}) - set(sizes)), \
             "live traffic created a program warmup never compiled"
+
+
+# -- OpenAI-compatible completions -------------------------------------------
+
+@pytest.fixture()
+def completion_server(tiny):
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.server import ModelServer
+
+    _, cfg = tiny
+    m = LLMModel("llm", model={k: getattr(cfg, k) for k in
+                               ("vocab_size", "d_model", "n_layers",
+                                "n_heads", "n_kv_heads", "d_ff",
+                                "max_seq_len", "attention_impl", "remat")},
+                 n_slots=2, max_len=32, buckets=(8, 16), seed=0)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    yield server
+    server.stop()
+    m.unload()
+
+
+def test_openai_completion_buffered(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    params, cfg = tiny
+    conn = http.client.HTTPConnection("127.0.0.1", completion_server.port,
+                                      timeout=60)
+    conn.request("POST", "/openai/v1/completions",
+                 body=_json.dumps({"model": "llm", "prompt": "Hi",
+                                   "max_tokens": 4}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = _json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, out
+    ref = _ref_generate(params, cfg, [72, 105], 4)   # "Hi" byte-encoded
+    choice = out["choices"][0]
+    assert choice["token_ids"] == ref
+    assert choice["finish_reason"] == "length"
+    assert out["usage"] == {"prompt_tokens": 2, "completion_tokens": 4}
+    # byte-level decode of the generated ids
+    assert choice["text"] == bytes(t for t in ref
+                                   if 0 <= t < 256).decode("utf-8",
+                                                           "replace")
+
+
+def test_openai_completion_streams_tokens(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    params, cfg = tiny
+    conn = http.client.HTTPConnection("127.0.0.1", completion_server.port,
+                                      timeout=60)
+    conn.request("POST", "/openai/v1/completions",
+                 body=_json.dumps({"model": "llm", "prompt": "Hi",
+                                   "max_tokens": 4, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events = []
+    for line in resp.read().decode().splitlines():
+        if line.startswith("data: "):
+            events.append(line[len("data: "):])
+    conn.close()
+    assert events[-1] == "[DONE]"
+    chunks = [_json.loads(e)["choices"][0] for e in events[:-1]]
+    toks = [c["token_id"] for c in chunks if "token_id" in c]
+    assert toks == _ref_generate(params, cfg, [72, 105], 4)
+    # the final chunk carries finish_reason; streamed text deltas
+    # concatenate to the buffered endpoint's text
+    assert chunks[-1]["finish_reason"] == "length"
+    streamed = "".join(c["text"] for c in chunks)
+    assert streamed == bytes(t for t in toks
+                             if 0 <= t < 256).decode("utf-8", "replace")
+
+
+def test_openai_completion_errors(completion_server):
+    import http.client
+    import json as _json
+
+    def post(body):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", completion_server.port, timeout=30)
+        conn.request("POST", "/openai/v1/completions",
+                     body=_json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = _json.loads(resp.read())
+        conn.close()
+        return resp.status, out
+
+    assert post({"prompt": "x"})[0] == 400            # model required
+    assert post({"model": "nope", "prompt": "x"})[0] == 404
+    assert post({"model": "llm", "prompt": ""})[0] == 400
+
+
+def test_stream_decoder_multibyte_and_eos_reason(tiny):
+    from kubeflow_tpu.serving.tokenizer import ByteTokenizer, StreamDecoder
+
+    d = StreamDecoder(ByteTokenizer())
+    # "é" = UTF-8 [195, 169]: nothing emits until the sequence completes
+    assert d.push(195) == ""
+    assert d.push(169) == "é"
+    assert d.push(33) == "!"
+    assert d.flush() == ""
+    # a genuinely malformed tail surfaces as replacement chars at flush
+    d2 = StreamDecoder(ByteTokenizer())
+    assert d2.push(195) == ""
+    assert d2.flush() == "�"
+
+    # finish_reason "stop": make the model's first generated token the EOS
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    params, cfg = tiny
+    first = _ref_generate(params, cfg, [72, 105], 1)[0]
+    engine = LLMEngine(params, cfg, n_slots=1, max_len=32, buckets=(8,),
+                       eos_id=first)
+    rid = engine.submit([72, 105], 8)
+    engine.run_until_idle()
+    assert engine.result(rid) == [first]
+    assert engine.finish_reason(rid) == "stop"
