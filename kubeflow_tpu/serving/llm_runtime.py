@@ -186,12 +186,16 @@ class LLMModel(Model):
                 f"generation timed out after {self._timeout_s}s")
 
     def stream(self, payload: Any, on_finish=None):
-        """Yield generated token ids as they land (the SSE-completions
-        backend). Same timeout/abandon discipline as _wait; tokens are
-        drained from the engine's partial results while it decodes.
+        """Token-id stream for the SSE-completions backend. Submits
+        EAGERLY (not a generator itself) so unservable requests —
+        PromptTooLong, QueueFull — raise before the caller commits an
+        HTTP status; returns the generator that drains the engine.
         `on_finish(reason)` fires before release with the OpenAI
         finish_reason ("stop" | "length")."""
         rid = self._submit(payload)
+        return self._stream_from(rid, on_finish)
+
+    def _stream_from(self, rid: int, on_finish=None):
         deadline = time.monotonic() + self._timeout_s
         sent = 0
         try:

@@ -75,7 +75,9 @@ class ModelServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(length)
-                    if self.path == "/openai/v1/completions":
+                    if self.path in ("/openai/v1/completions",
+                                     "/openai/v1/chat/completions"):
+                        chat = self.path.endswith("chat/completions")
                         try:
                             body = json.loads(raw) if raw else {}
                         except json.JSONDecodeError as e:
@@ -85,8 +87,9 @@ class ModelServer:
                             return self._send(
                                 400, {"error": "body must be an object"})
                         if body.get("stream"):
-                            return server._stream_completion(self, body)
-                        return self._send(*server._completion(body))
+                            return server._stream_completion(self, body,
+                                                             chat)
+                        return self._send(*server._completion(body, chat))
                     self._send(*server._handle_post(self.path, raw))
                 except Exception as e:
                     self._send(500, {"error": str(e)})
@@ -170,7 +173,8 @@ class ModelServer:
 
     # -- OpenAI-compatible completions (⊘ kserve huggingfaceserver) ----------
 
-    def _completion_request(self, body: dict[str, Any]):
+    def _completion_request(self, body: dict[str, Any],
+                            chat: bool = False):
         """Shared request parsing → (model, payload). Raises ProtocolError
         (→400), ModelError (→404), or NotReadyError (→503)."""
         name = body.get("model")
@@ -182,17 +186,37 @@ class ModelServer:
                 f"model {name!r} does not serve text completions")
         if not m.ready:
             raise NotReadyError(f"model {name!r} is not ready")
-        prompt = body.get("prompt", "")
-        if isinstance(prompt, list):
-            if not all(isinstance(t, int) for t in prompt):
+        if chat:
+            from kubeflow_tpu.serving.tokenizer import chat_prompt_ids
+
+            messages = body.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ProtocolError('"messages" must be a non-empty list')
+            for msg in messages:
+                if not (isinstance(msg, dict)
+                        and isinstance(msg.get("role"), str)
+                        and isinstance(msg.get("content"), str)):
+                    raise ProtocolError(
+                        "each message needs string role and content")
+            try:
+                ids = chat_prompt_ids(m.tokenizer, messages)
+            except Exception as e:
+                # e.g. an HF chat template (jinja) rejecting the message
+                # sequence: a malformed request, not a server fault
                 raise ProtocolError(
-                    "prompt must be a string or a list of token ids "
-                    "(batched string prompts are not supported)")
-            ids = list(prompt)
-        elif isinstance(prompt, str):
-            ids = m.tokenizer.encode(prompt)
+                    f"chat template rejected messages: {e}") from e
         else:
-            raise ProtocolError("prompt must be a string or token ids")
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                if not all(isinstance(t, int) for t in prompt):
+                    raise ProtocolError(
+                        "prompt must be a string or a list of token ids "
+                        "(batched string prompts are not supported)")
+                ids = list(prompt)
+            elif isinstance(prompt, str):
+                ids = m.tokenizer.encode(prompt)
+            else:
+                raise ProtocolError("prompt must be a string or token ids")
         if not ids:
             raise ProtocolError("prompt must be non-empty")
         try:
@@ -203,27 +227,46 @@ class ModelServer:
 
     @staticmethod
     def _completion_error(e: Exception) -> tuple[int, dict[str, Any]]:
-        code = (400 if isinstance(e, ProtocolError)
-                else 503 if isinstance(e, NotReadyError) else 404)
+        from kubeflow_tpu.serving.scheduler import QueueFull
+
+        code = (404 if isinstance(e, ModelError)
+                else 503 if isinstance(e, (NotReadyError, QueueFull))
+                else 400)   # ProtocolError / PromptTooLong: bad request
         return code, {"error": str(e)}
 
-    def _completion(self, body: dict[str, Any]
+    @staticmethod
+    def _completion_exceptions() -> tuple[type, ...]:
+        from kubeflow_tpu.serving.scheduler import PromptTooLong, QueueFull
+
+        # deliberately NOT bare ValueError: an internal engine bug must
+        # surface as a 500, not masquerade as a client error
+        return (ProtocolError, ModelError, NotReadyError, PromptTooLong,
+                QueueFull)
+
+    def _completion(self, body: dict[str, Any], chat: bool = False
                     ) -> tuple[int, dict[str, Any]]:
         t0 = time.perf_counter()
         try:
-            m, payload = self._completion_request(body)
+            m, payload = self._completion_request(body, chat)
             tokens, reason = m.complete(payload)
-        except (ProtocolError, ModelError, NotReadyError) as e:
+        except self._completion_exceptions() as e:
             return self._completion_error(e)
         self._observe(m.name, "completions", time.perf_counter() - t0)
+        text = m.tokenizer.decode(tokens)
+        choice: dict[str, Any] = {"index": 0, "token_ids": tokens,
+                                  "finish_reason": reason}
+        if chat:
+            choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text
         return 200, {
-            "object": "text_completion", "model": m.name,
-            "choices": [{"index": 0, "text": m.tokenizer.decode(tokens),
-                         "token_ids": tokens, "finish_reason": reason}],
+            "object": "chat.completion" if chat else "text_completion",
+            "model": m.name, "choices": [choice],
             "usage": {"prompt_tokens": len(payload["prompt_tokens"]),
                       "completion_tokens": len(tokens)}}
 
-    def _stream_completion(self, handler, body: dict[str, Any]) -> None:
+    def _stream_completion(self, handler, body: dict[str, Any],
+                           chat: bool = False) -> None:
         """Server-sent events: one `data: {...}` chunk per token carrying
         the incremental TEXT delta (multi-byte sequences decode across
         chunk boundaries), a final chunk with finish_reason, then
@@ -232,9 +275,13 @@ class ModelServer:
         streaming dataplane is the predictor's own port."""
         from kubeflow_tpu.serving.tokenizer import StreamDecoder
 
+        finish: list[str] = []
         try:
-            m, payload = self._completion_request(body)
-        except (ProtocolError, ModelError, NotReadyError) as e:
+            m, payload = self._completion_request(body, chat)
+            # m.stream submits eagerly: PromptTooLong/QueueFull raise HERE,
+            # before the 200 + SSE headers are committed
+            token_iter = m.stream(payload, on_finish=finish.append)
+        except self._completion_exceptions() as e:
             return handler._send(*self._completion_error(e))
         t0 = time.perf_counter()
         handler.send_response(200)
@@ -244,22 +291,28 @@ class ModelServer:
         handler.end_headers()
         handler.close_connection = True
         decoder = StreamDecoder(m.tokenizer)
-        finish: list[str] = []
+        first = [True]
 
         def chunk_of(text: str, token_id: int | None = None,
                      reason: str | None = None) -> bytes:
-            choice: dict[str, Any] = {"index": 0, "text": text,
-                                      "finish_reason": reason}
+            choice: dict[str, Any] = {"index": 0, "finish_reason": reason}
+            if chat:
+                choice["delta"] = ({"role": "assistant", "content": text}
+                                   if first[0] else {"content": text})
+                first[0] = False
+            else:
+                choice["text"] = text
             if token_id is not None:
                 choice["token_id"] = token_id
             return ("data: " + json.dumps(
-                {"object": "text_completion.chunk", "model": m.name,
-                 "choices": [choice]}) + "\n\n").encode()
+                {"object": ("chat.completion.chunk" if chat
+                            else "text_completion.chunk"),
+                 "model": m.name, "choices": [choice]}) + "\n\n").encode()
 
         try:   # everything after the headers: a disconnect anywhere here
                # must not fall back to do_POST's JSON 500 on this socket
             try:
-                for tok in m.stream(payload, on_finish=finish.append):
+                for tok in token_iter:
                     handler.wfile.write(chunk_of(decoder.push(tok),
                                                  token_id=int(tok)))
                     handler.wfile.flush()

@@ -36,6 +36,16 @@ class ByteTokenizer:
             "utf-8", errors="replace")
 
 
+def chat_prompt_ids(tokenizer: Any, messages: list[dict]) -> list[int]:
+    """messages → prompt token ids. Uses the tokenizer's own chat template
+    when it has one (HF); otherwise a plain role-tagged concatenation with
+    a generation prompt for the assistant turn."""
+    if hasattr(tokenizer, "apply_chat_template"):
+        return tokenizer.apply_chat_template(messages)
+    text = "".join(f"<|{m['role']}|>\n{m['content']}\n" for m in messages)
+    return tokenizer.encode(text + "<|assistant|>\n")
+
+
 class StreamDecoder:
     """Incremental detokenizer for streaming: decodes the RUNNING token
     sequence and emits the stable text delta, holding back trailing
@@ -85,5 +95,10 @@ def load_tokenizer(spec: str | None) -> Any:
 
         def decode(self, ids: Sequence[int]) -> str:
             return tok.decode(list(ids), skip_special_tokens=True)
+
+        if getattr(tok, "chat_template", None):
+            def apply_chat_template(self, messages: list) -> list[int]:
+                return tok.apply_chat_template(messages,
+                                               add_generation_prompt=True)
 
     return _HF()

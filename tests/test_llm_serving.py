@@ -282,7 +282,7 @@ def completion_server(tiny):
                                ("vocab_size", "d_model", "n_layers",
                                 "n_heads", "n_kv_heads", "d_ff",
                                 "max_seq_len", "attention_impl", "remat")},
-                 n_slots=2, max_len=32, buckets=(8, 16), seed=0)
+                 n_slots=2, max_len=64, buckets=(8, 48), seed=0)
     repo = ModelRepository()
     repo.register(m)
     server = ModelServer(repo).start()
@@ -393,3 +393,100 @@ def test_stream_decoder_multibyte_and_eos_reason(tiny):
     engine.run_until_idle()
     assert engine.result(rid) == [first]
     assert engine.finish_reason(rid) == "stop"
+
+
+def test_openai_chat_completion(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    from kubeflow_tpu.serving.tokenizer import ByteTokenizer, chat_prompt_ids
+
+    params, cfg = tiny
+    messages = [{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "Hi"}]
+    conn = http.client.HTTPConnection("127.0.0.1", completion_server.port,
+                                      timeout=60)
+    conn.request("POST", "/openai/v1/chat/completions",
+                 body=_json.dumps({"model": "llm", "messages": messages,
+                                   "max_tokens": 4}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = _json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, out
+    ids = chat_prompt_ids(ByteTokenizer(), messages)
+    ref = _ref_generate(params, cfg, ids, 4)
+    choice = out["choices"][0]
+    assert out["object"] == "chat.completion"
+    assert choice["token_ids"] == ref
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] == "length"
+
+
+def test_openai_chat_completion_streams(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", completion_server.port,
+                                      timeout=60)
+    conn.request("POST", "/openai/v1/chat/completions",
+                 body=_json.dumps({"model": "llm",
+                                   "messages": [{"role": "user",
+                                                 "content": "Hi"}],
+                                   "max_tokens": 4, "stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    events = [ln[len("data: "):]
+              for ln in resp.read().decode().splitlines()
+              if ln.startswith("data: ")]
+    conn.close()
+    assert events[-1] == "[DONE]"
+    chunks = [_json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    deltas = [c["choices"][0]["delta"] for c in chunks]
+    assert deltas[0].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_openai_chat_completion_errors(completion_server):
+    import http.client
+    import json as _json
+
+    def post(body):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", completion_server.port, timeout=30)
+        conn.request("POST", "/openai/v1/chat/completions",
+                     body=_json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = _json.loads(resp.read())
+        conn.close()
+        return resp.status, out
+
+    assert post({"model": "llm"})[0] == 400                 # no messages
+    assert post({"model": "llm", "messages": []})[0] == 400
+    assert post({"model": "llm",
+                 "messages": [{"role": "user"}]})[0] == 400  # no content
+
+
+def test_openai_unservable_prompts_get_4xx_5xx_not_sse(completion_server):
+    """PromptTooLong must be a clean HTTP error on BOTH dataplanes — the
+    stream path submits eagerly, before committing 200 + SSE headers."""
+    import http.client
+    import json as _json
+
+    long_prompt = list(range(1, 60))   # exceeds the largest bucket (48)
+    for stream in (False, True):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", completion_server.port, timeout=30)
+        conn.request("POST", "/openai/v1/completions",
+                     body=_json.dumps({"model": "llm",
+                                       "prompt": long_prompt,
+                                       "stream": stream}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = _json.loads(resp.read())
+        conn.close()
+        assert resp.status == 400, (stream, out)
+        assert "exceeds buckets" in out["error"]
