@@ -41,7 +41,7 @@ class LLMEngine:
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
                  max_queue: int = 1024, eos_id: int | None = None,
                  prefer_native: bool = True, decode_chunk: int = 8,
-                 mesh=None):
+                 mesh=None, sample_seed: int = 0):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         self.params = params
@@ -58,6 +58,13 @@ class LLMEngine:
         self.cache = self._alloc_cache()
         self.lengths = self._put(np.zeros((n_slots,), np.int32))
         self.last_tokens = self._put(np.zeros((n_slots,), np.int32))
+        # per-slot sampling temperature (0 = greedy) + the program-threaded
+        # PRNG key: both live on device like the rest of the slot state
+        self.temps = self._put(np.zeros((n_slots,), np.float32))
+        self.rng_key = (jax.random.key(sample_seed) if self.mesh is None
+                        else jax.device_put(jax.random.key(sample_seed),
+                                            self._repl))
+        self._req_temps: dict[int, float] = {}
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
         self._max_new: dict[int, int] = {}
@@ -144,56 +151,84 @@ class LLMEngine:
     # iteration (the new tokens), which is what keeps per-step latency at
     # dispatch cost instead of several tunnel round-trips.
 
-    def _prefill(self, params, cache, lengths, last_tokens, wave):
+    @staticmethod
+    def _pick(logits, temps, key):
+        """Greedy where temps==0, temperature sampling elsewhere — per ROW
+        (slot/wave entry), so one continuous batch mixes both."""
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, scaled,
+                                         axis=-1).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _prefill(self, params, cache, lengths, last_tokens, temps, key,
+                 wave):
         """Batched prefill wave. `wave` is ONE packed int32 array
-        [W, bucket+2] — row i = prompt tokens (right-padded) ++ [slot,
-        prompt_len] — because on a tunneled device every host->device
-        transfer costs a full RTT: one packed transfer + one dispatch
-        covers a whole burst of arrivals. Padded wave rows duplicate a
-        real row (same slot, same data), so their writes are idempotent."""
-        tokens, slots, prompt_lens = (wave[:, :-2], wave[:, -2],
-                                      wave[:, -1])
+        [W, bucket+3] — row i = prompt tokens (right-padded) ++ [slot,
+        prompt_len, temperature*1000] — because on a tunneled device every
+        host->device transfer costs a full RTT: one packed transfer + one
+        dispatch covers a whole burst of arrivals. Padded wave rows
+        duplicate a real row (same slot, same data) and sampling keys
+        derive from the slot id, so duplicate writes are idempotent even
+        for sampled requests."""
+        tokens, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
+                                      wave[:, -2])
+        row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
         logits, ks, vs = llama.prefill(params, tokens, self.cfg)
         bucket = tokens.shape[1]
         k, v = cache["k"], cache["v"]
-        toks = []
+        lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
             k = k.at[:, slots[i], :bucket].set(ks[:, i])
             v = v.at[:, slots[i], :bucket].set(vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
-            last = jax.lax.dynamic_index_in_dim(
-                logits[i], prompt_lens[i] - 1, keepdims=False)
-            tok = jnp.argmax(last, -1).astype(jnp.int32)
-            last_tokens = last_tokens.at[slots[i]].set(tok)
-            toks.append(tok)
-        return ({"k": k, "v": v}, lengths, last_tokens, jnp.stack(toks))
+            temps = temps.at[slots[i]].set(row_temps[i])
+            lasts.append(jax.lax.dynamic_index_in_dim(
+                logits[i], prompt_lens[i] - 1, keepdims=False))
+        key, sub = jax.random.split(key)
+        # per-row keys derive from the SLOT id: padded duplicate rows share
+        # their source row's slot, so they sample the identical token and
+        # the duplicate last_tokens writes stay idempotent
+        row_keys = jax.vmap(lambda s: jax.random.fold_in(sub, s))(slots)
+        stacked = jnp.stack(lasts)
+        greedy = jnp.argmax(stacked, -1).astype(jnp.int32)
+        scaled = stacked / jnp.maximum(row_temps, 1e-6)[:, None]
+        sampled = jax.vmap(
+            lambda rk, row: jax.random.categorical(rk, row).astype(
+                jnp.int32))(row_keys, scaled)
+        toks = jnp.where(row_temps > 0, sampled, greedy)
+        for i in range(tokens.shape[0]):
+            last_tokens = last_tokens.at[slots[i]].set(toks[i])
+        return ({"k": k, "v": v}, lengths, last_tokens, temps, key, toks)
 
-    def _decode(self, params, cache, lengths, last_tokens, active, *,
-                steps: int):
+    def _decode(self, params, cache, lengths, last_tokens, temps, key,
+                active, *, steps: int):
         """`steps` chained decode iterations inside ONE program (lax.scan):
         a K-token chunk costs one dispatch round-trip instead of K. Slots
         that finish (EOS) mid-chunk keep decoding on device; the host drops
         their surplus tokens, and the slot's next prefill resets its
         state."""
         def body(carry, _):
-            cache, lengths, last_tokens = carry
+            cache, lengths, last_tokens, key = carry
             logits, cache = llama.decode_step(params, last_tokens, cache,
                                               lengths, self.cfg)
-            toks = jnp.argmax(logits, -1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            toks = self._pick(logits, temps, sub)
             lengths = lengths + active.astype(jnp.int32)
             last_tokens = jnp.where(active, toks, last_tokens)
-            return (cache, lengths, last_tokens), toks
+            return (cache, lengths, last_tokens, key), toks
 
-        (cache, lengths, last_tokens), toks = jax.lax.scan(
-            body, (cache, lengths, last_tokens), None, length=steps)
-        return cache, lengths, last_tokens, toks   # toks [steps, n_slots]
+        (cache, lengths, last_tokens, key), toks = jax.lax.scan(
+            body, (cache, lengths, last_tokens, key), None, length=steps)
+        # toks [steps, n_slots]
+        return cache, lengths, last_tokens, temps, key, toks
 
     def _prefill_fn(self, bucket: int, width: int):
         """One compiled program per (bucket, wave-width) pair; widths are
         powers of two so a burst of any size maps onto a tiny program menu."""
         if (bucket, width) not in self._prefill_fns:
             self._prefill_fns[bucket, width] = jax.jit(
-                self._prefill, donate_argnums=(1, 2, 3))
+                self._prefill, donate_argnums=(1, 2, 3, 4, 5))
         return self._prefill_fns[bucket, width]
 
     def _decode_fn(self, steps: int):
@@ -202,18 +237,26 @@ class LLMEngine:
         if steps not in self._decode_fns:
             self._decode_fns[steps] = jax.jit(
                 functools.partial(self._decode, steps=steps),
-                donate_argnums=(1, 2, 3))
+                donate_argnums=(1, 2, 3, 4, 5))
         return self._decode_fns[steps]
 
     # -- public API ----------------------------------------------------------
 
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> int:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0) -> int:
+        import math
+
+        # a NaN/inf/huge value would blow up later INSIDE the engine loop
+        # thread (wave packing), killing serving for every request
+        if not (math.isfinite(temperature) and 0 <= temperature <= 100):
+            raise ValueError("temperature must be finite and in [0, 100]")
         with self._submit_lock:
             req_id = self.scheduler.submit(len(prompt), max_new_tokens,
                                            time.monotonic())
             self._prompts[req_id] = list(prompt)
             self._results[req_id] = []
             self._max_new[req_id] = max_new_tokens
+            self._req_temps[req_id] = float(temperature)
             self._submit_t[req_id] = time.monotonic()
         return req_id
 
@@ -269,25 +312,26 @@ class LLMEngine:
             while True:   # every power of two through next-pow2(n_slots):
                 # a wave of n_slots actions pads UP to that width, so for
                 # e.g. n_slots=6 width 8 must be warm too
-                packed = np.zeros((width, bucket + 2), np.int32)
+                packed = np.zeros((width, bucket + 3), np.int32)
                 packed[:, :2] = 1   # token + prompt_len floor
-                packed[:, -2] = np.arange(width) % self.n_slots
-                packed[:, -1] = 1
-                self.cache, self.lengths, self.last_tokens, _ = \
-                    self._prefill_fn(bucket, width)(
-                        self.params, self.cache, self.lengths,
-                        self.last_tokens, self._put(packed))
+                packed[:, -3] = np.arange(width) % self.n_slots
+                packed[:, -2] = 1
+                (self.cache, self.lengths, self.last_tokens, self.temps,
+                 self.rng_key, _) = self._prefill_fn(bucket, width)(
+                    self.params, self.cache, self.lengths,
+                    self.last_tokens, self.temps, self.rng_key,
+                    self._put(packed))
                 if width >= self.n_slots:
                     break
                 width *= 2
         k = 1
         toks = None
         while k <= self.decode_chunk:
-            self.cache, self.lengths, self.last_tokens, toks = \
-                self._decode_fn(k)(self.params, self.cache, self.lengths,
-                                   self.last_tokens,
-                                   self._put(np.zeros((self.n_slots,),
-                                                      bool)))
+            (self.cache, self.lengths, self.last_tokens, self.temps,
+             self.rng_key, toks) = self._decode_fn(k)(
+                self.params, self.cache, self.lengths, self.last_tokens,
+                self.temps, self.rng_key,
+                self._put(np.zeros((self.n_slots,), bool)))
             k *= 2
         float(toks[0, 0])   # sync: compile + execute finished (axon-safe)
         # reset via _put, not zeros_like: under a mesh the reset arrays must
@@ -295,6 +339,7 @@ class LLMEngine:
         # traced with, or the first live request retraces (= recompiles)
         self.lengths = self._put(np.zeros((self.n_slots,), np.int32))
         self.last_tokens = self._put(np.zeros((self.n_slots,), np.int32))
+        self.temps = self._put(np.zeros((self.n_slots,), np.float32))
         self._host_lengths[:] = 0
 
     def is_done(self, req_id: int) -> bool:
@@ -325,8 +370,9 @@ class LLMEngine:
         self._finish_reasons.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
-                 max_new_tokens: int = 32) -> list[int]:
-        rid = self.submit(prompt, max_new_tokens)
+                 max_new_tokens: int = 32,
+                 temperature: float = 0.0) -> list[int]:
+        rid = self.submit(prompt, max_new_tokens, temperature)
         while not self.is_done(rid):
             if not self.step():
                 raise RuntimeError("engine idle with request outstanding")
@@ -361,18 +407,22 @@ class LLMEngine:
         while width < len(wave):
             width *= 2
         padded = wave + [wave[-1]] * (width - len(wave))
-        # one packed transfer: [tokens ++ slot ++ prompt_len] per row (a
-        # tunneled device pays ~an RTT per transfer; 3 arrays would be 3)
-        packed = np.zeros((width, bucket + 2), np.int32)
+        # one packed transfer: [tokens ++ slot ++ prompt_len ++ temp_milli]
+        # per row (a tunneled device pays ~an RTT per transfer)
+        packed = np.zeros((width, bucket + 3), np.int32)
         for i, a in enumerate(padded):
             prompt = self._prompts[a.req_id]
             packed[i, :len(prompt)] = prompt
-            packed[i, -2] = a.slot
-            packed[i, -1] = a.prompt_len
-        self.cache, self.lengths, self.last_tokens, next_toks = \
-            self._prefill_fn(bucket, width)(
-                self.params, self.cache, self.lengths, self.last_tokens,
-                self._put(packed))
+            packed[i, -3] = a.slot
+            packed[i, -2] = a.prompt_len
+            t = self._req_temps.get(a.req_id, 0.0)
+            # nearest-milli quantization; sub-milli temps still sample
+            # (floor of 1) rather than silently flipping to greedy
+            packed[i, -1] = max(1, round(t * 1000)) if t > 0 else 0
+        (self.cache, self.lengths, self.last_tokens, self.temps,
+         self.rng_key, next_toks) = self._prefill_fn(bucket, width)(
+            self.params, self.cache, self.lengths, self.last_tokens,
+            self.temps, self.rng_key, self._put(packed))
         return next_toks
 
     def _do_decode(self) -> None:
@@ -400,9 +450,10 @@ class LLMEngine:
                and k < remaining):
             k *= 2
 
-        self.cache, self.lengths, self.last_tokens, toks = \
-            self._decode_fn(k)(self.params, self.cache, self.lengths,
-                               self.last_tokens, self._put(active))
+        (self.cache, self.lengths, self.last_tokens, self.temps,
+         self.rng_key, toks) = self._decode_fn(k)(
+            self.params, self.cache, self.lengths, self.last_tokens,
+            self.temps, self.rng_key, self._put(active))
         toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk
         done_slots: set[int] = set()
         for row in toks_np:
@@ -439,4 +490,5 @@ class LLMEngine:
             self._done.add(req_id)
             self._prompts.pop(req_id, None)
             self._max_new.pop(req_id, None)
+            self._req_temps.pop(req_id, None)
         return freed

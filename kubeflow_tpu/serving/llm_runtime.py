@@ -171,7 +171,8 @@ class LLMModel(Model):
                 "'max_new_tokens': N}")
         prompt = [int(t) for t in payload["prompt_tokens"]]
         max_new = int(payload.get("max_new_tokens", 32))
-        rid = self._engine.submit(prompt, max_new)
+        temperature = float(payload.get("temperature", 0.0))
+        rid = self._engine.submit(prompt, max_new, temperature)
         self._wake.set()
         return rid
 

@@ -20,6 +20,7 @@ manages instances and the router splits traffic — the Knative/Istio analog.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -223,7 +224,16 @@ class ModelServer:
             max_new = int(body.get("max_tokens", 16))
         except (TypeError, ValueError):
             raise ProtocolError("max_tokens must be an int") from None
-        return m, {"prompt_tokens": ids, "max_new_tokens": max_new}
+        try:
+            temperature = float(body.get("temperature", 0.0))
+        except (TypeError, ValueError):
+            raise ProtocolError("temperature must be a number") from None
+        if not (math.isfinite(temperature) and 0 <= temperature <= 100):
+            # json.loads happily parses NaN/Infinity; they must not reach
+            # the engine thread
+            raise ProtocolError("temperature must be finite and in [0, 100]")
+        return m, {"prompt_tokens": ids, "max_new_tokens": max_new,
+                   "temperature": temperature}
 
     @staticmethod
     def _completion_error(e: Exception) -> tuple[int, dict[str, Any]]:

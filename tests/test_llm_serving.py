@@ -1,6 +1,8 @@
 """Continuous-batching LLM serving: C++ scheduler, KV-cache decode numerics,
 multi-request engine behavior."""
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -243,29 +245,50 @@ def test_sharded_engine_rejects_bad_kv_split(tiny):
                   mesh=MeshConfig(tensor=4))
 
 
-def test_warmup_covers_live_traffic_no_retrace(tiny):
-    """After warmup, live traffic (single + burst, sharded or not) must hit
-    only already-traced programs — a retrace means a live request would pay
-    XLA compile time (jit trace-cache sizes are the observable)."""
+class _CompileCatcher(logging.Handler):
+    """Captures jax dispatch 'Finished XLA compilation' records — the
+    ground truth for whether a live request paid the compiler (tracing
+    cache entries alone can recur benignly in ~µs with the lowering
+    cache hitting)."""
+
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.compiles: list[str] = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "Finished XLA compilation" in msg:
+            self.compiles.append(msg)
+
+
+def test_warmup_covers_live_traffic_no_compiles(tiny):
+    """After warmup, live traffic (single + burst, sharded or not) must
+    never reach the XLA compiler."""
     from kubeflow_tpu.parallel import MeshConfig
 
     params, cfg = tiny
+    logger = logging.getLogger("jax._src.dispatch")
     for mesh in (None, MeshConfig(tensor=2)):
         engine = LLMEngine(params, cfg, n_slots=3, max_len=32,
                            buckets=(8, 16), mesh=mesh)
         engine.warmup()
-        sizes = {k: f._cache_size()
-                 for k, f in {**engine._prefill_fns,
-                              **engine._decode_fns}.items()}
-        engine.generate([1, 2, 3], 4)
-        rids = [engine.submit([1, 2, 3, 4, 5], 4) for _ in range(3)]
-        engine.run_until_idle()
+        keys_before = set({**engine._prefill_fns, **engine._decode_fns})
+        catcher = _CompileCatcher()
+        old_level = logger.level
+        logger.addHandler(catcher)
+        logger.setLevel(logging.DEBUG)
+        try:
+            engine.generate([1, 2, 3], 4)
+            rids = [engine.submit([1, 2, 3, 4, 5], 4) for _ in range(3)]
+            engine.run_until_idle()
+        finally:
+            logger.removeHandler(catcher)
+            logger.setLevel(old_level)
         assert all(engine.is_done(r) for r in rids)
-        after = {k: engine._prefill_fns.get(k, engine._decode_fns.get(k))
-                 ._cache_size() for k in sizes}
-        assert after == sizes, f"retrace under mesh={mesh}"
+        assert not catcher.compiles, \
+            f"live traffic compiled under mesh={mesh}: {catcher.compiles}"
         assert not (set({**engine._prefill_fns,
-                         **engine._decode_fns}) - set(sizes)), \
+                         **engine._decode_fns}) - keys_before), \
             "live traffic created a program warmup never compiled"
 
 
@@ -490,3 +513,99 @@ def test_openai_unservable_prompts_get_4xx_5xx_not_sse(completion_server):
         conn.close()
         assert resp.status == 400, (stream, out)
         assert "exceeds buckets" in out["error"]
+
+
+# -- temperature sampling -----------------------------------------------------
+
+def test_sampling_deterministic_seeded_and_mixed_with_greedy(tiny):
+    """temperature=0 stays bit-exact greedy even when a sampled request
+    shares the decode batch; sampling is deterministic under a seed."""
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9, 55]
+    a = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16),
+                  sample_seed=7)
+    greedy_rid = a.submit(prompt, 6)                       # temp 0
+    sampled_rid = a.submit(prompt, 6, temperature=1.2)     # shares batch
+    a.run_until_idle()
+    assert a.result(greedy_rid) == _ref_generate(params, cfg, prompt, 6)
+    sampled = a.result(sampled_rid)
+    assert len(sampled) == 6
+    assert all(0 <= t < cfg.vocab_size for t in sampled)
+
+    # same seed + same submission order → identical samples
+    b = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16),
+                  sample_seed=7)
+    b.submit(prompt, 6)
+    rid2 = b.submit(prompt, 6, temperature=1.2)
+    b.run_until_idle()
+    assert b.result(rid2) == sampled
+
+    # a different seed decouples the stream (overwhelmingly likely for
+    # 6 draws over a 128-vocab at temperature 1.2)
+    c = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16),
+                  sample_seed=8)
+    c.submit(prompt, 6)
+    rid3 = c.submit(prompt, 6, temperature=1.2)
+    c.run_until_idle()
+    assert c.result(rid3) != sampled
+
+
+def test_openai_temperature_param(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    def post(body):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", completion_server.port, timeout=60)
+        conn.request("POST", "/openai/v1/completions",
+                     body=_json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = _json.loads(resp.read())
+        conn.close()
+        return resp.status, out
+
+    code, out = post({"model": "llm", "prompt": "Hi", "max_tokens": 4,
+                      "temperature": 0.9})
+    assert code == 200 and len(out["choices"][0]["token_ids"]) == 4
+    assert post({"model": "llm", "prompt": "Hi",
+                 "temperature": -1})[0] == 400
+    assert post({"model": "llm", "prompt": "Hi",
+                 "temperature": "hot"})[0] == 400
+
+
+def test_padded_wave_rows_idempotent_for_sampled_requests(tiny):
+    """A 3-wide sampled burst pads to width 4 by duplicating the last
+    action; slot-derived sampling keys make the duplicate draw the SAME
+    token, so device state matches what the host recorded."""
+    params, cfg = tiny
+    eng = LLMEngine(params, cfg, n_slots=3, max_len=32, buckets=(8,),
+                    sample_seed=5)
+    rids = [eng.submit([5, 6, 7], 3, temperature=1.0) for _ in range(3)]
+    assert eng.step()   # the padded prefill wave
+    last = np.asarray(eng.last_tokens)
+    for slot in range(3):
+        rid = eng.scheduler.slot_request(slot)
+        assert last[slot] == eng.partial_result(rid)[0]
+    eng.run_until_idle()
+    assert all(eng.is_done(r) for r in rids)
+
+
+def test_nonfinite_temperature_rejected(tiny, completion_server):
+    import http.client
+    import json as _json
+
+    with pytest.raises(ValueError):
+        params, cfg = tiny
+        LLMEngine(params, cfg, n_slots=1, max_len=32,
+                  buckets=(8,)).submit([1], 2, temperature=float("nan"))
+    conn = http.client.HTTPConnection("127.0.0.1", completion_server.port,
+                                      timeout=30)
+    conn.request("POST", "/openai/v1/completions",
+                 body=_json.dumps({"model": "llm", "prompt": "Hi",
+                                   "temperature": float("inf")}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = _json.loads(resp.read())
+    conn.close()
+    assert resp.status == 400 and "finite" in out["error"]
