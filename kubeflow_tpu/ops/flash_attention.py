@@ -115,20 +115,17 @@ def flash_attention(
         v = repeat_kv(v, h // hkv)
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
 
-    # The Pallas kernel doesn't take segment ids; packed batches use the
-    # blockwise-XLA path (still O(S·block) memory).
-    if impl in ("auto", "pallas") and segment_ids is None:
+    if impl in ("auto", "pallas"):
         try:
             from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
 
             return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
                                           q_offset=q_offset,
+                                          segment_ids=segment_ids,
                                           block_kv=max(block_kv, 128))
         except (ImportError, NotImplementedError):
             if impl == "pallas":
                 raise
-    elif impl == "pallas":
-        raise NotImplementedError("pallas flash kernel has no segment_ids path")
     block = min(block_kv, k.shape[1])
     return _blockwise_attn(q, k, v, causal=causal, scale=scale,
                            q_offset=q_offset, block_kv=block,

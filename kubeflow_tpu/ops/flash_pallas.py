@@ -26,6 +26,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -51,9 +52,14 @@ def _round_up(x: int, m: int) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_kv,
-                sk):
+def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
+                block_kv, sk, segmented):
+    if segmented:
+        (seg_q_ref, seg_k_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -79,6 +85,9 @@ def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             valid = valid & (q_pos >= k_pos)
+        if segmented:
+            valid = valid & (seg_q_ref[0, 0, 0, :][:, None]
+                             == seg_k_ref[0, 0, 0, :][None, :])
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, 0:1]                         # [bq, 1]
@@ -109,8 +118,18 @@ def _fwd_kernel(qoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0, 0, :] = m_ref[:, 0] + jnp.log(l[:, 0])
 
 
-def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
-    """q,k,v: [BH, S, D] (already padded to block multiples except S)."""
+def _block_rows(seg, s_pad, block):
+    """[BH, S] int32 -> [BH, n, 1, block] padded with -1 (matches no segment);
+    the 4D singleton-sublane layout satisfies the TPU tiling rule (like lse)."""
+    bh, s = seg.shape
+    if s_pad != s:
+        seg = jnp.pad(seg, ((0, 0), (0, s_pad - s)), constant_values=-1)
+    return seg.reshape(bh, s_pad // block, 1, block)
+
+
+def _fwd(q, k, v, seg_q, seg_k, causal, scale, q_offset, interpret, block_q,
+         block_kv):
+    """q,k,v: [BH, S, D]; seg_q [BH, Sq] / seg_k [BH, Sk] or None."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     sq_p = _round_up(sq, block_q)
@@ -121,6 +140,21 @@ def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
         k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0)))
     n_q, n_k = sq_p // block_q, sk_p // block_kv
+    segmented = seg_q is not None
+
+    seg_in_specs, seg_args = [], []
+    if segmented:
+        # seg arrays stay [B, ...] — grid row b (= batch*heads) maps back to
+        # its batch via b // heads, so the h head-copies never materialize
+        hpb = bh // seg_q.shape[0]
+        seg_in_specs = [
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, i, j, *_: (b // hpb, i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, block_kv),
+                         lambda b, i, j, *_: (b // hpb, j, 0, 0)),
+        ]
+        seg_args = [_block_rows(seg_q, sq_p, block_q),
+                    _block_rows(seg_k, sk_p, block_kv)]
 
     qoff = jnp.asarray([q_offset], jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -130,6 +164,7 @@ def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
             pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j, *_: (b, j, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j, *_: (b, j, 0)),
+            *seg_in_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j, *_: (b, i, 0)),
@@ -145,7 +180,7 @@ def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
     )
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv, sk=sk)
+        block_q=block_q, block_kv=block_kv, sk=sk, segmented=segmented)
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -160,7 +195,7 @@ def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
             transcendentals=bh * sq_p * sk_p,
         ),
         interpret=interpret,
-    )(qoff, q, k, v)
+    )(qoff, q, k, v, *seg_args)
     return o[:, :sq], lse.reshape(bh, sq_p)
 
 
@@ -168,8 +203,13 @@ def _fwd(q, k, v, causal, scale, q_offset, interpret, block_q, block_kv):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_kv, sk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_q, block_kv, sk, segmented):
+    if segmented:
+        seg_q_ref, seg_k_ref, dq_ref, dq_acc = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        dq_ref, dq_acc = rest
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -193,6 +233,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             valid = valid & (q_pos >= k_pos)
+        if segmented:
+            valid = valid & (seg_q_ref[0, 0, 0, :][:, None]
+                             == seg_k_ref[0, 0, 0, :][None, :])
         lse = lse_ref[0, 0, 0, :][:, None]
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
@@ -215,9 +258,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_kv, sk):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, block_q, block_kv, sk, segmented):
+    if segmented:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -242,6 +289,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             valid = valid & (q_pos >= k_pos)
+        if segmented:
+            valid = valid & (seg_q_ref[0, 0, 0, :][:, None]
+                             == seg_k_ref[0, 0, 0, :][None, :])
         lse = lse_ref[0, 0, 0, :][:, None]
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)      # [bq, bk]
         do = do_ref[0]
@@ -270,7 +320,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, interpret, block_q, block_kv):
+def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
+         block_q, block_kv):
     bh, sq, d = q.shape
     sk = k.shape[1]
     sq_p = _round_up(sq, block_q)
@@ -289,34 +340,59 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, block_q, block_kv):
     # to satisfy the TPU (sublane, lane) tiling rule.
     lse3 = lse.reshape(bh, n_q, 1, block_q)
     delta3 = delta.reshape(bh, n_q, 1, block_q)
+    segmented = seg_q is not None
+    if segmented:
+        seg_q3 = _block_rows(seg_q, sq_p, block_q)
+        seg_k3 = _block_rows(seg_k, sk_p, block_kv)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec_dq = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
     row_spec = pl.BlockSpec((1, 1, 1, block_q),
                            lambda b, i, j: (b, i, 0, 0))
+    seg_specs_dq, seg_args = [], []
+    if segmented:
+        hpb = bh // seg_q.shape[0]
+        seg_specs_dq = [
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, i, j: (b // hpb, i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, block_kv),
+                         lambda b, i, j: (b // hpb, j, 0, 0)),
+        ]
+        seg_args = [seg_q3, seg_k3]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv, sk=sk),
+                          block_q=block_q, block_kv=block_kv, sk=sk,
+                          segmented=segmented),
         grid=(bh, n_q, n_k),
-        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec,
+                  *seg_specs_dq],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, *seg_args)
 
     q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0))
     row_spec_kv = pl.BlockSpec((1, 1, 1, block_q),
                               lambda b, j, i: (b, i, 0, 0))
+    seg_specs_kv = []
+    if segmented:
+        seg_specs_kv = [
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, j, i: (b // hpb, i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, block_kv),
+                         lambda b, j, i: (b // hpb, j, 0, 0)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_kv=block_kv, sk=sk),
+                          block_q=block_q, block_kv=block_kv, sk=sk,
+                          segmented=segmented),
         grid=(bh, n_k, n_q),
         in_specs=[q_spec_kv, kv_spec, kv_spec, q_spec_kv, row_spec_kv,
-                  row_spec_kv],
+                  row_spec_kv, *seg_specs_kv],
         out_specs=[kv_spec, kv_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype)],
@@ -324,7 +400,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, block_q, block_kv):
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, *seg_args)
 
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
@@ -333,21 +409,31 @@ def _bwd(q, k, v, o, lse, do, causal, scale, interpret, block_q, block_kv):
 # custom_vjp plumbing + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, interpret, block_q, block_kv):
-    o, _ = _fwd(q, k, v, causal, scale, 0, interpret, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, seg_q, seg_k, causal, scale, interpret, block_q,
+           block_kv):
+    o, _ = _fwd(q, k, v, seg_q, seg_k, causal, scale, 0, interpret,
+                block_q, block_kv)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret, block_q, block_kv):
-    o, lse = _fwd(q, k, v, causal, scale, 0, interpret, block_q, block_kv)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, interpret, block_q,
+               block_kv):
+    o, lse = _fwd(q, k, v, seg_q, seg_k, causal, scale, 0, interpret,
+                  block_q, block_kv)
+    return o, (q, k, v, seg_q, seg_k, o, lse)
 
 
 def _flash_bwd(causal, scale, interpret, block_q, block_kv, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, scale, interpret,
-                block_q, block_kv)
+    q, k, v, seg_q, seg_k, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale,
+                      interpret, block_q, block_kv)
+    # int arrays carry float0 cotangents; None segments get None back
+    dseg_q = (None if seg_q is None
+              else np.zeros(seg_q.shape, jax.dtypes.float0))
+    dseg_k = (None if seg_k is None
+              else np.zeros(seg_k.shape, jax.dtypes.float0))
+    return dq, dk, dv, dseg_q, dseg_k
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -355,9 +441,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def pallas_flash_attention(q, k, v, *, causal=True, scale=None,
                            q_offset=0, block_q=256, block_kv=512,
-                           interpret=None):
+                           segment_ids=None, interpret=None):
     """Flash attention via Pallas TPU kernels. BSHD layout, full heads.
 
+    segment_ids: [B, Sk] int32 packed-sequence ids — tokens attend only
+    within equal ids (query rows take the id at their absolute position;
+    continuation prefill slices at q_offset, matching the blockwise-XLA
+    path in flash_attention._blockwise_attn — ops.attention.mha itself
+    rejects Sq != Sk with segment_ids).
     Differentiable when `q_offset == 0` (training/prefill-from-zero); the
     decode/prefill-with-offset path is forward-only. Falls back (raises
     NotImplementedError) for tiny query lengths — flash_attention.py routes
@@ -384,11 +475,22 @@ def pallas_flash_attention(q, k, v, *, causal=True, scale=None,
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        # kept [B, S]: the kernels' BlockSpec index maps fold the grid's
+        # batch*heads row back to its batch, so no per-head copies exist
+        seg_k = segment_ids.astype(jnp.int32)
+        if sq != sk:  # continuation: q rows sit at [q_offset, q_offset+sq)
+            seg_q = jax.lax.dynamic_slice_in_dim(seg_k, q_offset, sq, axis=1)
+        else:
+            seg_q = seg_k
+
     static_offset = isinstance(q_offset, int)
     if static_offset and q_offset == 0:
-        of = _flash(qf, kf, vf, causal, scale, interpret, block_q, block_kv)
+        of = _flash(qf, kf, vf, seg_q, seg_k, causal, scale, interpret,
+                    block_q, block_kv)
     else:  # decode/continuation prefill: forward-only
-        of, _ = _fwd(qf, kf, vf, causal, scale, q_offset, interpret,
-                     block_q, block_kv)
+        of, _ = _fwd(qf, kf, vf, seg_q, seg_k, causal, scale, q_offset,
+                     interpret, block_q, block_kv)
         of = jax.lax.stop_gradient(of)
     return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

