@@ -220,17 +220,26 @@ class JAXJobController(Controller):
         # world) or beyond the current replica count are torn down wholesale
         # — their KTPU_NUM_PROCESSES/rank env no longer describes the gang
         live_pods = []
+        stale_torn_down = False
         for p in pods:
             labels = p["metadata"]["labels"]
             stale = (int(labels.get(GANG_EPOCH_LABEL, "0")) != epoch
                      or int(labels[REPLICA_INDEX_LABEL])
                      >= eff.get(labels[REPLICA_TYPE_LABEL], 0))
             if stale:
+                stale_torn_down = True
                 self.expectations.expect_deletions(key, 1)
                 self.store.try_delete("Pod", p["metadata"]["name"], ns)
             else:
                 live_pods.append(p)
         pods = live_pods
+        if stale_torn_down:
+            # gang DOWN before gang UP: creating the new epoch's pods while
+            # old-epoch pods still run lets the scheduler count the stale
+            # pods toward the new gang and bind a partial epoch (observed:
+            # one new pod binds alone, finishes, and the rest deadlock at
+            # WaitingForGang). Finish the teardown, create next pass.
+            return 0.05
         by_slot = {(p["metadata"]["labels"][REPLICA_TYPE_LABEL],
                     int(p["metadata"]["labels"][REPLICA_INDEX_LABEL])): p
                    for p in pods}
@@ -281,6 +290,7 @@ class JAXJobController(Controller):
                         o["status"].update(
                             elasticReplicas=eff["worker"] - 1,
                             gangEpoch=epoch + 1,
+                            lastResizeTime=time.time(),
                             restartCount=total_restarts),
                         set_condition(o["status"],
                                       JobConditionType.RESTARTING,
@@ -337,13 +347,71 @@ class JAXJobController(Controller):
             self._clean_pods(job)
             self._stop_coordinator(key)
             return 0.0
+        # -- elastic grow -----------------------------------------------------
+        # the rejoin path (⊘ PyTorch ElasticPolicy rdzv re-admit, SURVEY.md
+        # §5.3): a shrunken gang that has run stably for growAfterSeconds
+        # grows back toward min(spec replicas, maxReplicas) one worker at a
+        # time — IF the device inventory can actually place it. Same
+        # mechanism as shrink: whole-gang restart at the new world size,
+        # checkpoint-restore carries the training state across the resize.
+        grow_requeue = self._maybe_grow(job, eff, epoch, restarted)
+
+        hb_requeue = None
         if job["spec"].get("failureDetection"):
             # poll cadence for the heartbeat detector even when nothing else
             # changes — dead ranks only surface via this reconcile path
             ttl = job["spec"]["failureDetection"].get(
                 "heartbeatTtlSeconds", 10.0)
-            return min(max(ttl / 2.0, 0.1), 2.0)
+            hb_requeue = min(max(ttl / 2.0, 0.1), 2.0)
+        # a slow grow poll must never slacken the heartbeat cadence (a
+        # capacity-blocked grow would otherwise delay dead-rank detection
+        # by up to growAfterSeconds)
+        candidates = [r for r in (grow_requeue, hb_requeue) if r is not None]
+        if candidates:
+            return min(candidates)
         return 0.5 if restarted else None
+
+    def _maybe_grow(self, job, eff, epoch, restarted) -> float | None:
+        elastic = job["spec"].get("elasticPolicy")
+        if not elastic or restarted or "worker" not in eff:
+            return None
+        ns = job["metadata"].get("namespace", "default")
+        name = job["metadata"]["name"]
+        status = job["status"]
+        spec_replicas = job["spec"]["replicaSpecs"]["worker"].get(
+            "replicas", 1)
+        target = min(spec_replicas, elastic.get("maxReplicas", spec_replicas))
+        if eff["worker"] >= target:
+            return None
+        # stability gate: no resize/restart churn for growAfterSeconds
+        grow_after = elastic.get("growAfterSeconds", 3.0)
+        last = status.get("lastResizeTime") or status.get("startTime", 0)
+        if time.time() - last < grow_after:
+            return min(grow_after, 1.0)  # re-check when the window elapses
+        # the whole current gang must be Running (not mid-recovery)
+        pods = self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name})
+        running = [p for p in pods
+                   if p["status"].get("phase") == "Running"]
+        if len(running) < sum(eff.values()):
+            return None
+        # capacity gate: only grow if the scheduler could place one more
+        # worker right now (otherwise the gang restart would deadlock
+        # Pending — the all-or-nothing hazard the PodGroup exists for)
+        template = job["spec"]["replicaSpecs"]["worker"].get("template", {})
+        request = template.get("resources", {"cpu": 1})
+        inventory = getattr(self.cluster, "inventory", None)
+        if inventory is not None and not inventory.fits([request]):
+            return grow_after  # capacity may free later; poll slowly
+        new_world = eff["worker"] + 1
+        self.store.mutate(self.kind, name, lambda o: (
+            o["status"].update(
+                elasticReplicas=new_world,
+                gangEpoch=epoch + 1,
+                lastResizeTime=time.time()),
+            set_condition(o["status"], JobConditionType.RESTARTING,
+                          "ElasticResize",
+                          f"gang growing to {new_world} workers")), ns)
+        return 0.1  # next pass tears down the stale epoch and re-creates
 
     # -- helpers --------------------------------------------------------------
 

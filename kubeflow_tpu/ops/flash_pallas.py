@@ -455,13 +455,23 @@ def pallas_flash_attention(q, k, v, *, causal=True, scale=None,
     those to the blockwise-XLA path.
     """
     if interpret is None:
-        # auto mode: compiled on TPU; off-TPU only when the interpreter was
-        # opted into globally, else fall back to the blockwise-XLA path
+        # auto mode: compiled when the COMPILE TARGET is a TPU; off-TPU only
+        # when the interpreter was opted into globally, else fall back to
+        # the blockwise-XLA path. The target is the active mesh's platform
+        # when one is set (it may be a PJRT *topology* — AOT-compiling for
+        # v5e from a CPU-pinned process must still pick the kernel), and
+        # the process default backend otherwise.
         if FORCE_INTERPRET:
             interpret = True
-        elif jax.default_backend() != "tpu":
-            raise NotImplementedError("pallas flash kernel: no TPU backend")
         else:
+            from kubeflow_tpu.parallel.mesh import get_active_mesh
+
+            mesh = get_active_mesh()
+            platform = (mesh.devices.flat[0].platform if mesh is not None
+                        else jax.default_backend())
+            if platform != "tpu":
+                raise NotImplementedError(
+                    f"pallas flash kernel: target platform {platform!r}")
             interpret = False
     b, sq, h, d = q.shape
     sk = k.shape[1]

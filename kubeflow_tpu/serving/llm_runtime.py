@@ -72,14 +72,36 @@ class LLMModel(Model):
         from kubeflow_tpu.models import llama
         from kubeflow_tpu.serving.llm import LLMEngine
 
-        cfg = llama.LlamaConfig(**self._cfg_overrides)
-        params = self._load_params(cfg)
         mesh = None
         if self._mesh:
             # tensor-parallel predictor: config.mesh {tensor: N, ...}
             from kubeflow_tpu.parallel import MeshConfig
+            from kubeflow_tpu.parallel.mesh import make_mesh
 
-            mesh = MeshConfig(**self._mesh)
+            mesh = make_mesh(MeshConfig(**self._mesh))
+        if self._checkpoint and llama.is_hf_checkpoint(self._checkpoint):
+            # HuggingFace-format dir (config.json + safetensors): weights,
+            # architecture AND tokenizer come from one storageUri — the
+            # huggingfaceserver slot (⊘ kserve python/huggingfaceserver).
+            # The mesh goes INTO load_hf so an 8B checkpoint lands directly
+            # sharded — materializing it whole first would OOM the chip the
+            # sharding exists to relieve.
+            cfg = llama.config_from_hf(self._checkpoint,
+                                       **self._cfg_overrides)
+            params, cfg = llama.load_hf(self._checkpoint, cfg, mesh=mesh)
+            import os
+
+            from kubeflow_tpu.serving.tokenizer import (ByteTokenizer,
+                                                        load_tokenizer)
+
+            if isinstance(self.tokenizer, ByteTokenizer) and os.path.exists(
+                    os.path.join(self._checkpoint, "tokenizer.json")):
+                self.tokenizer = load_tokenizer(self._checkpoint)
+            if self._eos_id is None:
+                self._eos_id = getattr(self.tokenizer, "eos_id", None)
+        else:
+            cfg = llama.LlamaConfig(**self._cfg_overrides)
+            params = self._load_params(cfg)
         self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
                                  max_len=self._max_len,
                                  buckets=self._buckets, eos_id=self._eos_id,

@@ -7,9 +7,13 @@ Resolves a model URI to a local path before the predictor loads:
   - `ktpu://<digest>` — fetched from a pipelines ArtifactStore root
     (KTPU_ARTIFACT_ROOT env or explicit root), linking training outputs to
     serving exactly like KFP artifacts feed KServe
-  - `gs://`, `s3://`, `hf://` — recognized but unavailable in this
-    offline environment; raise with a clear message (the cloud SDK hooks
-    belong here).
+  - `hf://<org>/<name>` — resolved against the LOCAL HuggingFace hub cache
+    (HF_HUB_CACHE / HF_HOME layout: models--org--name/snapshots/<rev>);
+    no network — a model that was pre-downloaded serves, anything else
+    raises with the offline explanation. Pairs with models/llama.load_hf.
+  - `gs://`, `s3://` — recognized but unavailable in this offline
+    environment; raise with a clear message (the cloud SDK hooks belong
+    here).
 """
 
 from __future__ import annotations
@@ -20,6 +24,36 @@ import shutil
 
 class StorageError(Exception):
     pass
+
+
+def _resolve_hf_cache(repo: str) -> str:
+    """hf://org/name[@rev] -> snapshot dir in the local HF hub cache.
+
+    Resolution follows the hub layout: refs/<rev> (default `main`) names the
+    snapshot hash; only when no ref file exists (partial/hand-built caches)
+    fall back to the newest snapshot by mtime — mtime alone can point at a
+    stale revision when several are cached."""
+    repo, _, rev = repo.partition("@")
+    hub = os.environ.get("HF_HUB_CACHE") or os.path.join(
+        os.environ.get("HF_HOME", os.path.expanduser("~/.cache/huggingface")),
+        "hub")
+    model_root = os.path.join(hub, "models--" + repo.replace("/", "--"))
+    snap_root = os.path.join(model_root, "snapshots")
+    ref_file = os.path.join(model_root, "refs", rev or "main")
+    if os.path.isfile(ref_file):
+        with open(ref_file) as f:
+            snap = os.path.join(snap_root, f.read().strip())
+        if os.path.isdir(snap):
+            return snap
+    snaps = (sorted((os.path.join(snap_root, s) for s in
+                     os.listdir(snap_root)), key=os.path.getmtime)
+             if os.path.isdir(snap_root) else [])
+    if not snaps:
+        raise StorageError(
+            f"hf://{repo} is not in the local HuggingFace cache ({hub}) and "
+            "this environment has no network; pre-download the model or "
+            "point storageUri at it with file://")
+    return snaps[-1]
 
 
 def download(uri: str, dest_dir: str | None = None, *,
@@ -34,7 +68,9 @@ def download(uri: str, dest_dir: str | None = None, *,
         path = ArtifactStore(root).resolve(uri)
     elif uri.startswith("file://"):
         path = uri[len("file://"):]
-    elif any(uri.startswith(s) for s in ("gs://", "s3://", "hf://",
+    elif uri.startswith("hf://"):
+        path = _resolve_hf_cache(uri[len("hf://"):])
+    elif any(uri.startswith(s) for s in ("gs://", "s3://",
                                          "https://", "http://")):
         raise StorageError(
             f"scheme of {uri!r} requires network access, unavailable here; "

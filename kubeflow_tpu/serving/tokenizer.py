@@ -89,6 +89,7 @@ def load_tokenizer(spec: str | None) -> Any:
 
     class _HF:
         vocab_size = tok.vocab_size
+        eos_id = tok.eos_token_id  # None when the tokenizer defines none
 
         def encode(self, text: str) -> list[int]:
             return tok.encode(text, add_special_tokens=False)

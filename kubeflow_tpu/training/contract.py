@@ -1,0 +1,148 @@
+"""Contract proofs for the BASELINE flagship shape: Llama-3-8B on v5e-16.
+
+BASELINE.json config #3 ("Llama-3-8B multi-host JAXJob on v5e-16") is the
+north-star workload, but no 16-chip slice exists on a dev box. This module
+proves the contract shape anyway, the TPU-native way:
+
+  - AOT-lower the FULL training step (fwd+bwd+adamw) at the true 8B
+    dimensions over a 16-device fsdp x tensor mesh from ShapeDtypeStructs —
+    GSPMD partitions the program without a single parameter materializing.
+  - Compile the lowered module and read XLA's buffer assignment
+    (``compiled.memory_analysis()``) for per-device argument/temp/output
+    bytes; assert the peak fits v5e HBM (16 GiB).
+  - Independently account the sharded train-state bytes analytically from
+    the NamedShardings (exact, backend-independent).
+
+Reference anchor (SURVEY.md §6 config #3): the reference platform would run
+this as an MPIJob launching Megatron containers; here the same contract is a
+mesh shape + logical-axis rules on one jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel import MeshConfig
+
+V5E_HBM_BYTES = 16 * 1024**3  # per-chip HBM on TPU v5e
+
+
+def llama3_8b_overrides(seq_len: int = 8192) -> dict[str, Any]:
+    """The true Llama-3-8B dimensions as Trainer model_overrides
+    (models/llama.py LlamaConfig.llama3_8b, made explicit so the proof can't
+    silently drift from the contract shape)."""
+    return dict(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=seq_len, rope_theta=500000.0,
+        # full remat is the config that fits: against the real v5e compiler
+        # (topology AOT, fsdp8 x tp2, batch 8, seq 8192), remat="minimal"
+        # OOMs at 17.91G of 15.75G HBM; "full" compiles with peak ~11.4G
+        remat=True, remat_policy="full",
+    )
+
+
+def _leaf_device_bytes(leaf: jax.ShapeDtypeStruct) -> int:
+    shard = leaf.sharding.shard_shape(leaf.shape)
+    return math.prod(shard) * leaf.dtype.itemsize
+
+
+def analytic_state_bytes_per_device(trainer) -> int:
+    """Exact per-device train-state residency from the NamedShardings
+    (params + adam moments + step), independent of any backend."""
+    return sum(_leaf_device_bytes(l)
+               for l in jax.tree.leaves(trainer.abstract_state()))
+
+
+def aot_8b_report(n_devices: int = 16, batch: int | None = None,
+                  seq_len: int = 8192, do_compile: bool = True,
+                  n_layers: int | None = None,
+                  topology: str | None = None) -> dict[str, Any]:
+    """Lower (and optionally compile) the 8B train step on an
+    fsdp x tensor=2 mesh over `n_devices`; return the memory evidence.
+
+    Runs anywhere with `n_devices` JAX devices — the driver's virtual-CPU
+    mesh included. `topology` (e.g. "v5e:4x4") instead targets the REAL TPU
+    compiler via PJRT topology AOT: no chips needed, and the memory analysis
+    is the actual v5e HBM budget, not a CPU-buffer-assignment proxy.
+    `do_compile=False` stops after StableHLO lowering (fast; proves sharding
+    propagation at the true dims without invoking the backend compiler).
+    """
+    from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+
+    if topology is not None:
+        from jax.experimental import topologies
+
+        devices = list(topologies.get_topology_desc(topology).devices)
+        n_devices = len(devices)
+    else:
+        devices = jax.devices()[:n_devices]
+    mesh_cfg = MeshConfig(fsdp=n_devices // 2, tensor=2)
+    overrides = llama3_8b_overrides(seq_len)
+    if n_layers is not None:  # reduced-depth variant for execution tests
+        overrides["n_layers"] = n_layers
+    batch = batch if batch is not None else n_devices // 2  # 1 per dp shard
+    trainer = Trainer(
+        TrainerConfig(
+            model="llama", model_overrides=overrides, batch_size=batch,
+            optimizer=OptimizerConfig(warmup_steps=10, total_steps=100),
+            mesh=mesh_cfg),
+        devices=devices)
+
+    abstract_batch = {"tokens": jax.ShapeDtypeStruct(
+        (batch, seq_len), jnp.int32, sharding=trainer.batch_seq_sharding)}
+    lowered = trainer.aot_lower(abstract_batch)
+
+    n_params = sum(math.prod(l.shape) for l in jax.tree.leaves(
+        jax.eval_shape(lambda: trainer.model.init(
+            jax.random.key(0), trainer.model_cfg))))
+    report: dict[str, Any] = {
+        "model": "llama3-8b" if n_layers is None else f"llama3-8b/L{n_layers}",
+        "n_params": n_params,
+        "n_devices": n_devices,
+        "target": topology or str(devices[0].platform),
+        "mesh": {k: v for k, v in
+                 dataclasses.asdict(mesh_cfg.resolved(n_devices)).items()
+                 if v > 1},
+        "batch": batch,
+        "seq_len": seq_len,
+        "analytic_state_bytes_per_device": analytic_state_bytes_per_device(
+            trainer),
+        "lowered": True,
+    }
+    if do_compile:
+        # the TPU compiler enforces its HBM budget here: an oversubscribed
+        # layout fails compile() with RESOURCE_EXHAUSTED ("Used 17.91G of
+        # 15.75G hbm" for remat=minimal), so reaching memory_analysis() at
+        # all already proves the layout fits the target
+        compiled = lowered.compile()
+        report["compiled"] = True
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            report["xla"] = {
+                "argument_size_in_bytes": ma.argument_size_in_bytes,
+                "output_size_in_bytes": ma.output_size_in_bytes,
+                "temp_size_in_bytes": ma.temp_size_in_bytes,
+                "alias_size_in_bytes": ma.alias_size_in_bytes,
+            }
+            # the heap simulator's own peak (accounts donation/aliasing);
+            # 0 on backends that don't model it — fall back to the upper
+            # bound args + temps (outputs alias donated inputs)
+            peak = getattr(ma, "peak_memory_in_bytes", 0) or (
+                ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+            report["peak_bytes_per_device"] = int(peak)
+            report["v5e_hbm_bytes"] = V5E_HBM_BYTES
+            report["fits_v5e_hbm"] = bool(peak <= V5E_HBM_BYTES)
+    return report
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    print(json.dumps(aot_8b_report(n_devices=n)))

@@ -182,7 +182,7 @@ class Trainer:
         # state keeps the sharding it was initialized with (in_shardings=None
         # = "as given"); batch is forced onto the data (+sequence) axes.
         batch_sh = jax.tree.map(self._leaf_sharding, example_batch)
-        jitted = jax.jit(
+        jitted = self._jitted = jax.jit(
             train_step,
             in_shardings=(None, batch_sh),
             donate_argnums=(0,),
@@ -195,6 +195,14 @@ class Trainer:
                 return jitted(state, batch)
 
         return step
+
+    def aot_lower(self, abstract_batch):
+        """AOT-lower the sharded train step from ShapeDtypeStructs alone —
+        no device memory is touched, so an 8B-scale layout can be proven on
+        hosts that could never hold the weights (training/contract.py)."""
+        self._build_step(abstract_batch)
+        with active_mesh(self.mesh):
+            return self._jitted.lower(self.abstract_state(), abstract_batch)
 
     def compiled_step(self, state, example_batch):
         if self._jit_step is None:

@@ -1,0 +1,133 @@
+"""HF safetensors ingestion (VERDICT r1 missing #2): llama.load_hf must
+produce numerics identical to the published-weight reference implementation.
+
+Gold parity: a tiny random transformers LlamaForCausalLM is saved in real
+HF format (config.json + model.safetensors) and reloaded through
+llama.load_hf; our apply() logits must match the torch forward — this pins
+the name map, the [out,in]->[in,out] transposes, the rotate_half RoPE
+convention, GQA head layout, and rms_norm eps in one assertion.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("hf-llama")
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def _our_cfg(path):
+    return llama.config_from_hf(
+        path, dtype=jnp.float32, attention_impl="xla", remat=False)
+
+
+def test_config_inferred_from_hf(hf_dir):
+    path, _ = hf_dir
+    cfg = _our_cfg(path)
+    assert (cfg.vocab_size, cfg.d_model, cfg.n_layers) == (256, 64, 2)
+    assert (cfg.n_heads, cfg.n_kv_heads, cfg.d_ff) == (4, 2, 128)
+    assert cfg.rope_theta == 10000.0
+
+
+def test_load_hf_logits_match_transformers(hf_dir):
+    import torch
+
+    path, model = hf_dir
+    cfg = _our_cfg(path)
+    params, cfg = llama.load_hf(path, cfg)
+    assert llama.is_hf_checkpoint(path)
+
+    tokens = np.array([[3, 250, 7, 42, 1, 99, 100, 17]], np.int32)
+    ours = np.asarray(llama.apply(params, jnp.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_load_hf_tied_embeddings(hf_dir, tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=64,
+        tie_word_embeddings=True)
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    cfg = _our_cfg(str(tmp_path))
+    params, cfg = llama.load_hf(str(tmp_path), cfg)
+    np.testing.assert_array_equal(np.asarray(params["lm_head"]),
+                                  np.asarray(params["embed"]).T)
+    tokens = np.array([[5, 9, 11, 64]], np.int32)
+    ours = np.asarray(llama.apply(params, jnp.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_load_hf_sharded_over_mesh(hf_dir, devices8):
+    """8B-scale loads must land directly sharded: every leaf gets the
+    logical-rule sharding for the mesh (no replica materializes)."""
+    from kubeflow_tpu.parallel import MeshConfig, make_mesh
+
+    path, _ = hf_dir
+    mesh = make_mesh(MeshConfig(fsdp=2, tensor=2), devices=devices8[:4])
+    cfg = _our_cfg(path)
+    params, cfg = llama.load_hf(path, cfg, mesh=mesh)
+    wq = params["layers"]["wq"]  # logical ("layers","embed","qkv")
+    assert wq.sharding.shard_shape(wq.shape) == (2, 64 // 2, 64 // 2)
+    embed = params["embed"]      # logical ("vocab","embed")
+    assert embed.sharding.shard_shape(embed.shape) == (256 // 2, 64 // 2)
+
+
+def test_storage_resolves_hf_cache(hf_dir, tmp_path, monkeypatch):
+    """hf://org/name resolves offline through the local hub-cache layout."""
+    import shutil
+
+    from kubeflow_tpu.serving.storage import StorageError, download
+
+    path, _ = hf_dir
+    snap = tmp_path / "hub" / "models--tiny--llama" / "snapshots" / "abc123"
+    shutil.copytree(path, snap)
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "hub"))
+    assert download("hf://tiny/llama") == str(snap)
+    with pytest.raises(StorageError, match="not in the local"):
+        download("hf://absent/model")
+
+
+def test_llm_runtime_serves_hf_dir(hf_dir):
+    """InferenceService path: storageUri -> HF dir -> engine serves it
+    (weights + architecture from one dir; ⊘ kserve huggingfaceserver)."""
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+
+    path, _ = hf_dir
+    m = LLMModel("hf-llama", uri=path,
+                 model={"dtype": jnp.float32, "attention_impl": "xla",
+                        "remat": False},
+                 n_slots=2, max_len=64, buckets=(16,))
+    m.load()
+    try:
+        out = m.predict({"prompt_tokens": [3, 5, 7], "max_new_tokens": 4})
+        assert len(out["output_tokens"]) == 4
+        assert all(0 <= t < 256 for t in out["output_tokens"])
+    finally:
+        m.unload()

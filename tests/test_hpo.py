@@ -495,9 +495,13 @@ class TestExperimentE2E:
         assert still["status"]["trials"]["created"] == 2
 
     def test_tpe_experiment_improves_over_first_trials(self, hpo_cluster):
+        # parallel=1: with concurrent trials the COMPLETION order feeds TPE
+        # a machine-load-dependent observation sequence, making the final
+        # optimum nondeterministic (flaked in-suite at 0.71); serial trials
+        # keep the seeded sampler's trajectory reproducible
         cluster, _ = hpo_cluster
         cluster.store.create(make_experiment(
-            "tpe-e2e", algorithm="tpe", max_trials=14, parallel=2,
+            "tpe-e2e", algorithm="tpe", max_trials=14, parallel=1,
             settings={"n_initial_points": 4}))
         exp = wait_exp(cluster, "tpe-e2e", timeout=120)
         assert has_condition(exp["status"], JobConditionType.SUCCEEDED)

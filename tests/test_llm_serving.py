@@ -261,6 +261,7 @@ class _CompileCatcher(logging.Handler):
             self.compiles.append(msg)
 
 
+@pytest.mark.slow
 def test_warmup_covers_live_traffic_no_compiles(tiny):
     """After warmup, live traffic (single + burst, sharded or not) must
     never reach the XLA compiler."""

@@ -98,6 +98,7 @@ def test_mixtral_trains(devices8):
     assert np.isfinite(float(m["aux_loss"]))
 
 
+@pytest.mark.slow
 def test_expert_parallel_parity(devices8):
     def losses(trainer):
         state = trainer.init_state()

@@ -50,6 +50,7 @@ def _two_step_losses(trainer):
     return float(m1["loss"]), float(m2["loss"])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_seq_parallel_train_step_parity(devices8, impl):
     ref = _two_step_losses(
@@ -70,6 +71,7 @@ def test_seq_parallel_degrades_without_seq_axis(devices8, impl):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_seq_parallel_composes_with_tensor(devices8):
     ref = _two_step_losses(
         _make_trainer(MeshConfig(data=1), "xla", devices8[:1]))
