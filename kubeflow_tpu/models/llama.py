@@ -155,17 +155,33 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
         # drops to shard_map for its manual collectives (ppermute ring /
         # all-to-all reshard). Mesh comes from parallel.active_mesh —
         # degrade to plain attention when there's no seq axis to ride.
-        from kubeflow_tpu.parallel.mesh import get_active_mesh, mesh_shape
+        # When `sequence` is ALREADY manual (a pipeline stage body that
+        # manualized stage+sequence together), call the per-device bodies
+        # directly — Shardy rejects the nested-island form.
+        from kubeflow_tpu.parallel.mesh import (get_active_mesh,
+                                                manual_axis_names,
+                                                mesh_shape)
 
         mesh = get_active_mesh()
         seq_n = mesh_shape(mesh).get("sequence", 1) if mesh is not None else 1
+        if cfg.attention_impl == "ring" and seq_n > 1 and \
+                segment_ids is not None:
+            raise NotImplementedError(
+                "ring attention does not support packed-sequence "
+                "segment_ids; use attention_impl='ulysses' or 'flash'")
         if seq_n == 1:
             out = mha(q, k, v, causal=True, segment_ids=segment_ids)
+        elif "sequence" in manual_axis_names(mesh):
+            if cfg.attention_impl == "ring":
+                from kubeflow_tpu.ops.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, causal=True)
+            else:
+                from kubeflow_tpu.ops.ulysses import ulysses_attention
+
+                out = ulysses_attention(q, k, v, causal=True,
+                                        segment_ids=segment_ids)
         elif cfg.attention_impl == "ring":
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "ring attention does not support packed-sequence "
-                    "segment_ids; use attention_impl='ulysses' or 'flash'")
             from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
 
             out = ring_attention_sharded(q, k, v, mesh, causal=True)

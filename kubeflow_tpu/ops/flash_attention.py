@@ -21,21 +21,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import repeat_kv
+from kubeflow_tpu.parallel.mesh import manual_axis_names as _manual_axis_names
 
 NEG_INF = -1e30
-
-
-def _manual_axis_names(mesh) -> set:
-    """Mesh axes already bound as manual axes at this trace point (i.e. we
-    are inside a shard_map over them — e.g. a pipeline stage body)."""
-    manual = set()
-    for name in mesh.axis_names:
-        try:
-            jax.lax.axis_size(name)
-            manual.add(name)
-        except Exception:
-            continue
-    return manual
 
 
 def _pallas_island(q, k, v, segment_ids, call):

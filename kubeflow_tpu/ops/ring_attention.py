@@ -108,7 +108,9 @@ def ring_attention_sharded(
     axis_name: str = "sequence",
 ) -> jax.Array:
     """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq->ring,
-    heads->tensor); composes with tensor parallelism (axis dropped at size 1)."""
+    heads->tensor); composes with tensor parallelism (axis dropped at size
+    1). Inside an existing manual region call ``ring_attention`` directly
+    (see ulysses_attention_sharded's docstring for why)."""
     spec = P(("data", "fsdp"), axis_name, "tensor", None)
 
     def body(ql, kl, vl):

@@ -84,7 +84,12 @@ def ulysses_attention_sharded(
     axis_name: str = "sequence",
 ) -> jax.Array:
     """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq,
-    heads->tensor); composes with tensor parallelism (axis dropped at size 1)."""
+    heads->tensor); composes with tensor parallelism (axis dropped at size
+    1). Inside an existing manual region (pipeline stages) call
+    ``ulysses_attention`` directly instead — Shardy rejects nested manual
+    computations whose manual axes follow the outer free axis in the mesh
+    order, so the pipeline manualizes `sequence` alongside `stage` and
+    skips this wrapper (models/llama.py _attention)."""
     spec = P(("data", "fsdp"), axis_name, "tensor", None)
     seg_spec = P(("data", "fsdp"), axis_name)
 

@@ -152,6 +152,22 @@ def get_active_mesh() -> Mesh | None:
     return stack[-1] if stack else None
 
 
+def manual_axis_names(mesh: Mesh) -> set:
+    """Mesh axes already bound as manual axes at this trace point (i.e. we
+    are inside a shard_map over them — e.g. a pipeline stage body). Ops
+    that open their own shard_map islands (pallas flash, ring/ulysses
+    attention, MoE all-to-all) use this to nest correctly: manualize only
+    the remaining axes and bind to the context mesh."""
+    manual = set()
+    for name in mesh.axis_names:
+        try:
+            jax.lax.axis_size(name)
+            manual.add(name)
+        except Exception:
+            continue
+    return manual
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a [batch, ...] array over all data-like axes."""
     return NamedSharding(mesh, PartitionSpec(("data", "fsdp")))

@@ -116,12 +116,12 @@ def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
 
     Numerically identical to llama.loss_fn (same layer math, same shift);
     only the execution schedule differs. Composes with data/fsdp/tensor
-    sharding: the shard_map is manual over `stage` alone, so GSPMD keeps
-    partitioning everything else inside the stage body. Packed-sequence
+    sharding AND the seq-parallel attention islands: the shard_map is
+    manual over `stage` alone, so GSPMD keeps partitioning everything else
+    inside the stage body, and ring/ulysses attention nests as a
+    partial-manual island over the remaining axes. Packed-sequence
     segment_ids and loss_mask are supported (segment ids ride alongside
     each microbatch; the mask applies at the loss, outside the pipe).
-    The seq-parallel attention islands (ring/ulysses) are not composed with
-    PP — they'd nest manual regions over the same mesh; validated upstream.
     """
     from kubeflow_tpu.models import llama
     from kubeflow_tpu.ops.norms import rms_norm
@@ -129,33 +129,43 @@ def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
 
     shape = mesh_shape(mesh)
     n_stages = shape.get(AXIS, 1)
-    if cfg.attention_impl in ("ring", "ulysses") and \
-            shape.get("sequence", 1) > 1:
-        raise NotImplementedError(
-            "pipeline + sequence-parallel attention not composed yet; "
-            "use attention_impl='flash' or 'xla' with stage>1")
     m = n_microbatches or n_stages
     tokens = batch["tokens"]
     seg = batch.get("segment_ids")
-    positions = jnp.arange(tokens.shape[1])
-
-    def stage_fn(layers, h, seg_mb=None):
-        def layer_body(carry, layer):
-            return llama._layer_body(cfg, carry, layer, positions, seg_mb)
-
-        fn = layer_body
-        if cfg.remat:
-            policy = {
-                "minimal":
-                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                "full": jax.checkpoint_policies.nothing_saveable,
-                "none": jax.checkpoint_policies.everything_saveable,
-            }[cfg.remat_policy]
-            fn = jax.checkpoint(fn, policy=policy)
-        h, _ = jax.lax.scan(fn, h, layers)
-        return h
+    # sequence parallelism composes by manualizing `sequence` ALONGSIDE
+    # `stage` (Shardy rejects a nested manual island whose axes follow
+    # `stage` in the mesh order): activations enter seq-sharded, RoPE uses
+    # per-shard global positions, and the ring/ulysses per-device bodies
+    # run directly inside the stage body (models/llama.py _attention).
+    seq_par = (shape.get("sequence", 1) > 1
+               and cfg.attention_impl in ("ring", "ulysses"))
 
     def pipe(layers, x_mb, seg_mb):
+        if seq_par:
+            s_loc = x_mb.shape[2]
+            positions = (jax.lax.axis_index("sequence") * s_loc
+                         + jnp.arange(s_loc))
+        else:
+            positions = jnp.arange(x_mb.shape[2])
+
+        def stage_fn(layers, h, seg_mb=None):
+            def layer_body(carry, layer):
+                return llama._layer_body(cfg, carry, layer, positions,
+                                         seg_mb)
+
+            fn = layer_body
+            if cfg.remat:
+                policy = {
+                    "minimal":
+                        jax.checkpoint_policies
+                        .checkpoint_dots_with_no_batch_dims,
+                    "full": jax.checkpoint_policies.nothing_saveable,
+                    "none": jax.checkpoint_policies.everything_saveable,
+                }[cfg.remat_policy]
+                fn = jax.checkpoint(fn, policy=policy)
+            h, _ = jax.lax.scan(fn, h, layers)
+            return h
+
         # keep every stage-collective in f32: XLA:CPU's AllReducePromotion
         # pass CHECK-fails cloning bf16 all-reduces ("Invalid binary
         # instruction opcode copy"), so (a) the invariant->varying pcast —
@@ -164,6 +174,14 @@ def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
         # stage-dim gather all-reduce below is f32 too. On TPU the ring
         # ppermutes inside gpipe stay bf16 either way.
         x_mb = jax.lax.pcast(x_mb, (AXIS,), to="varying")
+        if seq_par:
+            # weights are sequence-INVARIANT; their cotangent psums over
+            # `sequence`. pcast them varying in f32 (param dtype) so that
+            # psum is f32 — the bf16 form trips the same XLA:CPU
+            # AllReducePromotion CHECK as above
+            layers = jax.tree.map(
+                lambda w: jax.lax.pcast(w, ("sequence",), to="varying"),
+                layers)
         out = gpipe(stage_fn, layers, x_mb.astype(cfg.dtype), extras=seg_mb)
         # leave the manual region with a leading per-stage dim (out_specs
         # P(stage)); the caller slices stage -1 in GSPMD-land — cheaper
@@ -182,11 +200,15 @@ def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
     x_mb = microbatch(x, m).astype(jnp.float32)
     seg_mb = None if seg is None else microbatch(seg, m)
     layer_spec = jax.tree.map(lambda _: P(AXIS), params["layers"])
+    manual = frozenset({AXIS, "sequence"} if seq_par else {AXIS})
+    seq_ax = "sequence" if seq_par else None
+    x_spec = P(None, None, seq_ax) if seq_par else P()
+    seg_spec = P(None, None, seq_ax) if seq_par else P()
     staged = jax.shard_map(
         pipe, mesh=mesh,
-        in_specs=(layer_spec, P(), P()),
-        out_specs=P(AXIS),
-        axis_names=frozenset({AXIS}),
+        in_specs=(layer_spec, x_spec, seg_spec),
+        out_specs=P(AXIS, None, None, seq_ax) if seq_par else P(AXIS),
+        axis_names=manual,
     )(params["layers"], x_mb, seg_mb)
     # only the LAST stage's bank is the pipeline output; back to model dtype
     h_mb = staged[-1].astype(cfg.dtype)

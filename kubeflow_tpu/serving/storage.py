@@ -45,6 +45,16 @@ def _resolve_hf_cache(repo: str) -> str:
             snap = os.path.join(snap_root, f.read().strip())
         if os.path.isdir(snap):
             return snap
+    if rev:
+        # a pinned revision must resolve EXACTLY (ref name or snapshot
+        # hash) — falling back to "newest snapshot" would silently serve
+        # different weights than the pin asked for
+        direct = os.path.join(snap_root, rev)
+        if os.path.isdir(direct):
+            return direct
+        raise StorageError(
+            f"hf://{repo}@{rev} is not in the local HuggingFace cache "
+            f"({hub}); pre-download that revision or drop the pin")
     snaps = (sorted((os.path.join(snap_root, s) for s in
                      os.listdir(snap_root)), key=os.path.getmtime)
              if os.path.isdir(snap_root) else [])

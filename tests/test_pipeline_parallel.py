@@ -108,6 +108,33 @@ def test_pipeline_composes_with_tensor_fsdp(devices8, mesh_cfg):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_pipeline_composes_with_sequence_parallel(devices8, impl):
+    """pp x sp (the final r1 composition guard): ring/ulysses attention
+    nests as a partial-manual island inside the manual-over-stage pipe."""
+    def make(mesh_cfg, devices):
+        t = _make_trainer(mesh_cfg, devices)
+        return t
+
+    ref = _two_step_losses(_make_trainer(MeshConfig(data=1), devices8[:1]))
+    trainer = Trainer(
+        TrainerConfig(
+            model="llama",
+            model_overrides=dict(
+                vocab_size=256, d_model=64, n_layers=4, n_heads=8,
+                n_kv_heads=4, d_ff=128, max_seq_len=64,
+                attention_impl=impl, dtype=jnp.float32, remat=False),
+            batch_size=8,
+            optimizer=OptimizerConfig(warmup_steps=1, total_steps=10),
+            mesh=MeshConfig(data=2, stage=2, sequence=2),
+            log_every=100),
+        devices=devices8)
+    trainer.metrics.echo = False
+    out = _two_step_losses(trainer)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
 def test_pipeline_packed_sequences_and_loss_mask(devices8):
     """segment_ids ride alongside each microbatch; loss_mask applies at the
     loss tail (both refused in r1 — pipeline.py:103-106 then)."""
