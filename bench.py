@@ -5,9 +5,11 @@ accelerator. Prints ONE JSON line:
 Baseline contract (BASELINE.json): >=40% MFU for Llama JAXJob. The reference
 publishes no numbers ("published": {}), so vs_baseline = achieved_MFU / 0.40.
 
-Model size is chosen to fit one chip's HBM with fp32 Adam state; the same
-code path scales to 8B on v5e-16 via MeshConfig (see __graft_entry__.
-dryrun_multichip for the sharded-path proof).
+Model size is chosen to fit one chip's HBM with Adam state (fp32 second
+moment, bf16 first moment — OptimizerConfig.mu_dtype); the same code path
+scales to 8B on v5e-16 via MeshConfig (see __graft_entry__.dryrun_multichip
+for the sharded-path proof and training/contract.py for the v5e-compiler
+memory evidence).
 """
 
 from __future__ import annotations
@@ -56,7 +58,8 @@ def main() -> None:
         model="llama",
         model_overrides=model_overrides,
         batch_size=batch,
-        optimizer=OptimizerConfig(warmup_steps=10, total_steps=1000),
+        optimizer=OptimizerConfig(warmup_steps=10, total_steps=1000,
+                                  mu_dtype="bfloat16" if on_tpu else None),
         mesh=MeshConfig(data=-1),
         log_every=1000,
     ))

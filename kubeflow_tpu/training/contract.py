@@ -41,7 +41,8 @@ def llama3_8b_overrides(seq_len: int = 8192) -> dict[str, Any]:
         n_kv_heads=8, d_ff=14336, max_seq_len=seq_len, rope_theta=500000.0,
         # full remat is the config that fits: against the real v5e compiler
         # (topology AOT, fsdp8 x tp2, batch 8, seq 8192), remat="minimal"
-        # OOMs at 17.91G of 15.75G HBM; "full" compiles with peak ~11.4G
+        # OOMs at 17.91G of 15.75G HBM; "full" compiles, heap-simulator
+        # peak 15.2G (memory_analysis().peak_memory_in_bytes)
         remat=True, remat_policy="full",
     )
 
@@ -61,7 +62,10 @@ def analytic_state_bytes_per_device(trainer) -> int:
 def aot_8b_report(n_devices: int = 16, batch: int | None = None,
                   seq_len: int = 8192, do_compile: bool = True,
                   n_layers: int | None = None,
-                  topology: str | None = None) -> dict[str, Any]:
+                  topology: str | None = None,
+                  mesh_cfg: MeshConfig | None = None,
+                  model_overrides: dict[str, Any] | None = None
+                  ) -> dict[str, Any]:
     """Lower (and optionally compile) the 8B train step on an
     fsdp x tensor=2 mesh over `n_devices`; return the memory evidence.
 
@@ -71,6 +75,9 @@ def aot_8b_report(n_devices: int = 16, batch: int | None = None,
     is the actual v5e HBM budget, not a CPU-buffer-assignment proxy.
     `do_compile=False` stops after StableHLO lowering (fast; proves sharding
     propagation at the true dims without invoking the backend compiler).
+    `mesh_cfg`/`model_overrides` repurpose the same compile-and-measure
+    machinery for other layouts (e.g. the 4D pipeline compile proof in
+    tests/test_contract_8b.py).
     """
     from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
 
@@ -81,8 +88,12 @@ def aot_8b_report(n_devices: int = 16, batch: int | None = None,
         n_devices = len(devices)
     else:
         devices = jax.devices()[:n_devices]
-    mesh_cfg = MeshConfig(fsdp=n_devices // 2, tensor=2)
-    overrides = llama3_8b_overrides(seq_len)
+    if mesh_cfg is None:
+        mesh_cfg = MeshConfig(fsdp=n_devices // 2, tensor=2)
+    if model_overrides is not None:
+        overrides = dict(model_overrides)
+    else:
+        overrides = llama3_8b_overrides(seq_len)
     if n_layers is not None:  # reduced-depth variant for execution tests
         overrides["n_layers"] = n_layers
     batch = batch if batch is not None else n_devices // 2  # 1 per dp shard
@@ -100,8 +111,13 @@ def aot_8b_report(n_devices: int = 16, batch: int | None = None,
     n_params = sum(math.prod(l.shape) for l in jax.tree.leaves(
         jax.eval_shape(lambda: trainer.model.init(
             jax.random.key(0), trainer.model_cfg))))
+    if model_overrides is not None:
+        label = (f"llama-custom(d{overrides.get('d_model')}"
+                 f"xL{overrides.get('n_layers')})")
+    else:
+        label = "llama3-8b" if n_layers is None else f"llama3-8b/L{n_layers}"
     report: dict[str, Any] = {
-        "model": "llama3-8b" if n_layers is None else f"llama3-8b/L{n_layers}",
+        "model": label,
         "n_params": n_params,
         "n_devices": n_devices,
         "target": topology or str(devices[0].platform),

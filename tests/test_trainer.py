@@ -31,6 +31,28 @@ def test_mnist_loss_decreases():
     assert int(state["step"]) == 30
 
 
+def test_bf16_first_moment_halves_mu_state():
+    """OptimizerConfig.mu_dtype='bfloat16': adam's first moment carries
+    bf16 (half the HBM residency + step traffic) while params and the
+    second moment stay f32, and training still converges."""
+    cfg = TrainerConfig(
+        model="mnist_cnn", batch_size=8,
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=2,
+                                  total_steps=50, mu_dtype="bfloat16"),
+        log_every=1)
+    tr = Trainer(cfg)
+    abstract = tr.abstract_state()
+    dtypes = {str(l.dtype) for l in jax.tree.leaves(abstract["opt_state"])}
+    assert "bfloat16" in dtypes and "float32" in dtypes
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree.leaves(abstract["params"]))
+    tr.metrics.echo = False
+    losses = []
+    data = data_lib.for_model("mnist_cnn", tr.model_cfg, 8)
+    tr.train(data, 20, step_callback=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
 def test_llama_tiny_train_dp_tp(devices8):
     tr = make_trainer(
         model="llama", mesh=MeshConfig(data=2, fsdp=2, tensor=2),
