@@ -60,7 +60,7 @@ def analytic_state_bytes_per_device(trainer) -> int:
 
 
 def aot_8b_report(n_devices: int = 16, batch: int | None = None,
-                  seq_len: int = 8192, do_compile: bool = True,
+                  seq_len: int | None = None, do_compile: bool = True,
                   n_layers: int | None = None,
                   topology: str | None = None,
                   mesh_cfg: MeshConfig | None = None,
@@ -90,13 +90,22 @@ def aot_8b_report(n_devices: int = 16, batch: int | None = None,
         devices = jax.devices()[:n_devices]
     if mesh_cfg is None:
         mesh_cfg = MeshConfig(fsdp=n_devices // 2, tensor=2)
+    resolved = mesh_cfg.resolved(n_devices)
     if model_overrides is not None:
         overrides = dict(model_overrides)
+        # derive defaults FROM the overrides so a custom layout can't get
+        # the 8B seq length by accident
+        if seq_len is None:
+            seq_len = overrides.get("max_seq_len", 2048)
     else:
+        seq_len = seq_len if seq_len is not None else 8192
         overrides = llama3_8b_overrides(seq_len)
     if n_layers is not None:  # reduced-depth variant for execution tests
         overrides["n_layers"] = n_layers
-    batch = batch if batch is not None else n_devices // 2  # 1 per dp shard
+    if batch is None:
+        # 1 example per data-parallel shard, times the microbatch need of a
+        # stage axis (the pipeline splits the batch into `stage` microbatches)
+        batch = max(1, resolved.data * resolved.fsdp) * max(1, resolved.stage)
     trainer = Trainer(
         TrainerConfig(
             model="llama", model_overrides=overrides, batch_size=batch,

@@ -223,8 +223,25 @@ class Trainer:
                 else self.batch_sharding)
 
     def shard_batch(self, batch: dict[str, Any]) -> dict[str, Any]:
+        """Host batch -> global device arrays.
+
+        Single-process: a committing device_put. Multi-host (a JAXJob
+        spanning processes via jax.distributed): each host feeds its OWN
+        rows — config.batch_size stays the GLOBAL batch, the data iterator
+        on every host yields batch_size / process_count examples, and the
+        per-host blocks are assembled into one global array without any
+        cross-host transfer (the v5e-16 multi-host feeding path, SURVEY.md
+        §5.8)."""
+        if jax.process_count() == 1:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._leaf_sharding(x)), batch)
+        import numpy as np
+
+        # np (not jnp): committing the local batch to a device first would
+        # add a redundant whole-batch transfer before the per-device slicing
         return jax.tree.map(
-            lambda x: jax.device_put(x, self._leaf_sharding(x)), batch)
+            lambda x: jax.make_array_from_process_local_data(
+                self._leaf_sharding(x), np.asarray(x)), batch)
 
     # -- loop ----------------------------------------------------------------
 
@@ -254,14 +271,28 @@ class Trainer:
             prof = StepProfiler(self.config.profile_dir,
                                 start_step + self.config.profile_start_step,
                                 self.config.profile_num_steps)
+        pending = None
         for i in range(num_steps):
-            batch = self.shard_batch(next(data))
+            batch = (pending if pending is not None
+                     else self.shard_batch(next(data)))
+            pending = None
             if step_fn is None:
                 step_fn = self.compiled_step(state, batch)
             step = start_step + i + 1
             if prof is not None:
                 prof.maybe_start(step)
             state, metrics = step_fn(state, batch)
+            # one-batch device prefetch: the next host->device transfer is
+            # enqueued while this step runs, hiding it behind compute
+            # (device_put/make_array are async dispatches). A data-iterator
+            # failure here must not lose THIS step's log + checkpoint —
+            # stash it and re-raise after the step's bookkeeping runs.
+            data_err: BaseException | None = None
+            if i + 1 < num_steps:
+                try:
+                    pending = self.shard_batch(next(data))
+                except BaseException as e:
+                    data_err = e
             if prof is not None:
                 # sync by fetching a scalar: on the tunneled TPU platform
                 # block_until_ready returns early, a fetch does not
@@ -284,6 +315,8 @@ class Trainer:
             if ckpt is not None:
                 # manager applies save_interval_steps; final step forced below
                 ckpt.save(step, state)
+            if data_err is not None:
+                raise data_err
         if prof is not None:
             prof.close()
         if ckpt is not None:
