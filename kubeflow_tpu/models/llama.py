@@ -326,6 +326,42 @@ def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig):
     return logits, ks, vs
 
 
+def prefill_continue(params: Params, tail_tokens: jax.Array,
+                     k_prefix: jax.Array, v_prefix: jax.Array,
+                     cfg: LlamaConfig):
+    """Continuation prefill: forward only the TAIL of a prompt whose prefix
+    KV is already computed (prefix caching — serving/llm.py).
+
+    tail_tokens: [B, T] (right-padded); k_prefix/v_prefix: [L, B, P, kv, hd]
+    from a previous prefill of the shared prefix. Returns
+    (logits [B, T, vocab] fp32, k_tail, v_tail [L, B, T, kv, hd]).
+    The tail attends causally over prefix+tail (q_offset = P); pad tail
+    positions produce garbage KV the caller masks by true lengths.
+    """
+    b, t = tail_tokens.shape
+    p = k_prefix.shape[2]
+    positions = p + jnp.arange(t)
+    x = params["embed"].astype(cfg.dtype)[tail_tokens]
+
+    def body(carry, inp):
+        x = carry
+        layer, kp, vp = inp  # kp/vp: [B, P, kv, hd]
+        q, k_new, v_new = _project_qkv(cfg, layer, x, positions)
+        k_full = jnp.concatenate([kp.astype(cfg.dtype), k_new], axis=1)
+        v_full = jnp.concatenate([vp.astype(cfg.dtype), v_new], axis=1)
+        out = mha(q, k_full, v_full, causal=True, q_offset=p)
+        x = x + out.reshape(b, t, -1) @ layer["wo"].astype(cfg.dtype)
+        x = _mlp(cfg, x, layer)
+        return x, (k_new, v_new)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["layers"], k_prefix, v_prefix))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
 def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
                 lengths: jax.Array, cfg: LlamaConfig):
     """One continuous-batching decode step over all cache slots.

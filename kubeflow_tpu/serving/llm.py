@@ -41,7 +41,8 @@ class LLMEngine:
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
                  max_queue: int = 1024, eos_id: int | None = None,
                  prefer_native: bool = True, decode_chunk: int = 8,
-                 mesh=None, sample_seed: int = 0):
+                 mesh=None, sample_seed: int = 0,
+                 prefix_cache: bool = False, max_prefixes: int = 4):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         self.params = params
@@ -84,6 +85,20 @@ class LLMEngine:
         self._submit_lock = threading.Lock()
         self._prefill_fns: dict[tuple[int, int], Any] = {}
         self._decode_fns: dict[int, Any] = {}
+        # -- prefix KV cache (vLLM-style shared-prompt reuse, TPU-shaped):
+        # device-resident KV for bucket-length prompt PREFIXES, keyed by the
+        # exact token tuple; a hit skips the prefix's prefill compute and
+        # runs a continuation program over the tail only. Bucket granularity
+        # keeps every program shape static (the TPU constraint everything
+        # here bends around).
+        self.prefix_cache_enabled = prefix_cache
+        self.max_prefixes = max_prefixes
+        self._prefix_store: "collections.OrderedDict[tuple, dict]" = \
+            collections.OrderedDict()
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._cont_fns: dict[tuple[int, int], Any] = {}
+        self._extract_fns: dict[int, Any] = {}
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving (BASELINE #5 at 8B scale: one engine
@@ -185,21 +200,68 @@ class LLMEngine:
             temps = temps.at[slots[i]].set(row_temps[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
                 logits[i], prompt_lens[i] - 1, keepdims=False))
+        key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots, key)
+        for i in range(tokens.shape[0]):
+            last_tokens = last_tokens.at[slots[i]].set(toks[i])
+        return ({"k": k, "v": v}, lengths, last_tokens, temps, key, toks)
+
+    @staticmethod
+    def _sample_last(stacked, row_temps, slots, key):
+        """Greedy/temperature pick over a wave's last-position logits.
+        Per-row keys derive from the SLOT id: padded duplicate rows share
+        their source row's slot, so they sample the identical token and
+        duplicate last_tokens writes stay idempotent."""
         key, sub = jax.random.split(key)
-        # per-row keys derive from the SLOT id: padded duplicate rows share
-        # their source row's slot, so they sample the identical token and
-        # the duplicate last_tokens writes stay idempotent
         row_keys = jax.vmap(lambda s: jax.random.fold_in(sub, s))(slots)
-        stacked = jnp.stack(lasts)
         greedy = jnp.argmax(stacked, -1).astype(jnp.int32)
         scaled = stacked / jnp.maximum(row_temps, 1e-6)[:, None]
         sampled = jax.vmap(
             lambda rk, row: jax.random.categorical(rk, row).astype(
                 jnp.int32))(row_keys, scaled)
-        toks = jnp.where(row_temps > 0, sampled, greedy)
+        return key, jnp.where(row_temps > 0, sampled, greedy)
+
+    def _prefill_cont(self, params, cache, lengths, last_tokens, temps, key,
+                      wave, k_prefix, v_prefix):
+        """Batched continuation prefill against cached prefixes. `wave` is
+        [W, T+3] — tail tokens (prompt[P:], right-padded to the tail
+        bucket) ++ [slot, full_prompt_len, temp_milli] per row; k/v_prefix:
+        [L, W, P, kv, hd] (row i's prefix — different requests may hit
+        DIFFERENT store entries of the same P). Writes prefix+tail KV into
+        each slot and samples next tokens from the tails' last rows; padded
+        duplicate rows repeat their source row (idempotent writes), exactly
+        like _prefill."""
+        tokens, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
+                                      wave[:, -2])
+        row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
+        p = k_prefix.shape[2]
+        logits, ks, vs = llama.prefill_continue(params, tokens, k_prefix,
+                                                v_prefix, self.cfg)
+        t_bucket = tokens.shape[1]
+        k, v = cache["k"], cache["v"]
+        lasts = []
+        for i in range(tokens.shape[0]):   # W is static: unrolled updates
+            k = k.at[:, slots[i], :p].set(k_prefix[:, i])
+            v = v.at[:, slots[i], :p].set(v_prefix[:, i])
+            k = k.at[:, slots[i], p:p + t_bucket].set(ks[:, i])
+            v = v.at[:, slots[i], p:p + t_bucket].set(vs[:, i])
+            lengths = lengths.at[slots[i]].set(prompt_lens[i])
+            temps = temps.at[slots[i]].set(row_temps[i])
+            lasts.append(jax.lax.dynamic_index_in_dim(
+                logits[i], prompt_lens[i] - p - 1, keepdims=False))
+        key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots,
+                                      key)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
         return ({"k": k, "v": v}, lengths, last_tokens, temps, key, toks)
+
+    def _extract_prefix(self, cache, slot, *, p: int):
+        """Slice a freshly prefilled slot's first `p` KV rows into a
+        store-shaped [L, 1, P, kv, hd] entry (stays on device)."""
+        k = jax.lax.dynamic_index_in_dim(cache["k"], slot, axis=1,
+                                         keepdims=False)[:, :p][:, None]
+        v = jax.lax.dynamic_index_in_dim(cache["v"], slot, axis=1,
+                                         keepdims=False)[:, :p][:, None]
+        return k, v
 
     def _decode(self, params, cache, lengths, last_tokens, temps, key,
                 active, *, steps: int):
@@ -230,6 +292,49 @@ class LLMEngine:
             self._prefill_fns[bucket, width] = jax.jit(
                 self._prefill, donate_argnums=(1, 2, 3, 4, 5))
         return self._prefill_fns[bucket, width]
+
+    def _cont_fn(self, p: int, t: int, width: int):
+        """One continuation program per (prefix bucket, tail bucket, wave
+        width); the prefix KV args are NOT donated — store entries are
+        reused (the stacked per-wave copy IS donatable, but stays alive
+        only within the dispatch)."""
+        if (p, t, width) not in self._cont_fns:
+            self._cont_fns[p, t, width] = jax.jit(
+                self._prefill_cont, donate_argnums=(1, 2, 3, 4, 5))
+        return self._cont_fns[p, t, width]
+
+    def _extract_fn(self, p: int):
+        if p not in self._extract_fns:
+            self._extract_fns[p] = jax.jit(
+                functools.partial(self._extract_prefix, p=p))
+        return self._extract_fns[p]
+
+    def _prefix_len_for(self, prompt_len: int) -> int | None:
+        """Largest bucket STRICTLY shorter than the prompt (>=1 tail token
+        must remain to produce the next-token logits)."""
+        cands = [b for b in self.buckets if b < prompt_len]
+        return max(cands) if cands else None
+
+    def _tail_bucket(self, tail_len: int) -> int | None:
+        cands = [b for b in self.buckets if b >= tail_len]
+        return min(cands) if cands else None
+
+    def _prefix_lookup(self, action):
+        """(key, p, t, entry) when the action's prompt hits the prefix
+        store and the tail fits a bucket within the cache; else None."""
+        prompt = self._prompts[action.req_id]
+        p = self._prefix_len_for(len(prompt))
+        if p is None:
+            return None
+        key = tuple(prompt[:p])
+        entry = self._prefix_store.get(key)
+        if entry is None:
+            return None
+        t = self._tail_bucket(len(prompt) - p)
+        if t is None or p + t > self.max_len:
+            return None
+        self._prefix_store.move_to_end(key)  # LRU touch
+        return key, p, t, entry
 
     def _decode_fn(self, steps: int):
         """One compiled program per chunk length (powers of two up to
@@ -283,12 +388,37 @@ class LLMEngine:
                 break   # Decode/None: dropping is safe — the decode pass
                         # re-derives from slot state on the next step()
             actions.append(nxt)
-        # group by bucket; each group prefills as ONE batched program
+        # prefix-cache hits peel off into continuation programs (tail-only
+        # compute); everything else groups by bucket, one batched program
+        # per group. All dispatches go out before any token fetch.
+        cont: list[tuple[PrefillAction, tuple]] = []
+        normal: list[PrefillAction] = []
+        if self.prefix_cache_enabled:
+            for a in actions:
+                hit = self._prefix_lookup(a)
+                (cont.append((a, hit)) if hit is not None
+                 else normal.append(a))
+        else:
+            normal = actions
         groups: dict[int, list[PrefillAction]] = {}
-        for a in actions:
+        for a in normal:
             groups.setdefault(a.bucket_len, []).append(a)
+        cont_groups: dict[tuple[int, int], list] = {}
+        for a, (_key, p, t, entry) in cont:
+            cont_groups.setdefault((p, t), []).append((a, entry))
         dispatched = [(wave, self._dispatch_prefill_wave(bucket, wave))
                       for bucket, wave in groups.items()]
+        dispatched += [([a for a, _ in pairs],
+                        self._dispatch_prefill_cont_wave(p, t, pairs))
+                       for (p, t), pairs in cont_groups.items()]
+        self._prefix_hits += len(cont)
+        if self.prefix_cache_enabled:
+            # store fresh prefixes BEFORE the fetch loop: recording a
+            # request's final token pops its prompt, and extraction only
+            # needs the (device-ordered) prefill to have been dispatched
+            for wave, _ in dispatched[:len(groups)]:
+                for a in wave:
+                    self._maybe_store_prefix(a)
         for wave, toks in dispatched:
             toks_np = np.asarray(toks)   # one fetch per wave
             for i, a in enumerate(wave):
@@ -324,6 +454,34 @@ class LLMEngine:
                 if width >= self.n_slots:
                     break
                 width *= 2
+        if self.prefix_cache_enabled:
+            # continuation menu: every (prefix bucket, tail bucket, width)
+            # that fits the cache, plus the per-prefix extract programs.
+            # buckets[-1] is excluded: the scheduler rejects prompts longer
+            # than the largest bucket, so a full-bucket prefix is
+            # unreachable — warming it would be dead compile time.
+            for p in self.buckets[:-1]:
+                ek, ev = self._extract_fn(p)(self.cache, 0)
+                for t in self.buckets:
+                    if p + t > self.max_len:
+                        continue
+                    width = 1
+                    while True:
+                        packed = np.zeros((width, t + 3), np.int32)
+                        packed[:, 0] = 1
+                        packed[:, -3] = np.arange(width) % self.n_slots
+                        packed[:, -2] = p + 1   # last-row index stays valid
+                        kw = jnp.concatenate([ek] * width, axis=1)
+                        vw = jnp.concatenate([ev] * width, axis=1)
+                        (self.cache, self.lengths, self.last_tokens,
+                         self.temps, self.rng_key, _) = \
+                            self._cont_fn(p, t, width)(
+                                self.params, self.cache, self.lengths,
+                                self.last_tokens, self.temps, self.rng_key,
+                                self._put(packed), kw, vw)
+                        if width >= self.n_slots:
+                            break
+                        width *= 2
         k = 1
         toks = None
         while k <= self.decode_chunk:
@@ -389,12 +547,75 @@ class LLMEngine:
         s = self.scheduler.stats()
         out = {"queued": s.queued, "active": s.active,
                "completed": s.completed, "rejected": s.rejected}
+        if self.prefix_cache_enabled:
+            out["prefix_hits"] = self._prefix_hits
+            out["prefix_misses"] = self._prefix_misses
+            out["prefix_entries"] = len(self._prefix_store)
         if ttfts:
             out["ttft_p50_s"] = float(np.percentile(ttfts, 50))
             out["ttft_p99_s"] = float(np.percentile(ttfts, 99))
         return out
 
     # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _pack_temp(temp: float) -> int:
+        """Nearest-milli quantization; sub-milli temps still sample (floor
+        of 1) rather than silently flipping to greedy. ONE rule for the
+        full-prefill and continuation row layouts."""
+        return max(1, round(temp * 1000)) if temp > 0 else 0
+
+    def _pack_rows(self, width: int, bucket: int, rows) -> np.ndarray:
+        """[tokens ++ slot ++ prompt_len ++ temp_milli] per row, padded up
+        to `width` by repeating the last row (idempotent duplicate writes).
+        rows: list of (tokens, slot, prompt_len, temp)."""
+        padded = list(rows) + [rows[-1]] * (width - len(rows))
+        packed = np.zeros((width, bucket + 3), np.int32)
+        for i, (toks, slot, plen, temp) in enumerate(padded):
+            packed[i, :len(toks)] = toks
+            packed[i, -3] = slot
+            packed[i, -2] = plen
+            packed[i, -1] = self._pack_temp(temp)
+        return packed
+
+    def _dispatch_prefill_cont_wave(self, p: int, t: int, pairs):
+        """Dispatch ONE batched continuation prefill for all hits sharing
+        (prefix bucket, tail bucket) — a shared-prefix burst costs one
+        packed transfer + one dispatch, mirroring _dispatch_prefill_wave.
+        pairs: list of (action, store entry); returns [W] device tokens."""
+        width = 1
+        while width < len(pairs):
+            width *= 2
+        padded = list(pairs) + [pairs[-1]] * (width - len(pairs))
+        rows = [(self._prompts[a.req_id][p:], a.slot, a.prompt_len,
+                 self._req_temps.get(a.req_id, 0.0)) for a, _ in padded]
+        packed = self._pack_rows(width, t, rows)
+        k_prefix = jnp.concatenate([e["k"] for _, e in padded], axis=1)
+        v_prefix = jnp.concatenate([e["v"] for _, e in padded], axis=1)
+        (self.cache, self.lengths, self.last_tokens, self.temps,
+         self.rng_key, toks) = self._cont_fn(p, t, width)(
+            self.params, self.cache, self.lengths, self.last_tokens,
+            self.temps, self.rng_key, self._put(packed),
+            k_prefix, v_prefix)
+        return toks
+
+    def _maybe_store_prefix(self, action) -> None:
+        """After a FULL prefill, bank the slot's bucket-length prefix KV
+        (device-to-device slice; nothing crosses the host)."""
+        prompt = self._prompts.get(action.req_id)
+        if prompt is None:
+            return
+        p = self._prefix_len_for(len(prompt))
+        if p is None:
+            return
+        key = tuple(prompt[:p])
+        if key in self._prefix_store:
+            return
+        self._prefix_misses += 1
+        k, v = self._extract_fn(p)(self.cache, action.slot)
+        self._prefix_store[key] = {"k": k, "v": v}
+        while len(self._prefix_store) > self.max_prefixes:
+            self._prefix_store.popitem(last=False)  # LRU eviction
 
     def _dispatch_prefill_wave(self, bucket: int,
                                wave: list[PrefillAction]):
@@ -406,19 +627,11 @@ class LLMEngine:
         width = 1
         while width < len(wave):
             width *= 2
-        padded = wave + [wave[-1]] * (width - len(wave))
         # one packed transfer: [tokens ++ slot ++ prompt_len ++ temp_milli]
         # per row (a tunneled device pays ~an RTT per transfer)
-        packed = np.zeros((width, bucket + 3), np.int32)
-        for i, a in enumerate(padded):
-            prompt = self._prompts[a.req_id]
-            packed[i, :len(prompt)] = prompt
-            packed[i, -3] = a.slot
-            packed[i, -2] = a.prompt_len
-            t = self._req_temps.get(a.req_id, 0.0)
-            # nearest-milli quantization; sub-milli temps still sample
-            # (floor of 1) rather than silently flipping to greedy
-            packed[i, -1] = max(1, round(t * 1000)) if t > 0 else 0
+        rows = [(self._prompts[a.req_id], a.slot, a.prompt_len,
+                 self._req_temps.get(a.req_id, 0.0)) for a in wave]
+        packed = self._pack_rows(width, bucket, rows)
         (self.cache, self.lengths, self.last_tokens, self.temps,
          self.rng_key, next_toks) = self._prefill_fn(bucket, width)(
             self.params, self.cache, self.lengths, self.last_tokens,

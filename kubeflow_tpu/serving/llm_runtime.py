@@ -40,7 +40,9 @@ class LLMModel(Model):
                  eos_id: int | None = None, checkpoint: str | None = None,
                  seed: int = 0, timeout_s: float = 300.0,
                  mesh: dict[str, int] | None = None,
-                 tokenizer: str | None = None, **_ignored: Any):
+                 tokenizer: str | None = None,
+                 prefix_cache: bool = False, max_prefixes: int = 4,
+                 **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
@@ -54,6 +56,8 @@ class LLMModel(Model):
         self._buckets = tuple(buckets)
         self._eos_id = eos_id
         self._checkpoint = checkpoint or uri
+        self._prefix_cache = prefix_cache
+        self._max_prefixes = max_prefixes
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -105,7 +109,9 @@ class LLMModel(Model):
         self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
                                  max_len=self._max_len,
                                  buckets=self._buckets, eos_id=self._eos_id,
-                                 mesh=mesh)
+                                 mesh=mesh,
+                                 prefix_cache=self._prefix_cache,
+                                 max_prefixes=self._max_prefixes)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()
