@@ -13,6 +13,11 @@ import pytest
 
 import jax
 
+# every test spins up at least one fully-warmed engine (~1 min of CPU
+# compiles): slow lane (the fast lane still covers the engine through
+# test_llm_serving's unmarked tests)
+pytestmark = pytest.mark.slow
+
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.serving.llm import LLMEngine
 
