@@ -27,6 +27,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.ops import quant
 from kubeflow_tpu.ops.attention import mha, repeat_kv
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rope import apply_rope
@@ -135,13 +136,44 @@ def logical_axes(cfg: LlamaConfig) -> Params:
     }
 
 
+QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 for SERVING (ops/quant.py): every matmul weight
+    becomes {"q": int8, "s": f32 per-out-channel}; embed (a gather) and the
+    norms (tiny) stay in param dtype. Decode re-reads all weights per step,
+    so this halves the dominant HBM traffic vs bf16 (4x vs f32) while the
+    MXU still computes in bf16. Training params are never quantized."""
+    out = dict(params)
+    out["layers"] = {
+        k: (quant.quantize_int8(v) if k in QUANT_LEAVES else v)
+        for k, v in params["layers"].items()}
+    out["lm_head"] = quant.quantize_int8(params["lm_head"])
+    return out
+
+
+def logical_axes_for(params: Params, cfg: LlamaConfig) -> Params:
+    """logical_axes matching `params`' ACTUAL structure: quantized leaves
+    expand to {"q": <full axes>, "s": <axes minus the contracted dim>}."""
+    base = logical_axes(cfg)
+
+    def expand(axes, value):
+        if quant.is_quantized(value):
+            return {"q": axes, "s": axes[:-2] + (axes[-1],)}
+        return axes
+
+    return jax.tree.map(expand, base, params,
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
 def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
     b, s, d = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, nh, hd)
-    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
-    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    q = quant.matmul(h, layer["wq"], cfg.dtype).reshape(b, s, nh, hd)
+    k = quant.matmul(h, layer["wk"], cfg.dtype).reshape(b, s, nkv, hd)
+    v = quant.matmul(h, layer["wv"], cfg.dtype).reshape(b, s, nkv, hd)
     q = apply_rope(q, positions, theta=cfg.rope_theta)
     k = apply_rope(k, positions, theta=cfg.rope_theta)
 
@@ -193,14 +225,15 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
     else:
         out = mha(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.reshape(b, s, nh * hd)
-    return x + out @ layer["wo"].astype(cfg.dtype)
+    return x + quant.matmul(out, layer["wo"], cfg.dtype)
 
 
 def _mlp(cfg: LlamaConfig, x, layer):
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = h @ layer["w_gate"].astype(cfg.dtype)
-    up = h @ layer["w_up"].astype(cfg.dtype)
-    return x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cfg.dtype)
+    gate = quant.matmul(h, layer["w_gate"], cfg.dtype)
+    up = quant.matmul(h, layer["w_up"], cfg.dtype)
+    return x + quant.matmul(jax.nn.silu(gate) * up, layer["w_down"],
+                            cfg.dtype)
 
 
 def _layer_body(cfg: LlamaConfig, carry, layer, positions, segment_ids):
@@ -240,8 +273,7 @@ def apply(
             x, _ = body(x, layer)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits
 
 
@@ -293,9 +325,9 @@ def _project_qkv(cfg: LlamaConfig, layer, x, positions):
     b, s, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, nh, hd)
-    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
-    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, nkv, hd)
+    q = quant.matmul(h, layer["wq"], cfg.dtype).reshape(b, s, nh, hd)
+    k = quant.matmul(h, layer["wk"], cfg.dtype).reshape(b, s, nkv, hd)
+    v = quant.matmul(h, layer["wv"], cfg.dtype).reshape(b, s, nkv, hd)
     return (apply_rope(q, positions, theta=cfg.rope_theta),
             apply_rope(k, positions, theta=cfg.rope_theta), v)
 
@@ -315,14 +347,13 @@ def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig):
         x = carry
         q, k, v = _project_qkv(cfg, layer, x, positions)
         out = mha(q, k, v, causal=True)
-        x = x + out.reshape(b, s, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + quant.matmul(out.reshape(b, s, -1), layer["wo"], cfg.dtype)
         x = _mlp(cfg, x, layer)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits, ks, vs
 
 
@@ -350,15 +381,14 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
         k_full = jnp.concatenate([kp.astype(cfg.dtype), k_new], axis=1)
         v_full = jnp.concatenate([vp.astype(cfg.dtype), v_new], axis=1)
         out = mha(q, k_full, v_full, causal=True, q_offset=p)
-        x = x + out.reshape(b, t, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + quant.matmul(out.reshape(b, t, -1), layer["wo"], cfg.dtype)
         x = _mlp(cfg, x, layer)
         return x, (k_new, v_new)
 
     x, (ks, vs) = jax.lax.scan(body, x,
                                (params["layers"], k_prefix, v_prefix))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits, ks, vs
 
 
@@ -393,15 +423,14 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
-        x = x + out.reshape(b, 1, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + quant.matmul(out.reshape(b, 1, -1), layer["wo"], cfg.dtype)
         x = _mlp(cfg, x, layer)
         return x, (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
                                          cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits[:, 0], {"k": ks, "v": vs}
 
 

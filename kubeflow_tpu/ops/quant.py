@@ -1,0 +1,54 @@
+"""Weight-only int8 quantization for serving (SURVEY.md §2.4: the
+Triton-LLM runtime slot ships quantized serving; here it is a framework
+primitive shaped for the TPU).
+
+Decode is HBM-bound: every step re-reads all weights for a handful of
+tokens, so int8 storage cuts the dominant traffic 2x vs bf16 (4x vs f32)
+while the MXU still computes in bf16 — per-output-channel scales keep the
+quantization error ~0.4% of each channel's range, the standard weight-only
+trade. Activations stay un-quantized (no calibration needed).
+
+A quantized weight is a dict leaf {"q": int8 [..., in, out],
+"s": f32 [..., out]}; the matmul helpers below dequantize at the use point
+(XLA fuses the int8->bf16 convert + scale into the matmul read).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8(w: jax.Array) -> dict[str, jax.Array]:
+    """Per-output-channel (last axis) symmetric int8 quantization."""
+    s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    s = jnp.maximum(s, 1e-8) / 127.0            # [..., 1, out]
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.squeeze(-2).astype(jnp.float32)}  # s: [..., out]
+
+
+def is_quantized(wt: Any) -> bool:
+    return isinstance(wt, dict) and "q" in wt and "s" in wt
+
+
+def matmul(x: jax.Array, wt: Any, dtype) -> jax.Array:
+    """x @ W for a raw or quantized weight leaf (x: [..., in]). The scale
+    is applied in f32 and the PRODUCT cast to dtype — casting s itself to
+    bf16 first would add a systematic per-channel bias on top of the
+    quantization error (s is tiny; this costs nothing)."""
+    if is_quantized(wt):
+        return ((x @ wt["q"].astype(dtype)).astype(jnp.float32)
+                * wt["s"]).astype(dtype)
+    return x @ wt.astype(dtype)
+
+
+def matmul_f32_out(x: jax.Array, wt: Any, dtype) -> jax.Array:
+    """Like matmul but accumulating to f32 (the lm-head contract)."""
+    if is_quantized(wt):
+        out = jnp.einsum("...d,dv->...v", x, wt["q"].astype(dtype),
+                         preferred_element_type=jnp.float32)
+        return out * wt["s"]
+    return jnp.einsum("...d,dv->...v", x, wt.astype(dtype),
+                      preferred_element_type=jnp.float32)

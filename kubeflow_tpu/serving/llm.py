@@ -42,9 +42,18 @@ class LLMEngine:
                  max_queue: int = 1024, eos_id: int | None = None,
                  prefer_native: bool = True, decode_chunk: int = 8,
                  mesh=None, sample_seed: int = 0,
-                 prefix_cache: bool = False, max_prefixes: int = 4):
+                 prefix_cache: bool = False, max_prefixes: int = 4,
+                 quantize: str | None = None):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        if quantize == "int8":
+            # weight-only int8 (models/llama.quantize_params): decode is
+            # HBM-bound on weight reads, so int8 storage is the serving
+            # throughput lever; done BEFORE sharding so the shards are int8
+            params = llama.quantize_params(params)
+        self.quantize = quantize
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -124,7 +133,8 @@ class LLMEngine:
         self.mesh = mesh
         self.params = shard_tree(
             self.params,
-            tree_logical_to_sharding(llama.logical_axes(self.cfg), mesh))
+            tree_logical_to_sharding(
+                llama.logical_axes_for(self.params, self.cfg), mesh))
         # no trailing None: GSPMD emits the trimmed spec on program outputs
         # and the jit cache compares specs structurally — a 5-element spec
         # here would retrace every program on its first post-warmup call
