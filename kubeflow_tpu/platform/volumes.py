@@ -22,16 +22,27 @@ VOLUME_KIND = "Volume"
 VIEWER_KIND = "PVCViewer"
 
 
+def default_volumes_root() -> str:
+    """The one place the volumes layout root is decided — shared by this
+    controller and the serving storage-initializer's pvc:// fetcher, so the
+    two halves of the contract can't disagree. KTPU_VOLUMES_ROOT overrides."""
+    return os.environ.get("KTPU_VOLUMES_ROOT") or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-volumes")
+
+
+def volume_path(root: str, ns: str, name: str) -> str:
+    return os.path.join(root, ns, name)
+
+
 class VolumeController(Controller):
     kind = VOLUME_KIND
 
     def __init__(self, cluster, data_root: str | None = None):
         super().__init__(cluster)
-        self.data_root = data_root or os.path.join(
-            os.environ.get("TMPDIR", "/tmp"), "kubeflow-tpu-volumes")
+        self.data_root = data_root or default_volumes_root()
 
     def volume_path(self, ns: str, name: str) -> str:
-        return os.path.join(self.data_root, ns, name)
+        return volume_path(self.data_root, ns, name)
 
     def reconcile(self, vol: dict[str, Any]) -> float | None:
         name = vol["metadata"]["name"]

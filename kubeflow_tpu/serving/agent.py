@@ -125,12 +125,14 @@ class MultiModelAgent:
     """
 
     def __init__(self, repository: ModelRepository | None = None,
-                 max_loaded: int = 4, storage_root: str | None = None):
+                 max_loaded: int = 4, storage_root: str | None = None,
+                 namespace: str | None = None):
         if max_loaded < 1:
             raise ValueError("max_loaded must be >= 1")
         self.repository = repository or ModelRepository()
         self.max_loaded = max_loaded
         self.storage_root = storage_root
+        self.namespace = namespace
         self._lock = threading.Lock()
         self._last_used: dict[str, float] = {}
         self._loading: set[str] = set()
@@ -166,7 +168,8 @@ class MultiModelAgent:
         try:
             local = uri
             if uri and "://" in uri:
-                local = download(uri, artifact_root=self.storage_root)
+                local = download(uri, artifact_root=self.storage_root,
+                                 namespace=self.namespace)
             model = load_model(model_format, name, local, **config)
             self.repository.register(model)  # loads the model
             with self._lock:

@@ -242,7 +242,9 @@ class InferenceServiceController(Controller):
         uri = mspec.get("uri")
         local = None
         if uri:
-            local = storage.download(uri, artifact_root=self.artifact_root)
+            local = storage.download(
+                uri, artifact_root=self.artifact_root,
+                namespace=isvc["metadata"].get("namespace", "default"))
         model = load_model(mspec["modelFormat"], isvc["metadata"]["name"],
                            uri=local, **mspec.get("config", {}))
         tspec = isvc["spec"].get("transformer")

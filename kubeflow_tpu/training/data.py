@@ -10,9 +10,36 @@ the jax.make_array_from_process_local_data path when running multi-process.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Iterator
 
 import numpy as np
+
+
+@dataclasses.dataclass
+class DatasetConfig:
+    """What a job trains on (the `dataset` key of KTPU_TRAINER_CONFIG).
+
+    The reference mounts real data into trainer pods (⊘ kubeflow/examples
+    mnist PVC/GCS volumes); here the same contract is a typed source spec:
+
+      synthetic   — per-model generator (default; benches/HPO/tests)
+      token_file  — flat uint32 token corpus via the C++ prefetching loader
+                    (native/src/data_loader.cpp) with a Python twin fallback
+      array_file  — .npz of named arrays, epoch-cycled minibatches
+
+    Multi-host: every process sees the same config; `make_dataset` gives each
+    process a batch_size/process_count slice (stride-sliced rows for
+    array_file, a process-decorrelated crop seed for token_file/synthetic)
+    and `Trainer.shard_batch` assembles the global array.
+    """
+
+    type: str = "synthetic"
+    path: str | None = None
+    seq_len: int = 128
+    seed: int | None = None  # falls back to TrainerConfig.seed
+    prefer_native: bool = True  # token_file: C++ prefetch ring when built
+    shuffle: bool = True  # array_file
 
 
 def synthetic_tokens(batch_size: int, seq_len: int, vocab_size: int,
@@ -94,3 +121,41 @@ def for_model(model: str, model_cfg, batch_size: int, seq_len: int = 128,
                                 model_cfg.in_channels, model_cfg.n_classes,
                                 seed)
     raise KeyError(f"no synthetic data recipe for model {model!r}")
+
+
+def make_dataset(ds: DatasetConfig, model: str, model_cfg, batch_size: int,
+                 fallback_seed: int = 0) -> Iterator[dict[str, Any]]:
+    """Resolve a DatasetConfig to this process's batch iterator.
+
+    batch_size is the GLOBAL batch (the Trainer.shard_batch contract); each
+    process yields its batch_size/process_count share, decorrelated across
+    hosts by a process-offset seed (token_file/synthetic) or a stride slice
+    of the rows (array_file)."""
+    import jax
+
+    pc, pi = jax.process_count(), jax.process_index()
+    if batch_size % pc:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by {pc} processes")
+    local = batch_size // pc
+    seed = ds.seed if ds.seed is not None else fallback_seed
+
+    if ds.type == "synthetic":
+        return for_model(model, model_cfg, local, seq_len=ds.seq_len,
+                         seed=seed + pi)
+    if ds.type == "token_file":
+        if not ds.path:
+            raise ValueError("dataset.type=token_file requires dataset.path")
+        from kubeflow_tpu.training.loader import token_file_dataset
+
+        return token_file_dataset(ds.path, local, ds.seq_len,
+                                  seed=seed + pi,
+                                  prefer_native=ds.prefer_native)
+    if ds.type == "array_file":
+        if not ds.path:
+            raise ValueError("dataset.type=array_file requires dataset.path")
+        with np.load(ds.path) as z:
+            arrays = {k: z[k][pi::pc] for k in z.files}
+        return array_dataset(arrays, local, shuffle=ds.shuffle, seed=seed)
+    raise ValueError(f"unknown dataset type {ds.type!r} "
+                     "(expected synthetic | token_file | array_file)")

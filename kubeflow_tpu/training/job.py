@@ -33,6 +33,7 @@ from typing import Any
 from kubeflow_tpu.control.executor import worker_target
 from kubeflow_tpu.parallel import MeshConfig
 from kubeflow_tpu.training.checkpoint import restore_or_init
+from kubeflow_tpu.training.data import DatasetConfig
 from kubeflow_tpu.training.metrics_writer import MetricsWriter
 from kubeflow_tpu.training.trainer import (OptimizerConfig, Trainer,
                                            TrainerConfig)
@@ -44,6 +45,7 @@ def config_from_env(env: dict[str, str]) -> tuple[TrainerConfig, int]:
     num_steps = int(raw.pop("num_steps", 100))
     opt = raw.pop("optimizer", {})
     mesh = raw.pop("mesh", {})
+    dataset = raw.pop("dataset", {})
     known = {f.name for f in dataclasses.fields(TrainerConfig)}
     unknown = set(raw) - known
     if unknown:
@@ -51,6 +53,7 @@ def config_from_env(env: dict[str, str]) -> tuple[TrainerConfig, int]:
     cfg = TrainerConfig(**raw)
     cfg.optimizer = OptimizerConfig(**opt)
     cfg.mesh = MeshConfig(**mesh)
+    cfg.dataset = DatasetConfig(**dataset)
     # LR schedule spans the run unless the spec pinned total_steps itself
     # (e.g. chunked training resuming against a longer schedule)
     if "total_steps" not in opt:
@@ -83,8 +86,12 @@ def train_target(env: dict[str, str], cancel: threading.Event) -> None:
         if cancel.is_set():
             raise SystemExit(143)
 
-    data = data_lib.for_model(cfg.model, trainer.model_cfg, cfg.batch_size,
-                              seed=cfg.seed)
-    trainer.train(data, remaining, state=state, step_callback=on_step)
+    data = data_lib.make_dataset(cfg.dataset, cfg.model, trainer.model_cfg,
+                                 cfg.batch_size, fallback_seed=cfg.seed)
+    try:
+        trainer.train(data, remaining, state=state, step_callback=on_step)
+    finally:
+        if hasattr(data, "close"):
+            data.close()
     metrics.close()
     print(f"training done: {num_steps} steps", flush=True)

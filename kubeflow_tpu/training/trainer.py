@@ -26,6 +26,7 @@ from kubeflow_tpu.parallel import (
     logical_to_spec,
     tree_logical_to_sharding,
 )
+from kubeflow_tpu.training.data import DatasetConfig
 from kubeflow_tpu.training.metrics_writer import MetricsWriter
 
 
@@ -54,6 +55,7 @@ class TrainerConfig:
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     sharding_rules: dict[str, Any] = dataclasses.field(default_factory=dict)
+    dataset: DatasetConfig = dataclasses.field(default_factory=DatasetConfig)
     seed: int = 0
     log_every: int = 10
     checkpoint_dir: str | None = None

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Produce a flat uint32 token corpus for `dataset: {type: token_file}` jobs.
+
+Two sources:
+  --text FILE   byte-level tokenize a UTF-8 text file (vocab 256 + BOS=256;
+                pair with model_overrides {"vocab_size": 512})
+  --synthetic   a structured n-gram stream (repeating 64-grams + noise) —
+                the on-disk twin of data.synthetic_tokens, so loss curves
+                from file-backed and generator-backed runs are comparable
+
+The output is what native/src/data_loader.cpp mmaps: little-endian uint32
+token ids, nothing else. The reference's analog is the tokenized-dataset
+artifacts its example trainer images mount from PVC/GCS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+# runnable as `python scripts/gen_corpus.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_corpus(n_tokens: int, vocab_size: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, vocab_size, size=(64,))
+    reps = int(np.ceil(n_tokens / 64))
+    tokens = np.tile(base, reps)[:n_tokens]
+    noise = rng.random(n_tokens) < 0.02
+    return np.where(noise, rng.integers(0, vocab_size, n_tokens),
+                    tokens).astype(np.uint32)
+
+
+def text_corpus(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), dtype=np.uint8)
+    return np.concatenate([[np.uint32(256)], raw.astype(np.uint32)])
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--text", help="UTF-8 text file to byte-tokenize")
+    src.add_argument("--synthetic", action="store_true")
+    p.add_argument("--out", required=True, help="output corpus path (.bin)")
+    p.add_argument("--tokens", type=int, default=1_000_000,
+                   help="synthetic corpus length")
+    p.add_argument("--vocab-size", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    tokens = (text_corpus(args.text) if args.text
+              else synthetic_corpus(args.tokens, args.vocab_size, args.seed))
+    from kubeflow_tpu.training.loader import write_corpus
+
+    write_corpus(args.out, tokens)
+    print(f"wrote {len(tokens)} tokens "
+          f"(max id {int(tokens.max())}) -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

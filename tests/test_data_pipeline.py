@@ -1,0 +1,190 @@
+"""Real-data training through the platform surface (SURVEY.md §2.6 data-path
+row): DatasetConfig routing, a JAXJob whose trainer reads an on-disk token
+corpus through the prefetching loader, and an HPO sweep over the same corpus
+— the reference's jobs-over-real-data contract (⊘ kubeflow/examples mnist
+data volumes) without stubbing the one component class a training platform
+cannot stub."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.control import (Cluster, JAXJobController, new_resource,
+                                  worker_target)  # noqa: F401
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.models import registry
+from kubeflow_tpu.training import data as data_lib
+from kubeflow_tpu.training.data import DatasetConfig, make_dataset
+from kubeflow_tpu.training.job import config_from_env
+from kubeflow_tpu.training.loader import write_corpus
+from scripts.gen_corpus import synthetic_corpus
+
+
+def _llama_cfg():
+    return registry.get("llama").config_cls(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64)
+
+
+# -- routing ------------------------------------------------------------------
+
+
+def test_default_dataset_matches_legacy_synthetic():
+    cfg = _llama_cfg()
+    want = next(data_lib.for_model("llama", cfg, 4, seed=3))
+    got = next(make_dataset(DatasetConfig(), "llama", cfg, 4, fallback_seed=3))
+    np.testing.assert_array_equal(want["tokens"], got["tokens"])
+
+
+def test_token_file_routing_and_determinism(tmp_path):
+    path = str(tmp_path / "c.bin")
+    write_corpus(path, np.arange(5000, dtype=np.uint32) % 97)
+    ds = DatasetConfig(type="token_file", path=path, seq_len=16, seed=7)
+    a = make_dataset(ds, "llama", _llama_cfg(), 4)
+    b = make_dataset(ds, "llama", _llama_cfg(), 4)
+    try:
+        ba, bb = next(a), next(b)
+        assert ba["tokens"].shape == (4, 16)
+        np.testing.assert_array_equal(ba["tokens"], bb["tokens"])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_array_file_routing(tmp_path):
+    path = str(tmp_path / "d.npz")
+    np.savez(path, image=np.zeros((10, 4, 4, 1), np.float32),
+             label=np.arange(10, dtype=np.int32))
+    ds = DatasetConfig(type="array_file", path=path, shuffle=False)
+    batch = next(make_dataset(ds, "mnist_cnn", None, 5))
+    assert batch["image"].shape == (5, 4, 4, 1)
+    np.testing.assert_array_equal(batch["label"], np.arange(5))
+
+
+@pytest.mark.parametrize("bad", [
+    {"type": "token_file"},           # missing path
+    {"type": "array_file"},           # missing path
+    {"type": "parquet"},              # unknown type
+])
+def test_dataset_validation(bad):
+    with pytest.raises(ValueError):
+        make_dataset(DatasetConfig(**bad), "llama", _llama_cfg(), 4)
+
+
+def test_config_from_env_parses_dataset():
+    cfg, _ = config_from_env({"KTPU_TRAINER_CONFIG": json.dumps(
+        {"model": "llama", "dataset": {"type": "token_file",
+                                       "path": "/x.bin", "seq_len": 256}})})
+    assert cfg.dataset.type == "token_file"
+    assert cfg.dataset.path == "/x.bin"
+    assert cfg.dataset.seq_len == 256
+
+
+# -- e2e: JAXJob over a corpus ------------------------------------------------
+
+
+def _corpus(tmp_path, vocab=256, n=200_000):
+    path = str(tmp_path / "corpus.bin")
+    write_corpus(path, synthetic_corpus(n, vocab, seed=0))
+    return path
+
+
+def _trainer_job(name, trainer_cfg, metrics_file):
+    return new_resource("JAXJob", name, spec={
+        "runPolicy": {"backoffLimit": 0},
+        "replicaSpecs": {"worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"backend": "thread", "target": "trainer",
+                         "resources": {"tpu": 1},
+                         "env": {"KTPU_TRAINER_CONFIG": json.dumps(trainer_cfg),
+                                 "KTPU_METRICS_FILE": metrics_file}},
+        }}})
+
+
+def _read_losses(metrics_file):
+    from kubeflow_tpu.training.metrics_writer import read_metrics
+
+    return [(r["step"], r["metrics"]["loss"]) for r in read_metrics(metrics_file)
+            if "loss" in r.get("metrics", {})]
+
+
+def test_jaxjob_trains_on_corpus_loss_decreases(tmp_path):
+    """The VERDICT missing-#1 contract: a JAXJob over an on-disk corpus,
+    through the platform surface (KTPU_TRAINER_CONFIG.dataset), with loss
+    actually decreasing — the loader feeds, the model learns."""
+    corpus = _corpus(tmp_path)
+    metrics_file = str(tmp_path / "metrics.jsonl")
+    cfg = {"model": "llama", "batch_size": 8, "num_steps": 30, "log_every": 1,
+           "model_overrides": {"vocab_size": 256, "d_model": 64, "n_layers": 2,
+                               "n_heads": 4, "n_kv_heads": 2, "d_ff": 128,
+                               "max_seq_len": 64},
+           "dataset": {"type": "token_file", "path": corpus, "seq_len": 64},
+           "mesh": {"data": 1},
+           "optimizer": {"learning_rate": 0.003, "warmup_steps": 3}}
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    with c:
+        c.store.create(_trainer_job("corpus-train", cfg, metrics_file))
+        done = c.wait_for("JAXJob", "corpus-train",
+                          lambda o: is_finished(o["status"]), timeout=180)
+    assert has_condition(done["status"], "Succeeded"), done["status"]
+    losses = _read_losses(metrics_file)
+    assert len(losses) >= 20
+    first = np.mean([v for _, v in losses[:5]])
+    last = np.mean([v for _, v in losses[-5:]])
+    # the corpus is a noisy repeating 64-gram: a learning model must cut
+    # loss well below the initial uniform-ish level
+    assert last < 0.7 * first, (first, last)
+
+
+@pytest.mark.slow
+def test_hpo_sweep_over_corpus(tmp_path):
+    """An Experiment whose trials each train on the corpus file, sweeping
+    learning_rate — HPO over real data, end to end."""
+    from kubeflow_tpu import hpo
+
+    corpus = _corpus(tmp_path)
+    # lr placeholder sits UNQUOTED in the JSON text: trial substitution
+    # interpolates the number in place, yielding a float in the parsed config
+    base = json.dumps(
+        {"model": "llama", "batch_size": 8, "num_steps": 12, "log_every": 1,
+         "model_overrides": {"vocab_size": 256, "d_model": 32, "n_layers": 1,
+                             "n_heads": 2, "n_kv_heads": 2, "d_ff": 64,
+                             "max_seq_len": 64},
+         "dataset": {"type": "token_file", "path": corpus, "seq_len": 64},
+         "mesh": {"data": 1},
+         "optimizer": {"learning_rate": "LR_SLOT", "warmup_steps": 2}},
+    ).replace('"LR_SLOT"', "${trialParameters.lr}")
+    exp = new_resource("Experiment", "corpus-sweep", spec={
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "random"},
+        "parameters": [{"name": "lr", "parameterType": "double",
+                        "feasibleSpace": {"min": 1e-4, "max": 1e-2,
+                                          "scale": "log"}}],
+        "parallelTrialCount": 2,
+        "maxTrialCount": 4,
+        "maxFailedTrialCount": 1,
+        "trialTemplate": {"spec": {"replicaSpecs": {"worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"backend": "thread", "target": "trainer",
+                         "resources": {"tpu": 1},
+                         "env": {"KTPU_TRAINER_CONFIG": base}},
+        }}}}})
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path / "hpo"))
+    try:
+        with c:
+            c.store.create(exp)
+            done = c.wait_for("Experiment", "corpus-sweep",
+                              lambda o: is_finished(o["status"]), timeout=300)
+    finally:
+        hpo.set_default_db(None)
+    assert has_condition(done["status"], JobConditionType.SUCCEEDED)
+    assert done["status"]["trials"]["succeeded"] >= 4
+    opt = done["status"]["currentOptimalTrial"]
+    assert np.isfinite(opt["objectiveValue"])

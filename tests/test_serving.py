@@ -188,6 +188,42 @@ class TestStorage:
         local = serving.download(art.uri, artifact_root=str(tmp_path))
         assert json.load(open(local)) == {"w": [1, 2]}
 
+    def test_scheme_registry_covers_kserve_schemes(self):
+        from kubeflow_tpu.serving.storage import registered_schemes
+        # ⊘ kserve Storage.download's per-scheme dispatch: every scheme it
+        # understands is at least *registered* here (cloud ones raise with
+        # the offline explanation instead of silently unknown)
+        assert {"file", "gs", "s3", "https", "http", "pvc", "hf",
+                "ktpu"} <= set(registered_schemes())
+        with pytest.raises(serving.StorageError, match="unknown storage"):
+            serving.download("az://x")
+
+    def test_register_fetcher_overrides(self, tmp_path):
+        from kubeflow_tpu.serving import storage as st
+        p = tmp_path / "m.bin"
+        p.write_bytes(b"x")
+        orig = st._FETCHERS["gs"]
+        try:
+            @st.register_fetcher("gs")
+            def _fake_gcs(rest, ctx):
+                return str(p)
+            assert serving.download("gs://bucket/m.bin") == str(p)
+        finally:
+            st._FETCHERS["gs"] = orig
+
+    def test_pvc_scheme_resolves_platform_volume(self, tmp_path, monkeypatch):
+        # a bound Volume is a managed dir <root>/<ns>/<name>
+        monkeypatch.setenv("KTPU_VOLUMES_ROOT", str(tmp_path))
+        vol = tmp_path / "default" / "train-out"
+        vol.mkdir(parents=True)
+        (vol / "model.bin").write_bytes(b"w")
+        got = serving.download("pvc://train-out/model.bin")
+        assert got == str(vol / "model.bin")
+        with pytest.raises(serving.StorageError, match="not bound"):
+            serving.download("pvc://missing-vol/model.bin")
+        with pytest.raises(serving.StorageError, match="escapes"):
+            serving.download("pvc://train-out/../../etc/passwd")
+
 
 # -- InferenceService e2e -----------------------------------------------------
 
