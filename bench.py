@@ -138,10 +138,23 @@ def main() -> None:
     extras["loader_feed_margin"] = round(feed_rate / (tokens_per_step / dt), 2)
     if hasattr(data, "close"):
         data.close()
+    # free the headline run's HBM before the extras: state+batches for the
+    # 0.65B proxy are ~10G of the 16G chip, and the longctx/serving/decode
+    # sections each build their own models (observed: keeping these alive
+    # RESOURCE_EXHAUSTs every extra)
+    del state, batch0, batches, step_fn, trainer, metrics
+    try:
+        extras["longctx"] = longctx_bench(on_tpu)
+    except Exception as e:  # long-context point is a best-effort extra
+        extras["longctx_error"] = f"{type(e).__name__}: {e}"
     try:
         extras.update(serving_bench(on_tpu))
     except Exception as e:  # serving metrics are best-effort extras
         extras["serving_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["decode_2k"] = decode_span_bench(on_tpu)
+    except Exception as e:
+        extras["decode_2k_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(achieved_mfu, 4),
@@ -149,6 +162,114 @@ def main() -> None:
         "vs_baseline": round(achieved_mfu / 0.40, 4),
         "extras": extras,
     }))
+
+
+def longctx_bench(on_tpu: bool) -> dict:
+    """Long-context point (SURVEY §5.7 design scale, VERDICT r2 missing #2):
+    the same proxy model at seq 8192 with the Pallas flash kernel + minimal
+    remat — the config that survives the S×S-probs memory wall. Multi-chip
+    long-context (ring over the sequence axis) is proven by the parity tests
+    and dryrun_multichip; this records the single-chip MFU at 8k."""
+    seq = 8192 if on_tpu else 512
+    base = dict(
+        vocab_size=32000, d_model=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=7168, max_seq_len=seq, remat=True, remat_policy="minimal",
+        attention_impl="flash", scan_layers=False,
+    ) if on_tpu else dict(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=128, max_seq_len=seq, attention_impl="flash",
+    )
+    def attempt(policy: str, batch: int) -> dict:
+        # own frame per attempt: on OOM the frame dies with the except
+        # block below, releasing this attempt's state (a stored traceback
+        # would pin ~10G of HBM and starve every later attempt/extra)
+        trainer = Trainer(TrainerConfig(
+            model="llama", model_overrides=dict(base, remat_policy=policy),
+            batch_size=batch,
+            optimizer=OptimizerConfig(warmup_steps=10, total_steps=1000,
+                                      mu_dtype="bfloat16" if on_tpu
+                                      else None),
+            mesh=MeshConfig(data=-1), log_every=1000))
+        trainer.metrics.echo = False
+        data = data_lib.for_model("llama", trainer.model_cfg, batch,
+                                  seq_len=seq)
+        state = trainer.init_state()
+        b0 = trainer.shard_batch(next(data))
+        step_fn = trainer.compiled_step(state, b0)
+        for _ in range(2):
+            state, metrics = step_fn(state, b0)
+        float(metrics["loss"])  # sync (axon: fetch, not block_until_ready)
+        n_meas = 5
+        t0 = time.perf_counter()
+        for _ in range(n_meas):
+            state, metrics = step_fn(state, b0)
+        assert float(metrics["loss"]) == float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / n_meas
+        tokens = batch * seq
+        flops = llama.flops_per_token(trainer.model_cfg, seq) * tokens
+        return {
+            "seq_len": seq, "batch": batch,
+            "mfu": round(mfu(flops, dt, 1), 4),
+            "tokens_per_sec_per_chip": round(tokens / dt, 1),
+            "step_time_s": round(dt, 4),
+            "attention": "pallas-flash", "remat": policy,
+        }
+
+    last_msg = "no config attempted"
+    # seq-8k activations are the constraint: walk down from the fastest
+    # config (minimal remat) to the one that fits (full recompute, batch 1)
+    for policy, batch in (("minimal", 2), ("minimal", 1),
+                          ("full", 4), ("full", 2), ("full", 1)):
+        try:
+            return attempt(policy, batch)
+        except Exception as e:  # OOM at this batch: try the smaller one
+            last_msg = f"{type(e).__name__}: {e}"  # message only, no frames
+    raise RuntimeError(last_msg)
+
+
+def decode_span_bench(on_tpu: bool) -> dict:
+    """Length-aware decode at a 2k-context cache (VERDICT r2 missing #4):
+    short live lengths in a max_len=2048 cache decode against a 128-row
+    attention span instead of all 2048 — the HBM-read lever. Same engine,
+    same requests, span picking ON vs forced full-cache."""
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=3584, max_seq_len=2048, remat=False,
+    ) if on_tpu else llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    max_len = 2048 if on_tpu else 64
+    engine = LLMEngine(params, cfg, n_slots=16 if on_tpu else 2,
+                       max_len=max_len, buckets=(128,) if on_tpu else (16,),
+                       decode_chunk=64 if on_tpu else 8)
+    engine.warmup()
+    prompt = list(range(1, 100)) if on_tpu else [3, 7, 11]
+    new_tokens = 64 if on_tpu else 8
+    n_req = engine.n_slots
+
+    def run() -> float:
+        rids = [engine.submit(prompt, new_tokens) for _ in range(n_req)]
+        t0 = time.perf_counter()
+        engine.run_until_idle()
+        dt = time.perf_counter() - t0
+        assert all(engine.is_done(r) for r in rids)
+        for r in rids:
+            engine.release(r)
+        return n_req * new_tokens / dt
+
+    span_tps = run()
+    real_pick = engine._pick_span
+    engine._pick_span = lambda needed: engine.max_len  # r2 behavior
+    full_tps = run()
+    engine._pick_span = real_pick
+    return {
+        "max_len": max_len, "n_req": n_req, "new_tokens": new_tokens,
+        "decode_chunk": engine.decode_chunk,
+        "tok_per_s_span": round(span_tps, 1),
+        "tok_per_s_full_cache": round(full_tps, 1),
+        "speedup": round(span_tps / full_tps, 2),
+    }
 
 
 def _poisson_run(engine, prompt, new_tokens: int, n_req: int,

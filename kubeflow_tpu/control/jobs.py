@@ -287,6 +287,9 @@ class JAXJobController(Controller):
                     # smaller (checkpoint-restore carries the training state,
                     # §5.3) instead of waiting for the lost capacity
                     self.store.mutate(self.kind, name, lambda o: (
+                        # a shrink supersedes any grow in flight: disarm the
+                        # grow watchdog or it would "revert" the new gang
+                        o["status"].pop("lastStableReplicas", None),
                         o["status"].update(
                             elasticReplicas=eff["worker"] - 1,
                             gangEpoch=epoch + 1,
@@ -378,6 +381,38 @@ class JAXJobController(Controller):
         ns = job["metadata"].get("namespace", "default")
         name = job["metadata"]["name"]
         status = job["status"]
+        # grow-in-flight watchdog (check-then-act hole: between fits() and
+        # the new gang binding, another job can claim the freed chips and
+        # park the grown gang at WaitingForGang forever). A committed grow
+        # records the last-known-good world; if the grown gang hasn't fully
+        # bound within growTimeoutSeconds, revert to it.
+        pending_stable = status.get("lastStableReplicas")
+        if pending_stable is not None:
+            pods = self.store.list("Pod", ns, labels={JOB_NAME_LABEL: name})
+            running = [p for p in pods
+                       if p["status"].get("phase") == "Running"]
+            if len(running) >= sum(eff.values()):
+                # grown gang bound and running: the resize is confirmed
+                self.store.mutate(self.kind, name, lambda o: o[
+                    "status"].pop("lastStableReplicas", None), ns)
+            else:
+                timeout = elastic.get("growTimeoutSeconds", 30.0)
+                waited = time.time() - status.get("lastResizeTime", 0)
+                if waited > timeout:
+                    self.store.mutate(self.kind, name, lambda o: (
+                        o["status"].pop("lastStableReplicas", None),
+                        o["status"].update(
+                            elasticReplicas=pending_stable,
+                            gangEpoch=epoch + 1,
+                            lastResizeTime=time.time()),
+                        set_condition(o["status"],
+                                      JobConditionType.RESTARTING,
+                                      "ElasticGrowReverted",
+                                      f"grown gang failed to bind in "
+                                      f"{timeout:.0f}s; reverting to "
+                                      f"{pending_stable} workers")), ns)
+                    return 0.1
+                return min(max(timeout - waited, 0.1), 1.0)
         spec_replicas = job["spec"]["replicaSpecs"]["worker"].get(
             "replicas", 1)
         target = min(spec_replicas, elastic.get("maxReplicas", spec_replicas))
@@ -407,7 +442,9 @@ class JAXJobController(Controller):
             o["status"].update(
                 elasticReplicas=new_world,
                 gangEpoch=epoch + 1,
-                lastResizeTime=time.time()),
+                lastResizeTime=time.time(),
+                # last-known-good world for the grow watchdog above
+                lastStableReplicas=eff["worker"]),
             set_condition(o["status"], JobConditionType.RESTARTING,
                           "ElasticResize",
                           f"gang growing to {new_world} workers")), ns)

@@ -196,18 +196,14 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
 
         mesh = get_active_mesh()
         seq_n = mesh_shape(mesh).get("sequence", 1) if mesh is not None else 1
-        if cfg.attention_impl == "ring" and seq_n > 1 and \
-                segment_ids is not None:
-            raise NotImplementedError(
-                "ring attention does not support packed-sequence "
-                "segment_ids; use attention_impl='ulysses' or 'flash'")
         if seq_n == 1:
             out = mha(q, k, v, causal=True, segment_ids=segment_ids)
         elif "sequence" in manual_axis_names(mesh):
             if cfg.attention_impl == "ring":
                 from kubeflow_tpu.ops.ring_attention import ring_attention
 
-                out = ring_attention(q, k, v, causal=True)
+                out = ring_attention(q, k, v, causal=True,
+                                     segment_ids=segment_ids)
             else:
                 from kubeflow_tpu.ops.ulysses import ulysses_attention
 
@@ -216,7 +212,8 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention_sharded
 
-            out = ring_attention_sharded(q, k, v, mesh, causal=True)
+            out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                         segment_ids=segment_ids)
         else:
             from kubeflow_tpu.ops.ulysses import ulysses_attention_sharded
 
@@ -393,19 +390,28 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
 
 
 def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
-                lengths: jax.Array, cfg: LlamaConfig):
+                lengths: jax.Array, cfg: LlamaConfig,
+                span: int | None = None):
     """One continuous-batching decode step over all cache slots.
 
     last_tokens: [B] token per slot; lengths: [B] current KV lengths
     (position where this step's KV is written). Returns
     (logits [B, vocab] fp32, updated cache). Inactive slots just produce
     garbage logits the engine ignores — shapes stay static.
+
+    `span` (static) bounds the attention to the cache's first `span` rows —
+    the length-aware decode menu (serving/llm.py): when every active length
+    is < span, attending over max_len would read/compute against rows the
+    mask discards anyway. Decode is HBM-bound on those KV reads at long
+    max_len, so the slice is the throughput lever. Caller guarantees
+    lengths < span; writes still land in the full cache.
     """
     b = last_tokens.shape[0]
     max_len = cache["k"].shape[2]
+    span = max_len if span is None else min(span, max_len)
     x = params["embed"].astype(cfg.dtype)[last_tokens][:, None]  # [B,1,D]
     rows = jnp.arange(b)
-    k_pos = jnp.arange(max_len)
+    k_pos = jnp.arange(span)
 
     def body(carry, inp):
         x = carry
@@ -414,8 +420,8 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
         ck = ck.at[rows, lengths].set(k_new[:, 0])
         cv = cv.at[rows, lengths].set(v_new[:, 0])
         nh, nkv = cfg.n_heads, cfg.n_kv_heads
-        kf = repeat_kv(ck, nh // nkv)
-        vf = repeat_kv(cv, nh // nkv)
+        kf = repeat_kv(jax.lax.slice_in_dim(ck, 0, span, axis=1), nh // nkv)
+        vf = repeat_kv(jax.lax.slice_in_dim(cv, 0, span, axis=1), nh // nkv)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
                             preferred_element_type=jnp.float32)
         logits *= 1.0 / (cfg.head_dim ** 0.5)

@@ -54,6 +54,12 @@ def _pallas_island(q, k, v, segment_ids, call):
             mesh.devices.flat[0].platform != "tpu":
         raise NotImplementedError(
             "pallas flash kernel: non-TPU mesh target")
+    # seq-length gate up here too ("decide early, never abort mid-shard_map"):
+    # seq is unsharded in the island, so the global shapes ARE what the
+    # kernel would see — raising now routes to the blockwise path without
+    # ever constructing the shard_map
+    if q.shape[1] < 128 or k.shape[1] < 128:
+        raise NotImplementedError("pallas flash kernel needs seq >= 128")
     shape = mesh_shape(mesh)
     manual = _manual_axis_names(mesh)
     batch_axes = tuple(a for a in ("data", "fsdp")

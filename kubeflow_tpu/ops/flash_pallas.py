@@ -406,6 +406,30 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
 
 
 # ---------------------------------------------------------------------------
+# ring-attention building blocks
+# ---------------------------------------------------------------------------
+# The ring body (ops/ring_attention.py) reuses the SAME kernels per arriving
+# KV shard: forward emits per-shard (o, lse) merged across ring steps with
+# the online-softmax recurrence; backward reuses the dq/dkv kernels with the
+# GLOBAL lse/o — p = exp(s - lse_global) is then the true partial softmax,
+# so per-shard grads sum to the exact full-attention gradient.
+
+
+def flash_fwd_stats(q, k, v, seg_q=None, seg_k=None, *, causal, scale,
+                    interpret, block_q=256, block_kv=512):
+    """Forward-only (o [BH,S,D] in q.dtype, lse [BH,S] f32)."""
+    return _fwd(q, k, v, seg_q, seg_k, causal, scale, 0, interpret,
+                block_q, block_kv)
+
+
+def flash_bwd_grads(q, k, v, seg_q, seg_k, o, lse, do, *, causal, scale,
+                    interpret, block_q=256, block_kv=512):
+    """(dq, dk, dv) for one q-block/KV-block pair given global (o, lse)."""
+    return _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
+                block_q, block_kv)
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp plumbing + public API
 # ---------------------------------------------------------------------------
 

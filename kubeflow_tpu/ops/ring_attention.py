@@ -3,12 +3,26 @@
 
 Each device holds one sequence shard of Q/K/V. KV shards rotate around the
 ring via ``jax.lax.ppermute`` while every device accumulates its queries'
-attention over each arriving KV block with the online-softmax recurrence —
-compute overlaps the neighbor exchange, and no device ever holds more than
-one extra KV shard. Causal masking across ring steps: block (i attends j)
-is fully unmasked when src_shard < my_shard, diagonal-causal when equal,
-fully masked when src_shard > my_shard (those steps still run for SPMD
-uniformity; their contribution is exp(-inf)=0).
+attention over each arriving KV block — compute overlaps the neighbor
+exchange, and no device ever holds more than one extra KV shard. Causal
+masking across ring steps: block (i attends j) is fully unmasked when
+src_shard < my_shard, diagonal-causal when equal, fully masked when
+src_shard > my_shard (those steps still run for SPMD uniformity; their
+contribution is exactly zero).
+
+Two per-step bodies behind ``impl``:
+  - ``pallas`` (TPU default): the flash_pallas kernels run per arriving KV
+    shard — forward emits per-shard (o, lse) merged across steps with the
+    online-softmax recurrence, backward is a second ring pass reusing the
+    dq/dkv kernels with the GLOBAL lse (p = exp(s - lse_global) is the true
+    partial softmax, so per-shard grads sum exactly). Per-step memory is
+    O(block), never the [B,H,S_loc,S_loc] score matrix.
+  - ``xla``: blockwise einsum online-softmax — differentiable via autodiff,
+    runs anywhere (CPU tests); materializes per-step [B,H,S_loc,S_loc]
+    logits, so it is the correctness twin, not the long-context design.
+
+Packed sequences: ``segment_ids`` [B, S] (sharded to [B, S_loc] locally)
+rotate around the ring alongside KV; tokens attend only within equal ids.
 
 ``ring_attention`` is written to execute *inside* ``jax.shard_map`` with the
 sequence axis named; ``ring_attention_sharded`` wraps it for standalone use.
@@ -16,8 +30,11 @@ sequence axis named; ``ring_attention_sharded`` wraps it for standalone use.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import repeat_kv
@@ -27,15 +44,273 @@ NEG_INF = -1e30
 
 def _block_attn_stats(q, k, v, mask):
     """One block's (numerator, row_max, row_sum) in fp32.
-    q: [B,Sq,H,D] (pre-scaled), k/v: [B,Sk,H,D], mask [Sq,Sk] bool or None."""
+    q: [B,Sq,H,D] (pre-scaled), k/v: [B,Sk,H,D], mask [Sq,Sk]/[B,Sq,Sk] bool
+    or None."""
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
     if mask is not None:
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        mask = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        logits = jnp.where(mask, logits, NEG_INF)
     m = jnp.max(logits, axis=-1)  # [B,H,Sq]
     p = jnp.exp(logits - m[..., None])
     s = jnp.sum(p, axis=-1)
     acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
     return acc, m, s
+
+
+def _ring_xla(q, k, v, seg, axis_name, causal, scale):
+    """Blockwise-XLA ring body (autodiff-differentiable; CPU-friendly).
+    k/v arrive UNexpanded ([B,S,kv,D]): GQA expansion happens per arriving
+    shard so the ring's ppermute traffic stays at kv-head width."""
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_loc, heads, d = q.shape
+    groups = heads // k.shape[2]
+    qf = q.astype(jnp.float32) * scale
+    segmented = seg is not None
+
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shard i -> i+1
+
+    def step(carry, r):
+        acc, m, s, k_cur, v_cur, seg_cur = carry
+        src = (my_idx - r) % n  # whose KV shard we hold at ring step r
+        if causal:
+            q_pos = my_idx * s_loc + jnp.arange(s_loc)
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        if segmented:
+            seg_mask = seg[:, :, None] == seg_cur[:, None, :]  # [B,Sq,Sk]
+            mask = seg_mask if mask is None else mask[None] & seg_mask
+        blk_acc, blk_m, blk_s = _block_attn_stats(
+            qf, repeat_kv(k_cur, groups).astype(jnp.float32),
+            repeat_kv(v_cur, groups).astype(jnp.float32), mask)
+        new_m = jnp.maximum(m, blk_m)
+        c_old = jnp.exp(m - new_m)
+        c_blk = jnp.exp(blk_m - new_m)
+        new_s = s * c_old + blk_s * c_blk
+        new_acc = (acc * c_old.transpose(0, 2, 1)[..., None]
+                   + blk_acc * c_blk.transpose(0, 2, 1)[..., None])
+        # rotate KV (+segments) to the next device; overlaps with compute
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        seg_nxt = (jax.lax.ppermute(seg_cur, axis_name, perm)
+                   if segmented else seg_cur)
+        return (new_acc, new_m, new_s, k_nxt, v_nxt, seg_nxt), None
+
+    # Accumulators derived from q so they carry q's varying-manual-axes type
+    # (fresh jnp.zeros would be axis-invariant and fail scan's carry check).
+    bhs = qf[..., 0].transpose(0, 2, 1)  # [B,H,S_loc]
+    init = (
+        jnp.zeros_like(qf),
+        jnp.full_like(bhs, NEG_INF),
+        jnp.zeros_like(bhs),
+        k, v,
+        seg if segmented else jnp.zeros((), jnp.int32),
+    )
+    (acc, m, s, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    denom = jnp.maximum(s, 1e-37).transpose(0, 2, 1)[..., None]  # [B,Sq,H,1]
+    return (acc / denom).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas ring body
+# ---------------------------------------------------------------------------
+
+
+def _flat(x):  # [B,S,H,D] -> [BH,S,D]
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unflat(x, b, h):  # [BH,S,D] -> [B,S,H,D]
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _expand_flat(kf, b, groups):
+    """GQA expansion in flat layout: [B*hkv,S,D] -> [B*h,S,D] (kv-head-major
+    order, matching repeat_kv's BSHD convention)."""
+    if groups == 1:
+        return kf
+    bh_kv, s, d = kf.shape
+    hkv = bh_kv // b
+    return jnp.repeat(kf.reshape(b, hkv, s, d), groups,
+                      axis=1).reshape(b * hkv * groups, s, d)
+
+
+def _reduce_flat(dk, b, groups):
+    """Adjoint of _expand_flat: sum expanded-head grads back to kv heads."""
+    if groups == 1:
+        return dk
+    bh, s, d = dk.shape
+    hkv = bh // (b * groups)
+    return dk.reshape(b, hkv, groups, s, d).sum(axis=2).reshape(
+        b * hkv, s, d)
+
+
+def _ring_blocks(s_loc: int, block_q: int, block_kv: int) -> tuple[int, int]:
+    bq = min(block_q, max(128, -(-s_loc // 128) * 128))
+    bkv = min(block_kv, max(128, -(-s_loc // 128) * 128))
+    return bq, bkv
+
+
+def _ring_pallas_fwd_loop(qf, kf, vf, seg, seg_q, b, groups, axis_name,
+                          causal, scale, interpret, block_q, block_kv):
+    """qf: [B*h, S_loc, D]; kf/vf: [B*hkv, S_loc, D] (UNexpanded — the ring
+    rotates kv-width shards; GQA expansion happens per arriving shard).
+    Returns (o [B*h,S,D] f32, lse [B*h,S] f32)."""
+    from kubeflow_tpu.ops.flash_pallas import flash_fwd_stats
+
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_loc = qf.shape[1]
+    segmented = seg is not None
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def call(k_cur, seg_cur, v_cur, diag):
+        o, lse = flash_fwd_stats(
+            qf, _expand_flat(k_cur, b, groups), _expand_flat(v_cur, b, groups),
+            seg_q, seg_cur if segmented else None,
+            causal=diag, scale=scale, interpret=interpret,
+            block_q=block_q, block_kv=block_kv)
+        return o.astype(jnp.float32), lse[:, :s_loc]
+
+    def step(carry, r):
+        out, lse, k_cur, v_cur, seg_cur = carry
+        src = (my_idx - r) % n
+        if causal:
+            # 0: diagonal (own shard), 1: fully unmasked (past), 2: skip
+            which = jnp.where(src == my_idx, 0, jnp.where(src < my_idx, 1, 2))
+            o_r, lse_r = jax.lax.switch(which, [
+                lambda k_, v_, s_: call(k_, s_, v_, True),
+                lambda k_, v_, s_: call(k_, s_, v_, False),
+                lambda k_, v_, s_: (jnp.zeros_like(out),
+                                    jnp.full_like(lse, NEG_INF)),
+            ], k_cur, v_cur, seg_cur)
+        else:
+            o_r, lse_r = call(k_cur, seg_cur, v_cur, False)
+        new_lse = jnp.logaddexp(lse, lse_r)
+        c_old = jnp.exp(lse - new_lse)[..., None]
+        c_new = jnp.exp(lse_r - new_lse)[..., None]
+        new_out = out * c_old + o_r * c_new
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        seg_nxt = (jax.lax.ppermute(seg_cur, axis_name, perm)
+                   if segmented else seg_cur)
+        return (new_out, new_lse, k_nxt, v_nxt, seg_nxt), None
+
+    init = (
+        jnp.zeros_like(qf, jnp.float32),
+        jnp.full_like(qf[..., 0], NEG_INF, dtype=jnp.float32),
+        kf, vf,
+        seg if segmented else jnp.zeros((), jnp.int32),
+    )
+    (out, lse, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return out, lse
+
+
+def _pad_lse(lse, block_q):
+    """Pad merged [BH,S] lse rows up to the kernel's padded length with a
+    large POSITIVE value so padded rows give p = exp(s - lse) = 0."""
+    s = lse.shape[1]
+    s_pad = -(-s // block_q) * block_q
+    if s_pad == s:
+        return lse
+    return jnp.pad(lse, ((0, 0), (0, s_pad - s)), constant_values=1e9)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, seg, axis_name, causal, scale, interpret, block_q,
+                block_kv):
+    out, _ = _ring_flash_fwd(q, k, v, seg, axis_name, causal, scale,
+                             interpret, block_q, block_kv)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, seg, axis_name, causal, scale, interpret,
+                    block_q, block_kv):
+    b, s_loc, h, d = q.shape
+    out, lse = _ring_pallas_fwd_loop(
+        _flat(q), _flat(k), _flat(v), seg, seg, b, h // k.shape[2],
+        axis_name, causal, scale, interpret, block_q, block_kv)
+    o = _unflat(out, b, h).astype(q.dtype)
+    return o, (q, k, v, seg, o, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, interpret, block_q, block_kv,
+                    res, do):
+    from kubeflow_tpu.ops.flash_pallas import flash_bwd_grads
+
+    q, k, v, seg, o, lse = res
+    b, s_loc, h, d = q.shape
+    groups = h // k.shape[2]
+    qf, kf, vf = _flat(q), _flat(k), _flat(v)
+    of, dof = _flat(o), _flat(do)
+    lse_p = _pad_lse(lse, block_q)
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    segmented = seg is not None
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def grads(k_cur, v_cur, seg_cur, diag):
+        dq_p, dk_e, dv_e = flash_bwd_grads(
+            qf, _expand_flat(k_cur, b, groups), _expand_flat(v_cur, b, groups),
+            seg, seg_cur if segmented else None,
+            of, lse_p, dof, causal=diag, scale=scale, interpret=interpret,
+            block_q=block_q, block_kv=block_kv)
+        # grads come back at q-head width; fold to kv width so the rotating
+        # (dk, dv) accumulators stay at the ring's kv-shard size
+        return (dq_p, _reduce_flat(dk_e.astype(jnp.float32), b, groups),
+                _reduce_flat(dv_e.astype(jnp.float32), b, groups))
+
+    def step(carry, r):
+        dq, k_cur, v_cur, seg_cur, dk_cur, dv_cur = carry
+        src = (my_idx - r) % n
+        if causal:
+            which = jnp.where(src == my_idx, 0, jnp.where(src < my_idx, 1, 2))
+            dq_p, dk_p, dv_p = jax.lax.switch(which, [
+                lambda k_, v_, s_: grads(k_, v_, s_, True),
+                lambda k_, v_, s_: grads(k_, v_, s_, False),
+                lambda k_, v_, s_: (jnp.zeros_like(qf),
+                                    jnp.zeros_like(kf, jnp.float32),
+                                    jnp.zeros_like(vf, jnp.float32)),
+            ], k_cur, v_cur, seg_cur)
+        else:
+            dq_p, dk_p, dv_p = grads(k_cur, v_cur, seg_cur, False)
+        dq = dq + dq_p.astype(jnp.float32)
+        dk_cur = dk_cur + dk_p
+        dv_cur = dv_cur + dv_p
+        # the (dk, dv) accumulators travel WITH their KV shard; after n
+        # steps every shard is back home carrying all devices' contributions
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        seg_nxt = (jax.lax.ppermute(seg_cur, axis_name, perm)
+                   if segmented else seg_cur)
+        return (dq, k_nxt, v_nxt, seg_nxt, dk_nxt, dv_nxt), None
+
+    init = (
+        jnp.zeros_like(qf, jnp.float32),
+        kf, vf,
+        seg if segmented else jnp.zeros((), jnp.int32),
+        jnp.zeros_like(kf, jnp.float32),
+        jnp.zeros_like(vf, jnp.float32),
+    )
+    (dq, _, _, _, dk, dv), _ = jax.lax.scan(step, init, jnp.arange(n))
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+    return (_unflat(dq, b, h).astype(q.dtype),
+            _unflat(dk, b, h // groups).astype(k.dtype),
+            _unflat(dv, b, h // groups).astype(v.dtype), dseg)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
 
 def ring_attention(
@@ -46,55 +321,47 @@ def ring_attention(
     axis_name: str = "sequence",
     causal: bool = True,
     scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    impl: str = "auto",  # auto | pallas | xla
 ) -> jax.Array:
-    """Per-device body (call inside shard_map). q/k/v: local [B, S_loc, H, D]."""
+    """Per-device body (call inside shard_map). q: local [B, S_loc, H, D];
+    k/v: local [B, S_loc, H_kv, D] (GQA kv stays unexpanded — the ring
+    rotates kv-width shards); segment_ids: local [B, S_loc] ids or None."""
     h, hkv = q.shape[2], k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
+    if h % hkv:
+        raise ValueError(f"n_heads {h} must be a multiple of kv heads {hkv}")
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    seg = (None if segment_ids is None
+           else segment_ids.astype(jnp.int32))
 
-    n = jax.lax.axis_size(axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
-    b, s_loc, heads, d = q.shape
-    qf = q.astype(jnp.float32) * scale
+    if impl in ("auto", "pallas"):
+        try:
+            from kubeflow_tpu.ops import flash_pallas
 
-    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shard i -> i+1
+            if flash_pallas.FORCE_INTERPRET:
+                interpret = True
+            else:
+                from kubeflow_tpu.parallel.mesh import get_active_mesh
 
-    def step(carry, r):
-        acc, m, s, k_cur, v_cur = carry
-        src = (my_idx - r) % n  # whose KV shard we hold at ring step r
-        if causal:
-            q_pos = my_idx * s_loc + jnp.arange(s_loc)
-            k_pos = src * s_loc + jnp.arange(s_loc)
-            mask = q_pos[:, None] >= k_pos[None, :]
-        else:
-            mask = None
-        blk_acc, blk_m, blk_s = _block_attn_stats(
-            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32), mask)
-        new_m = jnp.maximum(m, blk_m)
-        c_old = jnp.exp(m - new_m)
-        c_blk = jnp.exp(blk_m - new_m)
-        new_s = s * c_old + blk_s * c_blk
-        new_acc = (acc * c_old.transpose(0, 2, 1)[..., None]
-                   + blk_acc * c_blk.transpose(0, 2, 1)[..., None])
-        # rotate KV to the next device; overlaps with next step's compute
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (new_acc, new_m, new_s, k_nxt, v_nxt), None
-
-    # Accumulators derived from q so they carry q's varying-manual-axes type
-    # (fresh jnp.zeros would be axis-invariant and fail scan's carry check).
-    bhs = qf[..., 0].transpose(0, 2, 1)  # [B,H,S_loc]
-    init = (
-        jnp.zeros_like(qf),
-        jnp.full_like(bhs, NEG_INF),
-        jnp.zeros_like(bhs),
-        k, v,
-    )
-    (acc, m, s, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    denom = jnp.maximum(s, 1e-37).transpose(0, 2, 1)[..., None]  # [B,Sq,H,1]
-    return (acc / denom).astype(q.dtype)
+                mesh = get_active_mesh()
+                platform = (mesh.devices.flat[0].platform if mesh is not None
+                            else jax.default_backend())
+                if platform != "tpu":
+                    raise NotImplementedError(
+                        f"pallas ring body: target platform {platform!r}")
+                interpret = False
+            if q.shape[1] < 128:
+                # same early gate as _pallas_island: the kernels need a
+                # >=128 local sequence; decide here, not mid-kernel-trace
+                raise NotImplementedError(
+                    "pallas ring body needs S_loc >= 128")
+            bq, bkv = _ring_blocks(q.shape[1], 256, 512)
+            return _ring_flash(q, k, v, seg, axis_name, causal, scale,
+                               interpret, bq, bkv)
+        except NotImplementedError:
+            if impl == "pallas":
+                raise
+    return _ring_xla(q, k, v, seg, axis_name, causal, scale)
 
 
 def ring_attention_sharded(
@@ -106,6 +373,8 @@ def ring_attention_sharded(
     causal: bool = True,
     scale: float | None = None,
     axis_name: str = "sequence",
+    segment_ids: jax.Array | None = None,
+    impl: str = "auto",
 ) -> jax.Array:
     """Standalone entry: shards BSHD arrays over (batch->data/fsdp, seq->ring,
     heads->tensor); composes with tensor parallelism (axis dropped at size
@@ -113,9 +382,21 @@ def ring_attention_sharded(
     (see ulysses_attention_sharded's docstring for why)."""
     spec = P(("data", "fsdp"), axis_name, "tensor", None)
 
-    def body(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis_name=axis_name, causal=causal,
-                              scale=scale)
+    if segment_ids is None:
+        def body(ql, kl, vl):
+            return ring_attention(ql, kl, vl, axis_name=axis_name,
+                                  causal=causal, scale=scale, impl=impl)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+
+    seg_spec = P(("data", "fsdp"), axis_name)
+
+    def body_seg(ql, kl, vl, sl):
+        return ring_attention(ql, kl, vl, axis_name=axis_name, causal=causal,
+                              scale=scale, segment_ids=sl, impl=impl)
+
+    return jax.shard_map(body_seg, mesh=mesh,
+                         in_specs=(spec, spec, spec, seg_spec),
+                         out_specs=spec, check_vma=False)(q, k, v,
+                                                          segment_ids)

