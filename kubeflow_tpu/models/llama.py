@@ -313,9 +313,32 @@ def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
 # ---------------------------------------------------------------------------
 
 
-def init_cache(cfg: LlamaConfig, n_slots: int, max_len: int) -> Params:
+def init_cache(cfg: LlamaConfig, n_slots: int, max_len: int,
+               kv_quantize: str | None = None) -> Params:
     shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kv_quantize == "int8":
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(sshape, jnp.float32),
+                "v_s": jnp.zeros(sshape, jnp.float32)}
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-token-per-head symmetric int8 over head_dim: [..., hd] ->
+    (int8 [..., hd], f32 scale [...]). Decode re-reads the whole cache every
+    step, so int8 KV halves that HBM traffic vs bf16 (ops/quant.py's
+    weight-only argument, applied to the cache)."""
+    s = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1),
+                    1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
 
 
 def _project_qkv(cfg: LlamaConfig, layer, x, positions):
@@ -409,19 +432,39 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
     b = last_tokens.shape[0]
     max_len = cache["k"].shape[2]
     span = max_len if span is None else min(span, max_len)
+    quantized = "k_s" in cache
     x = params["embed"].astype(cfg.dtype)[last_tokens][:, None]  # [B,1,D]
     rows = jnp.arange(b)
     k_pos = jnp.arange(span)
 
     def body(carry, inp):
         x = carry
-        layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
+        if quantized:
+            layer, ck, cv, cks, cvs = inp  # int8 [B,max_len,kv,hd] + scales
+        else:
+            layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
         q, k_new, v_new = _project_qkv(cfg, layer, x, lengths[:, None])
-        ck = ck.at[rows, lengths].set(k_new[:, 0])
-        cv = cv.at[rows, lengths].set(v_new[:, 0])
+        if quantized:
+            kq, ksc = quantize_kv(k_new[:, 0])
+            vq, vsc = quantize_kv(v_new[:, 0])
+            ck = ck.at[rows, lengths].set(kq)
+            cv = cv.at[rows, lengths].set(vq)
+            cks = cks.at[rows, lengths].set(ksc)
+            cvs = cvs.at[rows, lengths].set(vsc)
+            k_att = dequantize_kv(
+                jax.lax.slice_in_dim(ck, 0, span, axis=1),
+                jax.lax.slice_in_dim(cks, 0, span, axis=1), cfg.dtype)
+            v_att = dequantize_kv(
+                jax.lax.slice_in_dim(cv, 0, span, axis=1),
+                jax.lax.slice_in_dim(cvs, 0, span, axis=1), cfg.dtype)
+        else:
+            ck = ck.at[rows, lengths].set(k_new[:, 0])
+            cv = cv.at[rows, lengths].set(v_new[:, 0])
+            k_att = jax.lax.slice_in_dim(ck, 0, span, axis=1)
+            v_att = jax.lax.slice_in_dim(cv, 0, span, axis=1)
         nh, nkv = cfg.n_heads, cfg.n_kv_heads
-        kf = repeat_kv(jax.lax.slice_in_dim(ck, 0, span, axis=1), nh // nkv)
-        vf = repeat_kv(jax.lax.slice_in_dim(cv, 0, span, axis=1), nh // nkv)
+        kf = repeat_kv(k_att, nh // nkv)
+        vf = repeat_kv(v_att, nh // nkv)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
                             preferred_element_type=jnp.float32)
         logits *= 1.0 / (cfg.head_dim ** 0.5)
@@ -431,13 +474,20 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
         x = x + quant.matmul(out.reshape(b, 1, -1), layer["wo"], cfg.dtype)
         x = _mlp(cfg, x, layer)
-        return x, (ck, cv)
+        return x, ((ck, cv, cks, cvs) if quantized else (ck, cv))
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                         cache["k"], cache["v"]))
+    if quantized:
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["k_s"], cache["v_s"]))
+        new_cache = {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                             cache["k"], cache["v"]))
+        new_cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
-    return logits[:, 0], {"k": ks, "v": vs}
+    return logits[:, 0], new_cache
 
 
 # ---------------------------------------------------------------------------

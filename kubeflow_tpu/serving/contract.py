@@ -47,11 +47,13 @@ class _AbstractEngine:
 
     _prefill = LLMEngine._prefill
     _decode = LLMEngine._decode
+    _cache_write = LLMEngine._cache_write
     _sample_last = staticmethod(LLMEngine._sample_last)
     _pick = staticmethod(LLMEngine._pick)
 
-    def __init__(self, cfg: llama.LlamaConfig):
+    def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None):
         self.cfg = cfg
+        self.kv_quantize = kv_quantize
 
 
 def _abstract_tree(tree, shardings):
@@ -78,6 +80,7 @@ def aot_serving_report(
     topology: str | None = "v5e:2x4",
     *,
     quantize: str | None = None,
+    kv_quantize: str | None = None,
     n_devices: int = 8,
     n_slots: int = 8,
     max_len: int = 8192,
@@ -107,7 +110,7 @@ def aot_serving_report(
     if cfg.n_kv_heads % n_devices:
         raise ValueError(f"kv heads {cfg.n_kv_heads} vs tensor={n_devices}")
     mesh = make_mesh(MeshConfig(tensor=n_devices), devices=devices)
-    eng = _AbstractEngine(cfg)
+    eng = _AbstractEngine(cfg, kv_quantize=kv_quantize)
 
     # -- weights: bf16 (cast) or weight-only int8, sharded by logical axes
     def build_params():
@@ -128,8 +131,19 @@ def aot_serving_report(
     repl = NamedSharding(mesh, P())
     cache_shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads,
                    cfg.head_dim)
-    cache = {k: jax.ShapeDtypeStruct(cache_shape, jnp.dtype(cfg.dtype),
-                                     sharding=cache_sh) for k in ("k", "v")}
+    if kv_quantize == "int8":
+        cache = {"k": jax.ShapeDtypeStruct(cache_shape, jnp.int8,
+                                           sharding=cache_sh),
+                 "v": jax.ShapeDtypeStruct(cache_shape, jnp.int8,
+                                           sharding=cache_sh),
+                 "k_s": jax.ShapeDtypeStruct(cache_shape[:-1], jnp.float32,
+                                             sharding=cache_sh),
+                 "v_s": jax.ShapeDtypeStruct(cache_shape[:-1], jnp.float32,
+                                             sharding=cache_sh)}
+    else:
+        cache = {k: jax.ShapeDtypeStruct(cache_shape, jnp.dtype(cfg.dtype),
+                                         sharding=cache_sh)
+                 for k in ("k", "v")}
     i32 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32,
                             sharding=repl)
     lengths, last = i32((n_slots,)), i32((n_slots,))
@@ -159,6 +173,7 @@ def aot_serving_report(
         "n_devices": n_devices,
         "tensor_parallel": n_devices,
         "weights": quantize or "bf16",
+        "kv_cache": kv_quantize or str(jnp.dtype(cfg.dtype)),
         "n_slots": n_slots,
         "max_len": max_len,
         "prefill_bucket": bucket,

@@ -44,11 +44,19 @@ class LLMEngine:
                  mesh=None, sample_seed: int = 0,
                  prefix_cache: bool = False, max_prefixes: int = 4,
                  quantize: str | None = None,
-                 warm_cont_pairs: int | None = 4):
+                 warm_cont_pairs: int | None = 4,
+                 kv_quantize: str | None = None):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
+        if kv_quantize not in (None, "int8"):
+            raise ValueError(f"unknown kv_quantize mode {kv_quantize!r}")
+        # int8 KV cache: decode re-reads the whole (span of the) cache
+        # every step, so int8 storage halves that HBM traffic vs bf16 and
+        # halves cache residency (2x slots or context at 8B scale);
+        # per-token-per-head scales, bf16 attention compute
+        self.kv_quantize = kv_quantize
         if quantize == "int8":
             # weight-only int8 (models/llama.quantize_params): decode is
             # HBM-bound on weight reads, so int8 storage is the serving
@@ -154,19 +162,30 @@ class LLMEngine:
         only ITS shard (make_array_from_callback) — an 8B-scale cache that
         only fits sharded must never be materialized whole on one device."""
         if self.mesh is None:
-            return llama.init_cache(self.cfg, self.n_slots, self.max_len)
+            return llama.init_cache(self.cfg, self.n_slots, self.max_len,
+                                    kv_quantize=self.kv_quantize)
         shape = (self.cfg.n_layers, self.n_slots, self.max_len,
                  self.cfg.n_kv_heads, self.cfg.head_dim)
+        leaves = {"k": (shape, jnp.int8), "v": (shape, jnp.int8),
+                  "k_s": (shape[:-1], jnp.float32),
+                  "v_s": (shape[:-1], jnp.float32)} \
+            if self.kv_quantize == "int8" else \
+            {"k": (shape, jnp.dtype(self.cfg.dtype)),
+             "v": (shape, jnp.dtype(self.cfg.dtype))}
 
-        def zeros_shard(index):
-            shard = tuple(len(range(*sl.indices(dim)))
-                          for sl, dim in zip(index, shape))
-            return np.zeros(shard, jnp.dtype(self.cfg.dtype))
+        def zeros_shard(shp, dt):
+            def cb(index):
+                shard = tuple(len(range(*sl.indices(dim)))
+                              for sl, dim in zip(index, shp))
+                return np.zeros(shard, dt)
+            return cb
 
+        # the 4-element spec shards dim 3 (kv heads) for both the 5D int8
+        # payloads and the 4D scale planes
         return {
-            name: jax.make_array_from_callback(shape, self._cache_sh,
-                                               zeros_shard)
-            for name in ("k", "v")}
+            name: jax.make_array_from_callback(shp, self._cache_sh,
+                                               zeros_shard(shp, dt))
+            for name, (shp, dt) in leaves.items()}
 
     def _put(self, x):
         """Host array → device; replicated across the mesh when sharded
@@ -209,11 +228,11 @@ class LLMEngine:
         row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
         logits, ks, vs = llama.prefill(params, tokens, self.cfg)
         bucket = tokens.shape[1]
-        k, v = cache["k"], cache["v"]
+        cache = dict(cache)
         lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
-            k = k.at[:, slots[i], :bucket].set(ks[:, i])
-            v = v.at[:, slots[i], :bucket].set(vs[:, i])
+            cache = self._cache_write(cache, slots[i], 0, bucket,
+                                      ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
             temps = temps.at[slots[i]].set(row_temps[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
@@ -221,7 +240,27 @@ class LLMEngine:
         key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots, key)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
-        return ({"k": k, "v": v}, lengths, last_tokens, temps, key, toks)
+        return (cache, lengths, last_tokens, temps, key, toks)
+
+    def _cache_write(self, cache, slot, start: int, count: int, ks, vs):
+        """Write [L, count, kv, hd] KV rows into a slot's [start, start+count)
+        range, quantizing when the cache is int8. start/count are static."""
+        out = dict(cache)
+        if self.kv_quantize == "int8":
+            kq, ksc = llama.quantize_kv(ks)
+            vq, vsc = llama.quantize_kv(vs)
+            out["k"] = cache["k"].at[:, slot, start:start + count].set(kq)
+            out["v"] = cache["v"].at[:, slot, start:start + count].set(vq)
+            out["k_s"] = cache["k_s"].at[:, slot,
+                                         start:start + count].set(ksc)
+            out["v_s"] = cache["v_s"].at[:, slot,
+                                         start:start + count].set(vsc)
+        else:
+            out["k"] = cache["k"].at[:, slot, start:start + count].set(
+                ks.astype(cache["k"].dtype))
+            out["v"] = cache["v"].at[:, slot, start:start + count].set(
+                vs.astype(cache["v"].dtype))
+        return out
 
     @staticmethod
     def _sample_last(stacked, row_temps, slots, key):
@@ -255,13 +294,13 @@ class LLMEngine:
         logits, ks, vs = llama.prefill_continue(params, tokens, k_prefix,
                                                 v_prefix, self.cfg)
         t_bucket = tokens.shape[1]
-        k, v = cache["k"], cache["v"]
+        cache = dict(cache)
         lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
-            k = k.at[:, slots[i], :p].set(k_prefix[:, i])
-            v = v.at[:, slots[i], :p].set(v_prefix[:, i])
-            k = k.at[:, slots[i], p:p + t_bucket].set(ks[:, i])
-            v = v.at[:, slots[i], p:p + t_bucket].set(vs[:, i])
+            cache = self._cache_write(cache, slots[i], 0, p,
+                                      k_prefix[:, i], v_prefix[:, i])
+            cache = self._cache_write(cache, slots[i], p, t_bucket,
+                                      ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
             temps = temps.at[slots[i]].set(row_temps[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
@@ -270,15 +309,24 @@ class LLMEngine:
                                       key)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
-        return ({"k": k, "v": v}, lengths, last_tokens, temps, key, toks)
+        return (cache, lengths, last_tokens, temps, key, toks)
 
     def _extract_prefix(self, cache, slot, *, p: int):
         """Slice a freshly prefilled slot's first `p` KV rows into a
-        store-shaped [L, 1, P, kv, hd] entry (stays on device)."""
+        store-shaped [L, 1, P, kv, hd] entry (stays on device; entries are
+        kept dequantized — the store is tiny next to the cache, and cont
+        prefill re-quantizes on write)."""
         k = jax.lax.dynamic_index_in_dim(cache["k"], slot, axis=1,
                                          keepdims=False)[:, :p][:, None]
         v = jax.lax.dynamic_index_in_dim(cache["v"], slot, axis=1,
                                          keepdims=False)[:, :p][:, None]
+        if self.kv_quantize == "int8":
+            ksc = jax.lax.dynamic_index_in_dim(
+                cache["k_s"], slot, axis=1, keepdims=False)[:, :p][:, None]
+            vsc = jax.lax.dynamic_index_in_dim(
+                cache["v_s"], slot, axis=1, keepdims=False)[:, :p][:, None]
+            k = llama.dequantize_kv(k, ksc, self.cfg.dtype)
+            v = llama.dequantize_kv(v, vsc, self.cfg.dtype)
         return k, v
 
     def _decode(self, params, cache, lengths, last_tokens, temps, key,

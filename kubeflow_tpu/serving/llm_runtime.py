@@ -42,7 +42,8 @@ class LLMModel(Model):
                  mesh: dict[str, int] | None = None,
                  tokenizer: str | None = None,
                  prefix_cache: bool = False, max_prefixes: int = 4,
-                 quantize: str | None = None, **_ignored: Any):
+                 quantize: str | None = None,
+                 kv_quantize: str | None = None, **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
@@ -59,6 +60,7 @@ class LLMModel(Model):
         self._prefix_cache = prefix_cache
         self._max_prefixes = max_prefixes
         self._quantize = quantize
+        self._kv_quantize = kv_quantize
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -113,7 +115,8 @@ class LLMModel(Model):
                                  mesh=mesh,
                                  prefix_cache=self._prefix_cache,
                                  max_prefixes=self._max_prefixes,
-                                 quantize=self._quantize)
+                                 quantize=self._quantize,
+                                 kv_quantize=self._kv_quantize)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()

@@ -34,15 +34,24 @@ def test_8b_serving_programs_lower_on_8_device_mesh(devices8):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("quantize", [None, "int8"])
-def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(quantize):
+@pytest.mark.parametrize("quantize,kv_quantize", [
+    (None, None),            # bf16 weights, bf16 KV
+    ("int8", None),          # int8 weights
+    ("int8", "int8"),        # full production decode config
+])
+def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(quantize,
+                                                           kv_quantize):
     _require_v5e()
-    report = aot_serving_report(quantize=quantize)
+    report = aot_serving_report(quantize=quantize, kv_quantize=kv_quantize)
     assert report["compiled"]
     assert report["fits_v5e_hbm"], report
     # int8 halves weight residency vs bf16 (scales add ~1%)
     if quantize == "int8":
         assert report["weight_bytes_per_device"] < 1.2 * 1024**3
+    if kv_quantize == "int8":
+        # int8 payload + f32/128-per-head scales: ~0.53x the bf16 cache
+        bf16_cache = 32 * 8 * 8192 * 1 * 128 * 2 * 2
+        assert report["kv_cache_bytes_per_device"] < 0.6 * bf16_cache
     peaks = report["peak_bytes_per_device"]
     assert set(peaks) == {"prefill_b2048_w4", "decode_x8"}
     assert all(p > 0 for p in peaks.values())

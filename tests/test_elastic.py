@@ -256,6 +256,7 @@ def test_heartbeat_detects_dead_rank(cluster):
     assert "HeartbeatLost" in reasons
 
 
+@pytest.mark.slow
 def test_fault_injection_checkpoint_resume(cluster, tmp_path):
     """The §5.3 contract: kill the trainer mid-run, the restarted pod must
     resume from the checkpoint (start_step == 5), finish the remaining
