@@ -48,6 +48,17 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _out_vma(*xs):
+    """Varying-manual-axes annotation for pallas out_shapes: the union of
+    the inputs' vma. Inside a check_vma=True shard_map (e.g. a pipeline
+    stage body) a pallas_call output without vma is rejected; annotating
+    with the inputs' axes makes the kernels legal in any manual region."""
+    vma = frozenset()
+    for x in xs:
+        vma |= getattr(jax.typeof(x), "vma", frozenset())
+    return vma
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -181,12 +192,14 @@ def _fwd(q, k, v, seg_q, seg_k, causal, scale, q_offset, interpret, block_q,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_kv=block_kv, sk=sk, segmented=segmented)
+    vma = _out_vma(q, k, v)
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n_q, 1, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, n_q, 1, block_q), jnp.float32,
+                                 vma=vma),
         ],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -360,6 +373,7 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
         ]
         seg_args = [seg_q3, seg_k3]
 
+    vma = _out_vma(q, k, v, do)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, sk=sk,
@@ -368,7 +382,7 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec,
                   *seg_specs_dq],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
@@ -394,8 +408,8 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
         in_specs=[q_spec_kv, kv_spec, kv_spec, q_spec_kv, row_spec_kv,
                   row_spec_kv, *seg_specs_kv],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype, vma=vma)],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),

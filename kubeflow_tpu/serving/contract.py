@@ -129,21 +129,13 @@ def aot_serving_report(
 
     cache_sh = NamedSharding(mesh, P(None, None, None, "tensor"))
     repl = NamedSharding(mesh, P())
-    cache_shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads,
-                   cfg.head_dim)
-    if kv_quantize == "int8":
-        cache = {"k": jax.ShapeDtypeStruct(cache_shape, jnp.int8,
-                                           sharding=cache_sh),
-                 "v": jax.ShapeDtypeStruct(cache_shape, jnp.int8,
-                                           sharding=cache_sh),
-                 "k_s": jax.ShapeDtypeStruct(cache_shape[:-1], jnp.float32,
-                                             sharding=cache_sh),
-                 "v_s": jax.ShapeDtypeStruct(cache_shape[:-1], jnp.float32,
-                                             sharding=cache_sh)}
-    else:
-        cache = {k: jax.ShapeDtypeStruct(cache_shape, jnp.dtype(cfg.dtype),
-                                         sharding=cache_sh)
-                 for k in ("k", "v")}
+    # cache schema from the ONE source of truth (llama.init_cache) so the
+    # proof can't drift from the layout the live engine allocates
+    cache = {
+        name: jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=cache_sh)
+        for name, sds in jax.eval_shape(
+            lambda: llama.init_cache(cfg, n_slots, max_len,
+                                     kv_quantize=kv_quantize)).items()}
     i32 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32,
                             sharding=repl)
     lengths, last = i32((n_slots,)), i32((n_slots,))

@@ -164,28 +164,25 @@ class LLMEngine:
         if self.mesh is None:
             return llama.init_cache(self.cfg, self.n_slots, self.max_len,
                                     kv_quantize=self.kv_quantize)
-        shape = (self.cfg.n_layers, self.n_slots, self.max_len,
-                 self.cfg.n_kv_heads, self.cfg.head_dim)
-        leaves = {"k": (shape, jnp.int8), "v": (shape, jnp.int8),
-                  "k_s": (shape[:-1], jnp.float32),
-                  "v_s": (shape[:-1], jnp.float32)} \
-            if self.kv_quantize == "int8" else \
-            {"k": (shape, jnp.dtype(self.cfg.dtype)),
-             "v": (shape, jnp.dtype(self.cfg.dtype))}
+        # schema derives from init_cache — ONE source of truth for the
+        # cache layout (shared with serving/contract.py)
+        leaves = jax.eval_shape(lambda: llama.init_cache(
+            self.cfg, self.n_slots, self.max_len,
+            kv_quantize=self.kv_quantize))
 
-        def zeros_shard(shp, dt):
+        def zeros_shard(sds):
             def cb(index):
                 shard = tuple(len(range(*sl.indices(dim)))
-                              for sl, dim in zip(index, shp))
-                return np.zeros(shard, dt)
+                              for sl, dim in zip(index, sds.shape))
+                return np.zeros(shard, sds.dtype)
             return cb
 
         # the 4-element spec shards dim 3 (kv heads) for both the 5D int8
         # payloads and the 4D scale planes
         return {
-            name: jax.make_array_from_callback(shp, self._cache_sh,
-                                               zeros_shard(shp, dt))
-            for name, (shp, dt) in leaves.items()}
+            name: jax.make_array_from_callback(sds.shape, self._cache_sh,
+                                               zeros_shard(sds))
+            for name, sds in leaves.items()}
 
     def _put(self, x):
         """Host array → device; replicated across the mesh when sharded

@@ -354,3 +354,34 @@ def test_ring_pallas_gqa_grad(pallas_interpret, devices8):
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_long_seq_flash_body(pallas_interpret, devices8, causal):
+    # past the 256 threshold the post-all-to-all local attention runs the
+    # flash path (never dense S x S probs) — parity vs dense mha
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=4, hkv=4, d=32, seed=17)
+    ref = mha(q, k, v, causal=causal)
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_ulysses_long_seq_flash_grad(pallas_interpret, devices8):
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=1, s=512, h=4, hkv=4, d=32, seed=18)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True) ** 2)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses_attention_sharded(q, k, v, mesh,
+                                                 causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)

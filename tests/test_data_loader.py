@@ -84,6 +84,7 @@ def test_errors(tmp_path, corpus):
         PyTokenLoader(tiny, 2, 8)
 
 
+@pytest.mark.slow  # corpus e2e also runs fast via test_data_pipeline
 def test_train_llama_from_corpus(corpus):
     """The real-data path: loss on a repeating-block corpus must drop."""
     from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig

@@ -47,6 +47,7 @@ def test_nas_parameters_expansion():
     assert arch == ("sep3", "identity")
 
 
+@pytest.mark.slow  # exhaustive per-op grads; supernet test stays fast
 def test_every_op_forward_and_grad():
     cfg = nas_cnn.NasCnnConfig(ops=nas_cnn.OP_NAMES, channels=8,
                                image_size=8, n_classes=4)

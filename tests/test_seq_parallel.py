@@ -60,6 +60,7 @@ def test_seq_parallel_train_step_parity(devices8, impl):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # the seq_n==1 -> mha degrade branch; full-CI lane
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_seq_parallel_degrades_without_seq_axis(devices8, impl):
     # no sequence axis on the mesh -> the impl falls back to plain attention
