@@ -187,7 +187,7 @@ def flash_attention(
     causal: bool = True,
     scale: float | None = None,
     q_offset: int | jax.Array = 0,
-    block_kv: int = 512,
+    block_kv: int | None = None,  # None = seq-adaptive kernel defaults
     segment_ids: jax.Array | None = None,
     impl: str = "auto",  # auto | pallas | xla
 ) -> jax.Array:
@@ -204,9 +204,10 @@ def flash_attention(
 
             from kubeflow_tpu.ops.flash_pallas import pallas_flash_attention
 
-            call = functools.partial(pallas_flash_attention, causal=causal,
-                                     scale=scale, q_offset=q_offset,
-                                     block_kv=max(block_kv, 128))
+            call = functools.partial(
+                pallas_flash_attention, causal=causal, scale=scale,
+                q_offset=q_offset,
+                block_kv=None if block_kv is None else max(block_kv, 128))
             if isinstance(q_offset, int) and q_offset == 0:
                 out = _pallas_island(q, k, v, segment_ids, call)
                 if out is not None:
@@ -215,7 +216,7 @@ def flash_attention(
         except (ImportError, NotImplementedError):
             if impl == "pallas":
                 raise
-    block = min(block_kv, k.shape[1])
+    block = min(block_kv or 512, k.shape[1])
     return _blockwise_attn(q, k, v, causal=causal, scale=scale,
                            q_offset=q_offset, block_kv=block,
                            segment_ids=segment_ids)

@@ -149,10 +149,12 @@ def _reduce_flat(dk, b, groups):
         b * hkv, s, d)
 
 
-def _ring_blocks(s_loc: int, block_q: int, block_kv: int) -> tuple[int, int]:
-    bq = min(block_q, max(128, -(-s_loc // 128) * 128))
-    bkv = min(block_kv, max(128, -(-s_loc // 128) * 128))
-    return bq, bkv
+def _ring_blocks(s_loc: int) -> tuple[int, int]:
+    from kubeflow_tpu.ops.flash_pallas import default_blocks
+
+    bq, bkv = default_blocks(s_loc, s_loc)
+    cap = max(128, -(-s_loc // 128) * 128)
+    return min(bq, cap), min(bkv, cap)
 
 
 def _ring_pallas_fwd_loop(qf, kf, vf, seg, seg_q, b, groups, axis_name,
@@ -355,7 +357,7 @@ def ring_attention(
                 # >=128 local sequence; decide here, not mid-kernel-trace
                 raise NotImplementedError(
                     "pallas ring body needs S_loc >= 128")
-            bq, bkv = _ring_blocks(q.shape[1], 256, 512)
+            bq, bkv = _ring_blocks(q.shape[1])
             return _ring_flash(q, k, v, seg, axis_name, causal, scale,
                                interpret, bq, bkv)
         except NotImplementedError:

@@ -385,3 +385,18 @@ def test_ulysses_long_seq_flash_grad(pallas_interpret, devices8):
     for a, b in zip(g_uly, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_ulysses_long_seq_gqa_segment_ids(pallas_interpret, devices8):
+    # the flash branch (seq >= 256) crossed with GQA expansion AND packed
+    # segment_ids gathered to the full-sequence view
+    mesh = make_mesh(MeshConfig(sequence=4), devices=devices8)
+    q, k, v = make_qkv(b=2, s=512, h=4, hkv=2, d=32, seed=19)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 200), jnp.int32), jnp.ones((2, 312), jnp.int32)],
+        axis=1)
+    ref = mha(q, k, v, causal=True, segment_ids=seg)
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                    segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
