@@ -240,35 +240,52 @@ def decode_span_bench(on_tpu: bool) -> dict:
     ) if on_tpu else llama.LlamaConfig.tiny()
     params = llama.init(jax.random.key(0), cfg)
     max_len = 2048 if on_tpu else 64
-    engine = LLMEngine(params, cfg, n_slots=16 if on_tpu else 2,
-                       max_len=max_len, buckets=(128,) if on_tpu else (16,),
-                       decode_chunk=64 if on_tpu else 8)
-    engine.warmup()
     prompt = list(range(1, 100)) if on_tpu else [3, 7, 11]
     new_tokens = 64 if on_tpu else 8
-    n_req = engine.n_slots
 
-    def run() -> float:
-        rids = [engine.submit(prompt, new_tokens) for _ in range(n_req)]
+    n_slots = 16 if on_tpu else 2
+    decode_chunk = 64 if on_tpu else 8
+
+    def run(engine) -> float:
+        rids = [engine.submit(prompt, new_tokens) for _ in range(n_slots)]
         t0 = time.perf_counter()
         engine.run_until_idle()
         dt = time.perf_counter() - t0
         assert all(engine.is_done(r) for r in rids)
         for r in rids:
             engine.release(r)
-        return n_req * new_tokens / dt
+        return n_slots * new_tokens / dt
 
-    span_tps = run()
-    real_pick = engine._pick_span
-    engine._pick_span = lambda needed: engine.max_len  # r2 behavior
-    full_tps = run()
-    engine._pick_span = real_pick
+    def build(**kw):
+        e = LLMEngine(params, cfg, n_slots=n_slots, max_len=max_len,
+                      buckets=(128,) if on_tpu else (16,),
+                      decode_chunk=decode_chunk, **kw)
+        e.warmup()
+        return e
+
+    # closure-free span override: a lambda capturing the engine (or a saved
+    # bound method) would keep its whole KV cache alive past the `del`
+    force_full = lambda needed, ml=max_len: ml  # noqa: E731
+
+    engine = build()
+    span_tps = run(engine)
+    engine._pick_span = force_full  # r2 behavior
+    full_tps = run(engine)
+    del engine
+    # int8 KV at FULL span: isolates the cache-read halving (span already
+    # removed most KV reads, so the int8 win shows against the full scan)
+    q_engine = build(kv_quantize="int8")
+    q_engine._pick_span = force_full
+    int8_full_tps = run(q_engine)
+    del q_engine
     return {
-        "max_len": max_len, "n_req": n_req, "new_tokens": new_tokens,
-        "decode_chunk": engine.decode_chunk,
+        "max_len": max_len, "n_req": n_slots, "new_tokens": new_tokens,
+        "decode_chunk": decode_chunk,
         "tok_per_s_span": round(span_tps, 1),
         "tok_per_s_full_cache": round(full_tps, 1),
+        "tok_per_s_full_cache_int8kv": round(int8_full_tps, 1),
         "speedup": round(span_tps / full_tps, 2),
+        "int8kv_speedup_at_full": round(int8_full_tps / full_tps, 2),
     }
 
 
