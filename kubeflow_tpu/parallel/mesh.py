@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import math
 import threading
 from typing import Iterator, Sequence
@@ -34,6 +35,8 @@ from typing import Iterator, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+log = logging.getLogger(__name__)
 
 # Canonical axis order. `data` outermost (may span DCN), `tensor`/`sequence`
 # innermost (highest-bandwidth ICI neighbours under the default device order).
@@ -86,6 +89,60 @@ class MeshConfig:
         return dataclasses.replace(self, **sizes)
 
 
+def _device_slice_index(d: jax.Device) -> int:
+    """Which ICI slice a device belongs to; 0 when the attribute is absent
+    (CPU/virtual devices, single-slice TPUs)."""
+    idx = getattr(d, "slice_index", None)
+    return 0 if idx is None else int(idx)
+
+
+def _hybrid_device_array(devices: Sequence[jax.Device],
+                         sizes: dict[str, int]) -> np.ndarray | None:
+    """Multi-slice (DCN-connected) arrangement: lay the `data` axis across
+    slices, every other axis within one slice — so gradient all-reduce is
+    the ONLY collective that rides DCN while fsdp/tp/sp/ep collectives stay
+    on ICI (the scaling-book recipe; the reference's NCCL-intra-node /
+    grad-sync-across-nodes split).
+
+    Best-effort: returns None (caller falls back to the flat claim order)
+    when the pool is one slice, when the claimed prefix cuts slices
+    unevenly, or when the layout has no data axis to stride the slices with
+    — a worse-routed mesh still beats an error the caller can't act on
+    (e.g. a tensor-only serving mesh)."""
+    groups: dict[int, list[jax.Device]] = {}
+    for d in devices:  # insertion order preserves the caller's ordering
+        groups.setdefault(_device_slice_index(d), []).append(d)
+    if len(groups) <= 1:
+        return None
+    n_slices = len(groups)
+    per_slice = [groups[k] for k in sorted(groups)]
+    if len({len(g) for g in per_slice}) != 1 or sizes["data"] % n_slices:
+        log.warning(
+            "device pool spans %d DCN-connected slices but the mesh %s "
+            "cannot stride them with the data axis; falling back to flat "
+            "device order — ICI-axis collectives may ride DCN",
+            n_slices, {k: v for k, v in sizes.items() if v > 1})
+        return None
+    inner = dict(sizes, data=sizes["data"] // n_slices)
+    inner_shape = tuple(inner[a] for a in AXIS_ORDER)
+    try:
+        # real TPU pools: JAX's helper additionally orders each slice's
+        # devices along physical ICI topology (best tensor/sequence rings)
+        from jax.experimental import mesh_utils
+
+        dcn_shape = tuple(n_slices if a == "data" else 1
+                          for a in AXIS_ORDER)
+        return np.asarray(mesh_utils.create_hybrid_device_mesh(
+            inner_shape, dcn_shape, devices=np.asarray(devices)))
+    except Exception:
+        pass  # virtual/CPU devices without real topology attributes
+    # [slice, data/n, fsdp, ...] -> merge the slice dim into data
+    stacked = np.stack([np.asarray(g).reshape(inner_shape)
+                        for g in per_slice])
+    assert stacked.size == len(devices)
+    return stacked.reshape(tuple(sizes[a] for a in AXIS_ORDER))
+
+
 def make_mesh(
     config: MeshConfig | None = None,
     *,
@@ -98,7 +155,9 @@ def make_mesh(
     the outermost axis, so under JAX's default device order it lands across
     slice/host boundaries and only gradient all-reduce crosses DCN — the
     analog of the reference's NCCL-rings-intra-node / grad-sync-across-nodes
-    topology split.
+    topology split. When the device pool spans multiple DCN-connected TPU
+    slices, the arrangement is hybrid: `data` explicitly strides the slices
+    and all other axes stay inside one slice's ICI.
     """
     if config is None:
         config = MeshConfig(**axis_sizes)
@@ -113,7 +172,10 @@ def make_mesh(
     # A mesh smaller than the pool claims the first `total` devices — the
     # analog of a job requesting fewer replicas than the cluster holds; the
     # gang scheduler (runtime.gang) does proper placement for concurrent jobs.
-    dev_array = np.asarray(devices[:total]).reshape(shape)
+    claimed = list(devices[:total])
+    hybrid = _hybrid_device_array(claimed, sizes)
+    dev_array = (hybrid if hybrid is not None
+                 else np.asarray(claimed).reshape(shape))
     return Mesh(dev_array, AXIS_ORDER)
 
 
