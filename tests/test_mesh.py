@@ -182,3 +182,4 @@ def test_hybrid_mesh_uneven_prefix_claim_falls_back(two_fake_slices, caplog):
                          devices=two_fake_slices)
     assert mesh.devices.size == 6
     assert [d.id for d in mesh.devices.reshape(-1)] == list(range(6))
+    assert any("falling back to flat" in r.message for r in caplog.records)
