@@ -135,11 +135,16 @@ def _hybrid_device_array(devices: Sequence[jax.Device],
         return np.asarray(mesh_utils.create_hybrid_device_mesh(
             inner_shape, dcn_shape, devices=np.asarray(devices)))
     except Exception:
-        # virtual/CPU devices without real topology attributes — but on a
-        # real TPU pool this fallback silently loses per-slice physical-ICI
-        # ordering, so leave a trace for diagnosability
-        log.debug("create_hybrid_device_mesh unavailable; using direct "
-                  "slice-grouped arrangement", exc_info=True)
+        # expected for virtual/CPU devices without topology attributes
+        # (DEBUG); on a real TPU pool this loses per-slice physical-ICI
+        # ordering — an operator debugging slow tensor/sequence collectives
+        # must be able to see it (WARNING)
+        level = (logging.WARNING
+                 if getattr(devices[0], "platform", "") == "tpu"
+                 else logging.DEBUG)
+        log.log(level, "create_hybrid_device_mesh unavailable; using "
+                "direct slice-grouped arrangement (per-slice ICI ordering "
+                "not topology-aware)", exc_info=True)
     # [slice, data/n, fsdp, ...] -> merge the slice dim into data
     stacked = np.stack([np.asarray(g).reshape(inner_shape)
                         for g in per_slice])

@@ -67,7 +67,9 @@ print("rank", ctx.process_id, "bert 4-host ok", round(losses[0], 4),
 def test_bert_four_process_ddp_jaxjob():
     job = new_resource("JAXJob", "bert-ddp", spec={
         "successPolicy": "AllWorkers",
-        "runPolicy": {"activeDeadlineSeconds": 280},
+        # 20s of slack past wait_for's 280s so an overrun surfaces as a
+        # Failed status WITH pod logs, not a bare TimeoutError
+        "runPolicy": {"activeDeadlineSeconds": 300},
         "replicaSpecs": {"worker": {
             "replicas": 4, "restartPolicy": "Never",
             "template": {"backend": "subprocess", "command": WORKER,

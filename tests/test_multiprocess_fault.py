@@ -71,7 +71,21 @@ if start == 0 and world == 2:
         json.dump({"losses": losses, "world": world}, f)
     if rank == 1:
         # rank 1 "dies": stops heartbeating and hangs (no exit, no beat) —
-        # only the controller's failure detector can notice this
+        # only the controller's failure detector can notice this. First
+        # keep beating until the step-4 checkpoint has COMMITTED (poll the
+        # shared dir), so the gang teardown that follows heartbeat loss
+        # can't race rank 0's async multi-process commit — otherwise
+        # attempt 2 occasionally finds no checkpoint.
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            probe = CheckpointManager(ckpt_dir)
+            committed = probe.latest_step()
+            probe.close()
+            if committed == 4:
+                break
+            time.sleep(0.25)
+        assert committed == 4, committed
         hb.stop(mark_done=False)
         time.sleep(300)
         raise SystemExit(1)
