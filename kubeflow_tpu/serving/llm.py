@@ -431,6 +431,32 @@ class LLMEngine:
 
     # -- public API ----------------------------------------------------------
 
+    def _chunk_plan(self, n: int) -> list[tuple[int, int]]:
+        """Chunked-prefill schedule for an n-token prompt longer than the
+        largest bucket: [(chunk_len, program_len), ...] — full largest-
+        bucket chunks, then a tail rounded up to a bucket. Raises
+        PromptTooLong when no tail bucket fits inside max_len."""
+        from kubeflow_tpu.serving.scheduler import PromptTooLong
+
+        big = self.buckets[-1]
+        if n >= self.max_len:
+            raise PromptTooLong(
+                f"prompt_len {n} leaves no room to decode in max_len "
+                f"{self.max_len}")
+        plan = []
+        done = 0
+        while n - done > big:
+            plan.append((big, big))
+            done += big
+        tail = n - done
+        t = self._tail_bucket(tail)
+        if t is None or done + t > self.max_len:
+            raise PromptTooLong(
+                f"prompt_len {n}: tail {tail} after {done} chunked tokens "
+                f"fits no bucket within max_len {self.max_len}")
+        plan.append((tail, t))
+        return plan
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0) -> int:
         import math
@@ -439,8 +465,26 @@ class LLMEngine:
         # thread (wave packing), killing serving for every request
         if not (math.isfinite(temperature) and 0 <= temperature <= 100):
             raise ValueError("temperature must be finite and in [0, 100]")
+        from kubeflow_tpu.serving.scheduler import PromptTooLong
+
+        sched_len = len(prompt)
+        if sched_len > self.buckets[-1]:
+            # chunked prefill: validate the chain now (fail at submit, not
+            # mid-serve); the scheduler sees the largest bucket — it only
+            # uses the length for bucket choice, the engine keeps the truth
+            try:
+                self._chunk_plan(sched_len)
+            except PromptTooLong:
+                # route the rejection THROUGH the scheduler so its
+                # rejected counter (the operator-facing metric) still
+                # counts unservable prompts
+                with self._submit_lock:
+                    self.scheduler.submit(sched_len, max_new_tokens,
+                                          time.monotonic())
+                raise  # unreachable: the scheduler submit raises first
+            sched_len = self.buckets[-1]
         with self._submit_lock:
-            req_id = self.scheduler.submit(len(prompt), max_new_tokens,
+            req_id = self.scheduler.submit(sched_len, max_new_tokens,
                                            time.monotonic())
             self._prompts[req_id] = list(prompt)
             self._results[req_id] = []
@@ -472,18 +516,25 @@ class LLMEngine:
                 break   # Decode/None: dropping is safe — the decode pass
                         # re-derives from slot state on the next step()
             actions.append(nxt)
-        # prefix-cache hits peel off into continuation programs (tail-only
-        # compute); everything else groups by bucket, one batched program
-        # per group. All dispatches go out before any token fetch.
+        # prompts longer than the largest bucket peel off into chained
+        # chunked prefills; prefix-cache hits into continuation programs
+        # (tail-only compute); everything else groups by bucket, one
+        # batched program per group. All dispatches go out before any
+        # token fetch.
+        chunked: list[PrefillAction] = []
+        short: list[PrefillAction] = []
+        for a in actions:  # one-pass, identity-safe partition
+            (chunked if len(self._prompts.get(a.req_id, ())) > a.bucket_len
+             else short).append(a)
         cont: list[tuple[PrefillAction, tuple]] = []
         normal: list[PrefillAction] = []
         if self.prefix_cache_enabled:
-            for a in actions:
+            for a in short:
                 hit = self._prefix_lookup(a)
                 (cont.append((a, hit)) if hit is not None
                  else normal.append(a))
         else:
-            normal = actions
+            normal = short
         groups: dict[int, list[PrefillAction]] = {}
         for a in normal:
             groups.setdefault(a.bucket_len, []).append(a)
@@ -495,21 +546,75 @@ class LLMEngine:
         dispatched += [([a for a, _ in pairs],
                         self._dispatch_prefill_cont_wave(p, t, pairs))
                        for (p, t), pairs in cont_groups.items()]
+        dispatched += [([a], self._dispatch_chunked_prefill(a))
+                       for a in chunked]
         self._prefix_hits += len(cont)
         if self.prefix_cache_enabled:
             # store fresh prefixes BEFORE the fetch loop: recording a
             # request's final token pops its prompt, and extraction only
-            # needs the (device-ordered) prefill to have been dispatched
+            # needs the (device-ordered) prefill to have been dispatched.
+            # Chunked requests bank their largest-bucket prefix too — the
+            # shared-system-prompt workload is exactly the long one.
             for wave, _ in dispatched[:len(groups)]:
                 for a in wave:
                     self._maybe_store_prefix(a)
+            for a in chunked:
+                self._maybe_store_prefix(a)
         for wave, toks in dispatched:
             toks_np = np.asarray(toks)   # one fetch per wave
             for i, a in enumerate(wave):
-                self._host_lengths[a.slot] = a.prompt_len
+                # true length, not action.prompt_len: a chunked request's
+                # scheduler-visible length was clamped to the largest bucket
+                self._host_lengths[a.slot] = len(self._prompts[a.req_id])
                 self._record_token(a.req_id, a.slot, int(toks_np[i]),
                                    first_token=True)
         return True
+
+    def _dispatch_chunked_prefill(self, action) -> Any:
+        """Chained prefill for a prompt longer than the largest bucket:
+        the first chunk runs the ordinary bucket prefill, then each further
+        chunk extracts the accumulated slot KV and runs a continuation
+        program against it (the prefix-cache machinery, aimed at the
+        slot's own rows). One request = len(plan) dispatches; the chain's
+        programs ((extract p, cont (p, t, 1)) per boundary) compile lazily
+        on the first long prompt — a cold start the docstring of warmup()
+        points at. Returns the next-token device array [1]."""
+        prompt = self._prompts[action.req_id]
+        plan = self._chunk_plan(len(prompt))
+        slot = action.slot
+        temp = self._req_temps.get(action.req_id, 0.0)
+        big = self.buckets[-1]
+        # prefix-cache composition: a banked largest-bucket prefix (the
+        # shared-system-prompt case) replaces the first full prefill — the
+        # chain starts at the first continuation instead
+        hit = None
+        if self.prefix_cache_enabled:
+            hit = self._prefix_store.get(tuple(prompt[:big]))
+            if hit is not None:
+                self._prefix_store.move_to_end(tuple(prompt[:big]))
+                self._prefix_hits += 1
+        if hit is None:
+            packed = self._pack_rows(1, big,
+                                     [(prompt[:big], slot, big, temp)])
+            (self.cache, self.lengths, self.last_tokens, self.temps,
+             self.rng_key, toks) = self._prefill_fn(big, 1)(
+                self.params, self.cache, self.lengths, self.last_tokens,
+                self.temps, self.rng_key, self._put(packed))
+        done = big
+        pending = None if hit is None else (hit["k"], hit["v"])
+        for chunk_len, t in plan[1:]:
+            chunk = prompt[done:done + chunk_len]
+            ek, ev = (pending if pending is not None
+                      else self._extract_fn(done)(self.cache, slot))
+            pending = None
+            packed = self._pack_rows(1, t, [(chunk, slot,
+                                             done + chunk_len, temp)])
+            (self.cache, self.lengths, self.last_tokens, self.temps,
+             self.rng_key, toks) = self._cont_fn(done, t, 1)(
+                self.params, self.cache, self.lengths, self.last_tokens,
+                self.temps, self.rng_key, self._put(packed), ek, ev)
+            done += chunk_len
+        return toks
 
     def run_until_idle(self) -> None:
         while self.step():
@@ -520,7 +625,11 @@ class LLMEngine:
         power-of-two wave width, plus decode) so no request ever pays XLA
         compile time. Must run before serving traffic: a cold width means
         a whole burst waits ~seconds on the compiler. Slot state is junk
-        during warmup and reset after; call only while idle."""
+        during warmup and reset after; call only while idle.
+
+        NOT pre-warmed: the chunked-prefill chain programs (extract +
+        continuation per chunk boundary) — the first prompt longer than
+        the largest bucket pays their compile, later ones are warm."""
         for bucket in self.buckets:
             width = 1
             while True:   # every power of two through next-pow2(n_slots):
@@ -541,8 +650,10 @@ class LLMEngine:
         if self.prefix_cache_enabled:
             # continuation menu: (prefix bucket, tail bucket, width) pairs,
             # plus the per-prefix extract programs. buckets[-1] is excluded
-            # as a prefix: the scheduler rejects prompts longer than the
-            # largest bucket, so a full-bucket prefix is unreachable.
+            # as a prefix HERE because short-prompt hits can't reach it
+            # (_prefix_len_for needs p < prompt_len <= largest bucket);
+            # chunked-prefill requests DO compile (p=buckets[-1], t, 1)
+            # continuation programs — lazily, like the rest of the chain.
             # Only the first `warm_cont_pairs` pairs are pre-compiled (the
             # menu grows quadratically in buckets — see __init__); colder
             # pairs compile lazily on first hit.

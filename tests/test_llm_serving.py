@@ -591,7 +591,10 @@ def test_openai_unservable_prompts_get_4xx_5xx_not_sse(completion_server):
     import http.client
     import json as _json
 
-    long_prompt = list(range(1, 60))   # exceeds the largest bucket (48)
+    # 59 tokens: chunked prefill covers 48, but the 11-token tail's only
+    # bucket (48) would overflow max_len 64 — genuinely unservable on
+    # this engine even with chunking
+    long_prompt = list(range(1, 60))
     for stream in (False, True):
         conn = http.client.HTTPConnection(
             "127.0.0.1", completion_server.port, timeout=30)
@@ -604,7 +607,8 @@ def test_openai_unservable_prompts_get_4xx_5xx_not_sse(completion_server):
         out = _json.loads(resp.read())
         conn.close()
         assert resp.status == 400, (stream, out)
-        assert "exceeds buckets" in out["error"]
+        assert "fits no bucket" in out["error"] or \
+            "exceeds buckets" in out["error"]
 
 
 # -- temperature sampling -----------------------------------------------------
@@ -701,3 +705,59 @@ def test_nonfinite_temperature_rejected(tiny, completion_server):
     out = _json.loads(resp.read())
     conn.close()
     assert resp.status == 400 and "finite" in out["error"]
+
+
+def test_chunked_prefill_long_prompt_matches_ref(tiny):
+    """Prompts longer than the largest bucket chain through continuation
+    programs (chunked prefill) — previously a hard PromptTooLong."""
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=2, max_len=64, buckets=(8, 16))
+    prompt = [(7 * i + 3) % cfg.vocab_size for i in range(40)]  # > 16
+    out = engine.generate(prompt, max_new_tokens=5)
+    assert out == _ref_generate(params, cfg, prompt, 5)
+    # and mixed traffic: a short prompt rides the normal wave path while
+    # a long one chains, both correct
+    short = [5, 9, 2]
+    r_long = engine.submit(prompt, 4)
+    r_short = engine.submit(short, 4)
+    engine.run_until_idle()
+    assert engine.result(r_long) == _ref_generate(params, cfg, prompt, 4)
+    assert engine.result(r_short) == _ref_generate(params, cfg, short, 4)
+
+
+def test_chunked_prefill_rejects_no_decode_room(tiny):
+    from kubeflow_tpu.serving.scheduler import PromptTooLong
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16))
+    with pytest.raises(PromptTooLong):
+        engine.submit(list(range(32)), 4)  # == max_len: no decode room
+    # 31 tokens: chunks 16+8-bucketed tail 15 -> bucket 16, 16+16=32 <= 32
+    rid = engine.submit([1] * 31, 1)
+    engine.run_until_idle()
+    assert engine.is_done(rid)
+
+
+def test_chunked_reject_counts_in_scheduler_stats(tiny):
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16))
+    before = engine.scheduler.stats().rejected
+    with pytest.raises(PromptTooLong):
+        engine.submit(list(range(32)), 4)  # unservable even chunked
+    assert engine.scheduler.stats().rejected == before + 1
+
+
+def test_chunked_prefill_hits_prefix_store(tiny):
+    """A long shared prefix (system prompt) banks on the first chunked
+    request and skips the big-bucket prefill on the second."""
+    params, cfg = tiny
+    engine = LLMEngine(params, cfg, n_slots=2, max_len=64, buckets=(8, 16),
+                       prefix_cache=True)
+    base = [(5 * i + 2) % cfg.vocab_size for i in range(16)]
+    p1 = base + [7, 8, 9, 10, 11]   # 21 tokens: chunked (16 + tail 5)
+    p2 = base + [40, 41, 42]        # same 16-token prefix, different tail
+    out1 = engine.generate(p1, max_new_tokens=4)
+    assert out1 == _ref_generate(params, cfg, p1, 4)
+    hits0 = engine.metrics()["prefix_hits"]
+    out2 = engine.generate(p2, max_new_tokens=4)
+    assert out2 == _ref_generate(params, cfg, p2, 4)
+    assert engine.metrics()["prefix_hits"] > hits0
