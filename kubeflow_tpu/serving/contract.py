@@ -46,6 +46,8 @@ class _AbstractEngine:
     jits — the proof covers the production code path, not a re-derivation."""
 
     _prefill = LLMEngine._prefill
+    _prefill_cont = LLMEngine._prefill_cont
+    _extract_prefix = LLMEngine._extract_prefix
     _decode = LLMEngine._decode
     _cache_write = LLMEngine._cache_write
     _sample_last = staticmethod(LLMEngine._sample_last)
@@ -152,6 +154,29 @@ def aot_serving_report(
         functools.partial(eng._decode, steps=decode_steps),
         donate_argnums=(1, 2, 3, 4, 5)).lower(
         params, cache, lengths, last, temps, key, active)
+    # chunked-prefill / prefix-cache continuation steps. Every chain
+    # boundary compiles a DIFFERENT (p, t) program with a growing prefix
+    # tensor, so the contract covers the FIRST boundary (p=bucket — the
+    # prefix-cache hit shape) and the LARGEST possible boundary
+    # (p = max_len - bucket — the worst-peak program of the longest
+    # admissible prompt), plus the extract feeding it.
+    cont_wave = i32((1, bucket + 3))
+
+    def cont_lower(p):
+        kv_prefix = jax.ShapeDtypeStruct(
+            (cfg.n_layers, 1, p, cfg.n_kv_heads, cfg.head_dim),
+            jnp.dtype(cfg.dtype), sharding=cache_sh)
+        return jax.jit(
+            eng._prefill_cont, donate_argnums=(1, 2, 3, 4, 5)).lower(
+            params, cache, lengths, last, temps, key, cont_wave,
+            kv_prefix, kv_prefix)
+
+    p_max = max_len - bucket
+    cont_lowered = cont_lower(bucket)
+    cont_max_lowered = cont_lower(p_max)
+    extract_lowered = jax.jit(
+        functools.partial(eng._extract_prefix, p=p_max)).lower(
+        cache, jax.ShapeDtypeStruct((), jnp.int32, sharding=repl))
 
     weight_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(params))
     cache_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(cache))
@@ -179,6 +204,9 @@ def aot_serving_report(
         peaks = {
             f"prefill_b{bucket}_w{width}": _peak(prefill_lowered.compile()),
             f"decode_x{decode_steps}": _peak(decode_lowered.compile()),
+            f"cont_p{bucket}_t{bucket}": _peak(cont_lowered.compile()),
+            f"cont_p{p_max}_t{bucket}": _peak(cont_max_lowered.compile()),
+            f"extract_p{p_max}": _peak(extract_lowered.compile()),
         }
         report["compiled"] = True
         report["peak_bytes_per_device"] = peaks

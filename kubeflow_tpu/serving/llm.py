@@ -31,7 +31,7 @@ import numpy as np
 
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.serving.scheduler import (DecodeAction, PrefillAction,
-                                            make_scheduler)
+                                            PromptTooLong, make_scheduler)
 
 
 class LLMEngine:
@@ -436,8 +436,6 @@ class LLMEngine:
         largest bucket: [(chunk_len, program_len), ...] — full largest-
         bucket chunks, then a tail rounded up to a bucket. Raises
         PromptTooLong when no tail bucket fits inside max_len."""
-        from kubeflow_tpu.serving.scheduler import PromptTooLong
-
         big = self.buckets[-1]
         if n >= self.max_len:
             raise PromptTooLong(
@@ -465,8 +463,6 @@ class LLMEngine:
         # thread (wave packing), killing serving for every request
         if not (math.isfinite(temperature) and 0 <= temperature <= 100):
             raise ValueError("temperature must be finite and in [0, 100]")
-        from kubeflow_tpu.serving.scheduler import PromptTooLong
-
         sched_len = len(prompt)
         if sched_len > self.buckets[-1]:
             # chunked prefill: validate the chain now (fail at submit, not
@@ -475,13 +471,16 @@ class LLMEngine:
             try:
                 self._chunk_plan(sched_len)
             except PromptTooLong:
-                # route the rejection THROUGH the scheduler so its
-                # rejected counter (the operator-facing metric) still
-                # counts unservable prompts
+                # bump the scheduler's rejected counter (the operator
+                # metric) but surface the chunk-aware message, not the
+                # scheduler's generic "exceeds buckets"
                 with self._submit_lock:
-                    self.scheduler.submit(sched_len, max_new_tokens,
-                                          time.monotonic())
-                raise  # unreachable: the scheduler submit raises first
+                    try:
+                        self.scheduler.submit(sched_len, max_new_tokens,
+                                              time.monotonic())
+                    except PromptTooLong:
+                        pass
+                raise
             sched_len = self.buckets[-1]
         with self._submit_lock:
             req_id = self.scheduler.submit(sched_len, max_new_tokens,
@@ -500,7 +499,9 @@ class LLMEngine:
         All queued prefills drain into per-bucket BATCHED programs (one
         dispatch per bucket group) and every wave dispatches before any
         token fetch, so a burst of n arrivals pays ~one program dispatch +
-        one RTT instead of n of each."""
+        one RTT instead of n of each. Exception: prompts longer than the
+        largest bucket run as per-request chained dispatches (2 per chunk
+        boundary) — long-prompt TTFT scales with the chain length."""
         with self._submit_lock:
             action = self.scheduler.next()
         if action is None:
@@ -553,13 +554,11 @@ class LLMEngine:
             # store fresh prefixes BEFORE the fetch loop: recording a
             # request's final token pops its prompt, and extraction only
             # needs the (device-ordered) prefill to have been dispatched.
-            # Chunked requests bank their largest-bucket prefix too — the
-            # shared-system-prompt workload is exactly the long one.
+            # (Chunked requests banked theirs inside the chain, reusing
+            # the boundary-1 extract.)
             for wave, _ in dispatched[:len(groups)]:
                 for a in wave:
                     self._maybe_store_prefix(a)
-            for a in chunked:
-                self._maybe_store_prefix(a)
         for wave, toks in dispatched:
             toks_np = np.asarray(toks)   # one fetch per wave
             for i, a in enumerate(wave):
@@ -606,6 +605,10 @@ class LLMEngine:
             chunk = prompt[done:done + chunk_len]
             ek, ev = (pending if pending is not None
                       else self._extract_fn(done)(self.cache, slot))
+            if (done == big and hit is None and self.prefix_cache_enabled):
+                # bank the largest-bucket prefix from the boundary-1
+                # extract we just ran — no second extract dispatch
+                self._store_prefix_entry(tuple(prompt[:big]), ek, ev)
             pending = None
             packed = self._pack_rows(1, t, [(chunk, slot,
                                              done + chunk_len, temp)])
@@ -810,6 +813,12 @@ class LLMEngine:
             k_prefix, v_prefix)
         return toks
 
+    def _store_prefix_entry(self, key: tuple, k, v) -> None:
+        self._prefix_misses += 1
+        self._prefix_store[key] = {"k": k, "v": v}
+        while len(self._prefix_store) > self.max_prefixes:
+            self._prefix_store.popitem(last=False)  # LRU eviction
+
     def _maybe_store_prefix(self, action) -> None:
         """After a FULL prefill, bank the slot's bucket-length prefix KV
         (device-to-device slice; nothing crosses the host)."""
@@ -822,11 +831,8 @@ class LLMEngine:
         key = tuple(prompt[:p])
         if key in self._prefix_store:
             return
-        self._prefix_misses += 1
         k, v = self._extract_fn(p)(self.cache, action.slot)
-        self._prefix_store[key] = {"k": k, "v": v}
-        while len(self._prefix_store) > self.max_prefixes:
-            self._prefix_store.popitem(last=False)  # LRU eviction
+        self._store_prefix_entry(key, k, v)
 
     def _dispatch_prefill_wave(self, bucket: int,
                                wave: list[PrefillAction]):

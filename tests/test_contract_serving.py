@@ -53,5 +53,8 @@ def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(quantize,
         bf16_cache = 32 * 8 * 8192 * 1 * 128 * 2 * 2
         assert report["kv_cache_bytes_per_device"] < 0.6 * bf16_cache
     peaks = report["peak_bytes_per_device"]
-    assert set(peaks) == {"prefill_b2048_w4", "decode_x8"}
+    assert set(peaks) == {"prefill_b2048_w4", "decode_x8",
+                          "cont_p2048_t2048",   # prefix-hit / 1st boundary
+                          "cont_p6144_t2048",   # largest chain boundary
+                          "extract_p6144"}      # the extract feeding it
     assert all(p > 0 for p in peaks.values())
