@@ -490,6 +490,94 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
     return logits[:, 0], new_cache
 
 
+def verify_step(params: Params, tokens: jax.Array, cache: Params,
+                lengths: jax.Array, cfg: LlamaConfig,
+                span: int | None = None):
+    """Speculative-verify step: forward S_v tokens per slot in ONE pass.
+
+    tokens: [B, S_v] — row b holds the slot's pending last token followed by
+    S_v-1 draft tokens; they occupy positions lengths[b]..lengths[b]+S_v-1.
+    Returns (logits [B, S_v, vocab] fp32, updated cache): logits[:, i] is the
+    model's next-token distribution after consuming tokens[:, i] — the
+    verifier accepts the longest draft prefix where argmax(logits[:, i]) ==
+    tokens[:, i+1] (serving/llm.py). KV rows for ALL S_v positions are
+    written (rejected rows become stale, masked by `lengths` and overwritten
+    by later writes — same contract as decode_step's junk writes for
+    inactive slots). With S_v=1 this is exactly decode_step.
+
+    The per-slot position offsets are what distinguish this from a prefill:
+    every slot verifies at a DIFFERENT depth in its cache, which is why the
+    reference's GPU runtimes (⊘ vllm speculative worker) need a dedicated
+    program here too. Decode is HBM-bound on weight+cache reads, so the
+    extra S_v-1 query rows ride along nearly free — that asymmetry is the
+    entire speculative-decoding bet.
+    """
+    b, s_v = tokens.shape
+    max_len = cache["k"].shape[2]
+    span = max_len if span is None else min(span, max_len)
+    quantized = "k_s" in cache
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, S_v, D]
+    rows = jnp.arange(b)
+    positions = lengths[:, None] + jnp.arange(s_v)[None]  # [B, S_v]
+    k_pos = jnp.arange(span)
+    # query i (position lengths+i) attends keys at k_pos <= lengths+i
+    mask = (k_pos[None, None, :] <= positions[:, :, None])[:, None]  # [B,1,Sv,span]
+    # drop mode: inactive slots can carry lengths near max_len — their junk
+    # writes must vanish, not clamp onto the last live row
+    idx = (rows[:, None], positions)
+
+    def body(carry, inp):
+        x = carry
+        if quantized:
+            layer, ck, cv, cks, cvs = inp
+        else:
+            layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
+        q, k_new, v_new = _project_qkv(cfg, layer, x, positions)
+        if quantized:
+            kq, ksc = quantize_kv(k_new)
+            vq, vsc = quantize_kv(v_new)
+            ck = ck.at[idx].set(kq, mode="drop")
+            cv = cv.at[idx].set(vq, mode="drop")
+            cks = cks.at[idx].set(ksc, mode="drop")
+            cvs = cvs.at[idx].set(vsc, mode="drop")
+            k_att = dequantize_kv(
+                jax.lax.slice_in_dim(ck, 0, span, axis=1),
+                jax.lax.slice_in_dim(cks, 0, span, axis=1), cfg.dtype)
+            v_att = dequantize_kv(
+                jax.lax.slice_in_dim(cv, 0, span, axis=1),
+                jax.lax.slice_in_dim(cvs, 0, span, axis=1), cfg.dtype)
+        else:
+            ck = ck.at[idx].set(k_new.astype(ck.dtype), mode="drop")
+            cv = cv.at[idx].set(v_new.astype(cv.dtype), mode="drop")
+            k_att = jax.lax.slice_in_dim(ck, 0, span, axis=1)
+            v_att = jax.lax.slice_in_dim(cv, 0, span, axis=1)
+        nh, nkv = cfg.n_heads, cfg.n_kv_heads
+        kf = repeat_kv(k_att, nh // nkv)
+        vf = repeat_kv(v_att, nh // nkv)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
+                            preferred_element_type=jnp.float32)
+        logits *= 1.0 / (cfg.head_dim ** 0.5)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        x = x + quant.matmul(out.reshape(b, s_v, -1), layer["wo"], cfg.dtype)
+        x = _mlp(cfg, x, layer)
+        return x, ((ck, cv, cks, cvs) if quantized else (ck, cv))
+
+    if quantized:
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["k_s"], cache["v_s"]))
+        new_cache = {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
+                                             cache["k"], cache["v"]))
+        new_cache = {"k": ks, "v": vs}
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
+    return logits, new_cache
+
+
 # ---------------------------------------------------------------------------
 # HuggingFace checkpoint ingestion (SURVEY.md §2.4 huggingfaceserver slot;
 # VERDICT r1 missing #2: real published weights must be servable).

@@ -34,6 +34,38 @@ from kubeflow_tpu.serving.scheduler import (DecodeAction, PrefillAction,
                                             PromptTooLong, make_scheduler)
 
 
+def _ngram_draft(hist, lengths, k: int, n: int):
+    """Prompt-lookup drafting, vectorized over slots (device-side — no host
+    round-trip, so it can live inside the scanned decode program).
+
+    hist: [B, L] token history — positions 0..lengths[b] are real (the token
+    at `lengths` is the pending last token, recorded by the caller just
+    before drafting); beyond that is stale garbage the masks exclude.
+    For each slot: find the LATEST position j < lengths where the n-gram
+    hist[j-n+1..j] equals the context's trailing n-gram hist[lengths-n+1..
+    lengths], and propose the k tokens that followed it. Returns
+    (drafts [B, k] int32, count [B] int32) — count is how many proposals
+    are real (0 when no match / not enough known continuation tokens).
+    """
+    b, l = hist.shape
+    gram_pos = jnp.clip(lengths[:, None] + jnp.arange(1 - n, 1)[None],
+                        0, l - 1)
+    gram = jnp.take_along_axis(hist, gram_pos, axis=1)  # [B, n]
+    # window ending at j matches iff hist[j-n+1+t] == gram[t] for all t;
+    # n static slices — the whole match is a handful of [B, L] compares
+    m = jnp.ones((b, l - n + 1), bool)
+    for t in range(n):
+        m = m & (hist[:, t:l - n + 1 + t] == gram[:, t:t + 1])
+    jend = jnp.arange(n - 1, l)[None]  # window-end position per column
+    valid = m & (jend < lengths[:, None]) & (lengths[:, None] >= n)
+    j_best = jnp.max(jnp.where(valid, jend, -1), axis=1)  # [B]; -1 = none
+    dpos = jnp.clip(j_best[:, None] + 1 + jnp.arange(k)[None], 0, l - 1)
+    drafts = jnp.take_along_axis(hist, dpos, axis=1).astype(jnp.int32)
+    # continuation tokens are only known through position `lengths`
+    count = jnp.where(j_best >= 0, jnp.clip(lengths - j_best, 0, k), 0)
+    return drafts, count.astype(jnp.int32)
+
+
 class LLMEngine:
     """Greedy continuous-batching generation over llama-family params."""
 
@@ -45,13 +77,35 @@ class LLMEngine:
                  prefix_cache: bool = False, max_prefixes: int = 4,
                  quantize: str | None = None,
                  warm_cont_pairs: int | None = 4,
-                 kv_quantize: str | None = None):
+                 kv_quantize: str | None = None,
+                 speculative: int | None = None,
+                 spec_ngram: int = 3):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         if kv_quantize not in (None, "int8"):
             raise ValueError(f"unknown kv_quantize mode {kv_quantize!r}")
+        if speculative is not None and not 1 <= speculative <= 16:
+            raise ValueError("speculative must be 1..16 draft tokens")
+        if spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
+        # -- speculative decoding (prompt-lookup/n-gram drafting, fully
+        # device-resident): each "decode" dispatch becomes a scan of verify
+        # steps — draft k tokens by matching the context's trailing n-gram
+        # against a device-side token-history buffer, verify all k+1
+        # positions in ONE forward (llama.verify_step), accept the longest
+        # argmax-matching prefix. Greedy output is EXACTLY the
+        # non-speculative output (tested); the win is tokens-per-dispatch
+        # on copy-heavy / low-entropy text where drafts accept. Drafting,
+        # verification, and acceptance all run inside the compiled program;
+        # the host only fetches (count, tokens) rows — on a tunneled
+        # device nothing else keeps the RTT amortized.
+        self.spec = speculative
+        self.spec_ngram = spec_ngram
+        self._spec_fns: dict[tuple[int, int], Any] = {}
+        self._spec_tokens = 0
+        self._spec_verifies = 0
         # int8 KV cache: decode re-reads the whole (span of the) cache
         # every step, so int8 storage halves that HBM traffic vs bf16 and
         # halves cache residency (2x slots or context at 8B scale);
@@ -162,8 +216,12 @@ class LLMEngine:
         only ITS shard (make_array_from_callback) — an 8B-scale cache that
         only fits sharded must never be materialized whole on one device."""
         if self.mesh is None:
-            return llama.init_cache(self.cfg, self.n_slots, self.max_len,
-                                    kv_quantize=self.kv_quantize)
+            cache = llama.init_cache(self.cfg, self.n_slots, self.max_len,
+                                     kv_quantize=self.kv_quantize)
+            if self.spec:
+                cache["hist"] = jnp.zeros((self.n_slots, self.max_len),
+                                          jnp.int32)
+            return cache
         # schema derives from init_cache — ONE source of truth for the
         # cache layout (shared with serving/contract.py)
         leaves = jax.eval_shape(lambda: llama.init_cache(
@@ -179,10 +237,15 @@ class LLMEngine:
 
         # the 4-element spec shards dim 3 (kv heads) for both the 5D int8
         # payloads and the 4D scale planes
-        return {
+        cache = {
             name: jax.make_array_from_callback(sds.shape, self._cache_sh,
                                                zeros_shard(sds))
             for name, sds in leaves.items()}
+        if self.spec:
+            # the token-history buffer is tiny: replicate it
+            cache["hist"] = jax.device_put(
+                np.zeros((self.n_slots, self.max_len), np.int32), self._repl)
+        return cache
 
     def _put(self, x):
         """Host array → device; replicated across the mesh when sharded
@@ -237,6 +300,14 @@ class LLMEngine:
         key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots, key)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
+        if self.spec:
+            # token-history mirror of the KV writes (n-gram drafting reads
+            # it); pad garbage past prompt_len is never read — the matcher
+            # masks positions > lengths
+            hist = cache["hist"]
+            for i in range(tokens.shape[0]):
+                hist = hist.at[slots[i], :bucket].set(tokens[i])
+            cache["hist"] = hist
         return (cache, lengths, last_tokens, temps, key, toks)
 
     def _cache_write(self, cache, slot, start: int, count: int, ks, vs):
@@ -280,17 +351,21 @@ class LLMEngine:
         [W, T+3] — tail tokens (prompt[P:], right-padded to the tail
         bucket) ++ [slot, full_prompt_len, temp_milli] per row; k/v_prefix:
         [L, W, P, kv, hd] (row i's prefix — different requests may hit
-        DIFFERENT store entries of the same P). Writes prefix+tail KV into
-        each slot and samples next tokens from the tails' last rows; padded
-        duplicate rows repeat their source row (idempotent writes), exactly
-        like _prefill."""
-        tokens, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
-                                      wave[:, -2])
+        DIFFERENT store entries of the same P). With speculative decoding
+        on, rows are [tail(T) ++ prefix(P) ++ slot, len, temp] — the prefix
+        KV alone can't populate the token-history buffer the n-gram drafter
+        reads, so the prefix TOKENS ride the same packed transfer. Writes
+        prefix+tail KV into each slot and samples next tokens from the
+        tails' last rows; padded duplicate rows repeat their source row
+        (idempotent writes), exactly like _prefill."""
+        tokens_all, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
+                                          wave[:, -2])
         row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
         p = k_prefix.shape[2]
+        t_bucket = tokens_all.shape[1] - (p if self.spec else 0)
+        tokens = tokens_all[:, :t_bucket]
         logits, ks, vs = llama.prefill_continue(params, tokens, k_prefix,
                                                 v_prefix, self.cfg)
-        t_bucket = tokens.shape[1]
         cache = dict(cache)
         lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
@@ -306,6 +381,13 @@ class LLMEngine:
                                       key)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
+        if self.spec:
+            hist = cache["hist"]
+            prefix_toks = tokens_all[:, t_bucket:]
+            for i in range(tokens.shape[0]):
+                hist = hist.at[slots[i], :p].set(prefix_toks[i])
+                hist = hist.at[slots[i], p:p + t_bucket].set(tokens[i])
+            cache["hist"] = hist
         return (cache, lengths, last_tokens, temps, key, toks)
 
     def _extract_prefix(self, cache, slot, *, p: int):
@@ -348,6 +430,85 @@ class LLMEngine:
             body, (cache, lengths, last_tokens, key), None, length=steps)
         # toks [steps, n_slots]
         return cache, lengths, last_tokens, temps, key, toks
+
+    def _spec_decode(self, params, cache, lengths, last_tokens, temps, key,
+                     active, *, steps: int, span: int):
+        """`steps` speculative verify rounds inside ONE program: each round
+        records the pending token into the history buffer, drafts up to
+        `self.spec` tokens by n-gram lookup (_ngram_draft), verifies all
+        drafts in one llama.verify_step forward, and accepts the longest
+        argmax-matching prefix plus the model's own bonus token — 1..spec+1
+        tokens per round per slot, at ~one decode-step's HBM cost. Greedy
+        slots get EXACT greedy output (verification IS the greedy model);
+        sampled slots (temp>0) draft nothing and sample the bonus, i.e.
+        degrade to plain decode. Emits [steps, B, spec+2] int32 rows:
+        [count ++ tokens] per slot per round."""
+        k_spec = self.spec
+        rows = jnp.arange(self.n_slots)
+        max_len = self.max_len
+
+        def body(carry, _):
+            cache, lengths, last_tokens, key = carry
+            hist = cache["hist"]
+            # record the pending token at its cache position (inactive
+            # slots' writes are dropped — their hist is dead state anyway,
+            # but a clamped write at max_len-1 could land on a live row)
+            hist = hist.at[rows, jnp.where(active, lengths, max_len)].set(
+                last_tokens, mode="drop")
+            drafts, count = _ngram_draft(hist, lengths, k_spec,
+                                         self.spec_ngram)
+            count = jnp.where(active & (temps <= 0), count, 0)
+            tokens_in = jnp.concatenate([last_tokens[:, None], drafts],
+                                        axis=1)
+            kv = {k: v for k, v in cache.items() if k != "hist"}
+            logits, kv = llama.verify_step(params, tokens_in, kv, lengths,
+                                           self.cfg, span=span)
+            preds = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
+            match = ((preds[:, :k_spec] == drafts)
+                     & (jnp.arange(k_spec)[None] < count[:, None]))
+            # length of the leading all-True run = accepted drafts
+            n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                            axis=1)
+            bonus_greedy = jnp.take_along_axis(preds, n_acc[:, None],
+                                               axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            bonus = jnp.where(temps > 0,
+                              self._pick(logits[:, 0], temps, sub),
+                              bonus_greedy)
+            jj = jnp.arange(k_spec + 1)[None]
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((self.n_slots, 1), jnp.int32)], axis=1)
+            emit = jnp.where(jj < n_acc[:, None], drafts_pad,
+                             jnp.where(jj == n_acc[:, None],
+                                       bonus[:, None], 0))
+            emit_count = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+            # accepted drafts enter the history now; the bonus token lands
+            # next round as the pending last_token
+            wpos = lengths[:, None] + 1 + jnp.arange(k_spec)[None]
+            wmask = (jnp.arange(k_spec)[None] < n_acc[:, None]) \
+                & active[:, None]
+            hist = hist.at[rows[:, None],
+                           jnp.where(wmask, wpos, max_len)].set(
+                drafts, mode="drop")
+            kv["hist"] = hist
+            new_len = lengths + emit_count
+            new_last = jnp.where(active, bonus, last_tokens)
+            packed = jnp.concatenate([emit_count[:, None], emit], axis=1)
+            return (kv, new_len, new_last, key), packed
+
+        (cache, lengths, last_tokens, key), out = jax.lax.scan(
+            body, (cache, lengths, last_tokens, key), None, length=steps)
+        return cache, lengths, last_tokens, temps, key, out
+
+    def _spec_fn(self, steps: int, span: int | None = None):
+        """Compiled speculative program per (rounds, attention span) — the
+        spec-mode twin of _decode_fn's menu."""
+        span = self.max_len if span is None else span
+        if (steps, span) not in self._spec_fns:
+            self._spec_fns[steps, span] = jax.jit(
+                functools.partial(self._spec_decode, steps=steps, span=span),
+                donate_argnums=(1, 2, 3, 4, 5))
+        return self._spec_fns[steps, span]
 
     def _prefill_fn(self, bucket: int, width: int):
         """One compiled program per (bucket, wave-width) pair; widths are
@@ -610,8 +771,14 @@ class LLMEngine:
                 # extract we just ran — no second extract dispatch
                 self._store_prefix_entry(tuple(prompt[:big]), ek, ev)
             pending = None
-            packed = self._pack_rows(1, t, [(chunk, slot,
-                                             done + chunk_len, temp)])
+            # the chain boundary is a continuation with the request's OWN
+            # prefix (p == done), so the row layout comes from the same
+            # helper the cont waves use
+            row_toks = self._cont_row_tokens(
+                list(prompt[:done + chunk_len]), done, t)
+            packed = self._pack_rows(1, t + (done if self.spec else 0),
+                                     [(row_toks, slot,
+                                       done + chunk_len, temp)])
             (self.cache, self.lengths, self.last_tokens, self.temps,
              self.rng_key, toks) = self._cont_fn(done, t, 1)(
                 self.params, self.cache, self.lengths, self.last_tokens,
@@ -671,7 +838,8 @@ class LLMEngine:
                 ek, ev = extracts[p]
                 width = 1
                 while True:
-                    packed = np.zeros((width, t + 3), np.int32)
+                    cols = t + (p if self.spec else 0) + 3
+                    packed = np.zeros((width, cols), np.int32)
                     packed[:, 0] = 1
                     packed[:, -3] = np.arange(width) % self.n_slots
                     packed[:, -2] = p + 1   # last-row index stays valid
@@ -699,13 +867,17 @@ class LLMEngine:
             combos = ([(c, self.max_len) for c in chunks]
                       + [(chunks[-1], s) for s in spans[:-1]])
         toks = None
+        # spec mode dispatches _spec_fn instead of _decode_fn — warm THAT
+        # menu (the plain decode menu would be dead weight)
+        fn = self._spec_fn if self.spec else self._decode_fn
         for c, span in combos:
             (self.cache, self.lengths, self.last_tokens, self.temps,
-             self.rng_key, toks) = self._decode_fn(c, span)(
+             self.rng_key, toks) = fn(c, span)(
                 self.params, self.cache, self.lengths, self.last_tokens,
                 self.temps, self.rng_key,
                 self._put(np.zeros((self.n_slots,), bool)))
-        float(toks[0, 0])   # sync: compile + execute finished (axon-safe)
+        float(np.asarray(toks).flat[0])  # sync: compile + execute finished
+        # (axon-safe: a value fetch, not block_until_ready)
         # reset via _put, not zeros_like: under a mesh the reset arrays must
         # carry the same committed replicated sharding the programs were
         # traced with, or the first live request retraces (= recompiles)
@@ -765,6 +937,13 @@ class LLMEngine:
             out["prefix_hits"] = self._prefix_hits
             out["prefix_misses"] = self._prefix_misses
             out["prefix_entries"] = len(self._prefix_store)
+        if self.spec:
+            out["spec_verify_rounds"] = self._spec_verifies
+            out["spec_tokens_emitted"] = self._spec_tokens
+            # 1.0 = no draft ever accepted (plain-decode cost); spec+1 =
+            # every draft accepted — the effective per-round multiplier
+            out["spec_tokens_per_round"] = round(
+                self._spec_tokens / max(1, self._spec_verifies), 3)
         if ttfts:
             out["ttft_p50_s"] = float(np.percentile(ttfts, 50))
             out["ttft_p99_s"] = float(np.percentile(ttfts, 99))
@@ -792,6 +971,16 @@ class LLMEngine:
             packed[i, -1] = self._pack_temp(temp)
         return packed
 
+    def _cont_row_tokens(self, prompt: list[int], p: int, t: int):
+        """A continuation row's token columns: the tail (prompt[p:p+...],
+        padded to the tail bucket by _pack_rows) — plus, in speculative
+        mode, the p prefix tokens appended after a pad-to-t, so the
+        compiled program can mirror them into the history buffer."""
+        tail = prompt[p:]
+        if not self.spec:
+            return tail
+        return tail + [0] * (t - len(tail)) + prompt[:p]
+
     def _dispatch_prefill_cont_wave(self, p: int, t: int, pairs):
         """Dispatch ONE batched continuation prefill for all hits sharing
         (prefix bucket, tail bucket) — a shared-prefix burst costs one
@@ -801,9 +990,10 @@ class LLMEngine:
         while width < len(pairs):
             width *= 2
         padded = list(pairs) + [pairs[-1]] * (width - len(pairs))
-        rows = [(self._prompts[a.req_id][p:], a.slot, a.prompt_len,
+        rows = [(self._cont_row_tokens(self._prompts[a.req_id], p, t),
+                 a.slot, a.prompt_len,
                  self._req_temps.get(a.req_id, 0.0)) for a, _ in padded]
-        packed = self._pack_rows(width, t, rows)
+        packed = self._pack_rows(width, t + (p if self.spec else 0), rows)
         k_prefix = jnp.concatenate([e["k"] for _, e in padded], axis=1)
         v_prefix = jnp.concatenate([e["v"] for _, e in padded], axis=1)
         (self.cache, self.lengths, self.last_tokens, self.temps,
@@ -868,6 +1058,9 @@ class LLMEngine:
         surplus tokens are dropped host-side, and new arrivals wait at
         most one chunk for their prefill — decode_chunk bounds scheduling
         latency."""
+        if self.spec:
+            self._do_spec_decode()
+            return
         slot_req = [self.scheduler.slot_request(s) for s in range(self.n_slots)]
         active = np.array([r >= 0 for r in slot_req], bool)
         remaining = max(self._max_new[r] - len(self._results[r])
@@ -905,6 +1098,53 @@ class LLMEngine:
                     # the shared _done set — decides, so a concurrent
                     # release() from a server thread can't unfinish it.
                     done_slots.add(slot)
+
+    def _do_spec_decode(self) -> None:
+        """Speculative twin of _do_decode: dispatch one scanned program of
+        verify rounds, then replay the emitted (count, tokens) rows in
+        order. `steps` rounds advance a slot by 1..spec+1 tokens each, so
+        the round count is bounded by cache headroom at the worst case
+        (every draft accepted) — surplus tokens past EOS/budget are dropped
+        host-side exactly like mid-chunk decode finishes."""
+        slot_req = [self.scheduler.slot_request(s)
+                    for s in range(self.n_slots)]
+        active = np.array([r >= 0 for r in slot_req], bool)
+        remaining = max(self._max_new[r] - len(self._results[r])
+                        for r in slot_req if r >= 0)
+        kp1 = self.spec + 1
+        headroom = self.max_len - int(
+            max(self._host_lengths[s] for s in range(self.n_slots)
+                if active[s]))
+        steps = 1
+        while (steps * 2 <= self.decode_chunk
+               and steps * 2 * kp1 <= headroom and steps < remaining):
+            steps *= 2
+        longest = int(max((self._host_lengths[s]
+                           for s in range(self.n_slots) if active[s]),
+                          default=0))
+        span = self._pick_span(min(longest + steps * kp1, self.max_len))
+        (self.cache, self.lengths, self.last_tokens, self.temps,
+         self.rng_key, out) = self._spec_fn(steps, span)(
+            self.params, self.cache, self.lengths, self.last_tokens,
+            self.temps, self.rng_key, self._put(active))
+        out_np = np.asarray(out)   # [steps, n_slots, spec+2]; one fetch
+        done_slots: set[int] = set()
+        for s in range(steps):
+            for slot, req in enumerate(slot_req):
+                if req < 0 or slot in done_slots:
+                    continue
+                cnt = int(out_np[s, slot, 0])
+                self._spec_verifies += 1
+                for j in range(cnt):
+                    self._host_lengths[slot] += 1
+                    # count DELIVERED tokens, not the round's emit count:
+                    # a mid-round finish drops the surplus, and the
+                    # tokens-per-round metric must not claim them
+                    self._spec_tokens += 1
+                    if self._record_token(req, slot,
+                                          int(out_np[s, slot, 1 + j])):
+                        done_slots.add(slot)
+                        break
 
     def _record_token(self, req_id: int, slot: int, token: int,
                       first_token: bool = False) -> bool:

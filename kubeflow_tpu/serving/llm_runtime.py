@@ -43,7 +43,9 @@ class LLMModel(Model):
                  tokenizer: str | None = None,
                  prefix_cache: bool = False, max_prefixes: int = 4,
                  quantize: str | None = None,
-                 kv_quantize: str | None = None, **_ignored: Any):
+                 kv_quantize: str | None = None,
+                 speculative: int | None = None,
+                 spec_ngram: int = 3, **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
@@ -61,6 +63,8 @@ class LLMModel(Model):
         self._max_prefixes = max_prefixes
         self._quantize = quantize
         self._kv_quantize = kv_quantize
+        self._speculative = speculative
+        self._spec_ngram = spec_ngram
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -116,7 +120,9 @@ class LLMModel(Model):
                                  prefix_cache=self._prefix_cache,
                                  max_prefixes=self._max_prefixes,
                                  quantize=self._quantize,
-                                 kv_quantize=self._kv_quantize)
+                                 kv_quantize=self._kv_quantize,
+                                 speculative=self._speculative,
+                                 spec_ngram=self._spec_ngram)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()

@@ -1,0 +1,232 @@
+"""Speculative decoding (prompt-lookup n-gram drafting + single-pass verify).
+
+The engine contract under test: with ``speculative=k`` the GREEDY output of
+every request is byte-identical to the non-speculative engine (verification
+IS the greedy model — acceptance only short-cuts dispatches, never changes
+tokens), while on low-entropy/copy-heavy text more than one token is
+emitted per verify round. ⊘ vllm speculative decoding (ngram lookup);
+the reference platform itself has no serving runtime at all (SURVEY §2.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.llm import LLMEngine, _ngram_draft
+
+
+def tiny_cfg(**kw):
+    return llama.LlamaConfig.tiny(**kw)
+
+
+@pytest.fixture(scope="module")
+def params_cfg():
+    cfg = tiny_cfg()
+    return llama.init(jax.random.key(0), cfg), cfg
+
+
+@pytest.fixture(scope="module")
+def trained_params_cfg():
+    """Tiny llama trained to continue a repeating 8-gram — a deterministic
+    low-entropy continuation task, the regime prompt-lookup exploits (the
+    serving analog of copy-heavy summarization/extraction)."""
+    cfg = tiny_cfg()
+    pattern = np.array([3, 11, 7, 19, 2, 31, 5, 23], np.int32)
+    tokens = np.tile(pattern, 64)[: 4 * 64].reshape(4, 64)
+    params = llama.init(jax.random.key(1), cfg)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, _), grads = jax.value_and_grad(
+            llama.loss_fn, has_aux=True)(params, batch, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for _ in range(120):
+        params, opt_state, loss = step(params, opt_state)
+    assert float(loss) < 0.5, f"tiny model failed to learn: loss={loss}"
+    return params, cfg, pattern
+
+
+# -- unit: the drafter -------------------------------------------------------
+
+
+def test_ngram_draft_finds_latest_match():
+    # hist: ...[5,6] at j=1, later [5,6] again ending at lengths=7
+    hist = jnp.array([[4, 5, 6, 9, 8, 7, 5, 6, 0, 0]], jnp.int32)
+    lengths = jnp.array([7], jnp.int32)
+    drafts, count = _ngram_draft(hist, lengths, k=3, n=2)
+    # latest earlier [5,6] window ends at j=2 -> drafts = hist[3:6] = 9,8,7
+    assert count[0] == 3
+    np.testing.assert_array_equal(np.asarray(drafts)[0], [9, 8, 7])
+
+
+def test_ngram_draft_no_match_and_short_context():
+    hist = jnp.array([[1, 2, 3, 4, 5, 0, 0, 0]], jnp.int32)
+    drafts, count = _ngram_draft(hist, jnp.array([4], jnp.int32), k=2, n=2)
+    assert count[0] == 0  # no repeated bigram
+    drafts, count = _ngram_draft(
+        jnp.array([[9, 0, 0, 0]], jnp.int32), jnp.array([0], jnp.int32),
+        k=2, n=2)
+    assert count[0] == 0  # context shorter than the gram
+
+
+def test_ngram_draft_count_clipped_by_known_tokens():
+    # match ends right before the pending token: only 1 continuation known
+    hist = jnp.array([[5, 6, 9, 5, 6, 0, 0, 0]], jnp.int32)
+    lengths = jnp.array([4], jnp.int32)  # pending token at 4 (=6)
+    drafts, count = _ngram_draft(hist, lengths, k=3, n=2)
+    # latest earlier [5,6] ends at j=1 -> continuations hist[2:5]=9,5,6 but
+    # only positions <= lengths are known -> count = min(3, 4-1) = 3
+    assert count[0] == 3
+    np.testing.assert_array_equal(np.asarray(drafts)[0], [9, 5, 6])
+
+
+# -- unit: verify_step == decode_step at S_v=1 -------------------------------
+
+
+@pytest.mark.parametrize("kv_quantize", [None, "int8"])
+def test_verify_step_matches_decode_step(params_cfg, kv_quantize):
+    params, cfg = params_cfg
+    n_slots, max_len = 2, 32
+    cache = llama.init_cache(cfg, n_slots, max_len, kv_quantize=kv_quantize)
+    # put some real context in slot KV first via a few decode steps
+    lengths = jnp.zeros((n_slots,), jnp.int32)
+    last = jnp.array([7, 11], jnp.int32)
+    for _ in range(3):
+        logits_d, cache = llama.decode_step(params, last, cache, lengths,
+                                            cfg)
+        lengths = lengths + 1
+        last = jnp.argmax(logits_d, -1).astype(jnp.int32)
+
+    v_logits, v_cache = llama.verify_step(params, last[:, None], cache,
+                                          lengths, cfg)
+    d_logits, d_cache = llama.decode_step(params, last, cache, lengths, cfg)
+    np.testing.assert_allclose(np.asarray(v_logits[:, 0]),
+                               np.asarray(d_logits), rtol=2e-2, atol=2e-2)
+    for k in cache:
+        np.testing.assert_allclose(np.asarray(v_cache[k]),
+                                   np.asarray(d_cache[k]), rtol=1e-2,
+                                   atol=1e-2)
+
+
+# -- engine: exactness + acceptance ------------------------------------------
+
+
+def build(params, cfg, spec=None, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("buckets", (16, 32))
+    e = LLMEngine(params, cfg, speculative=spec, spec_ngram=2,
+                  decode_chunk=4, **kw)
+    e.warmup()
+    return e
+
+
+def test_spec_greedy_exactness_random_model(params_cfg):
+    """Acceptance ~0 on an untrained model — the degenerate case must still
+    be exactly greedy."""
+    params, cfg = params_cfg
+    plain = build(params, cfg, spec=None)
+    spec = build(params, cfg, spec=3)
+    prompts = [[5, 9, 2, 14], [3, 3, 3, 3, 3, 3, 3, 3],
+               list(range(1, 31))]
+    for p in prompts:
+        assert spec.generate(p, 24) == plain.generate(p, 24)
+
+
+def test_spec_greedy_exactness_and_acceptance_trained(trained_params_cfg):
+    params, cfg, pattern = trained_params_cfg
+    plain = build(params, cfg, spec=None)
+    spec = build(params, cfg, spec=4)
+    prompt = list(np.tile(pattern, 3))  # 24 tokens of the learned cycle
+    out_plain = plain.generate(prompt, 40)
+    out_spec = spec.generate(prompt, 40)
+    assert out_spec == out_plain
+    # the model continues the cycle and the drafter proposes exactly that
+    m = spec.metrics()
+    assert m["spec_tokens_per_round"] > 2.0, m
+    # fewer dispatch rounds is the whole point
+    assert m["spec_verify_rounds"] * 2 < len(out_spec) * 1.5 + 8
+
+
+def test_spec_batch_mixed_with_sampling(trained_params_cfg):
+    """temp>0 slots coexist: they draft nothing (degrade to plain decode)
+    while greedy slots accept; everyone terminates with the right lengths."""
+    params, cfg, pattern = trained_params_cfg
+    spec = build(params, cfg, spec=3)
+    prompt = list(np.tile(pattern, 2))
+    r_greedy = spec.submit(prompt, 16, temperature=0.0)
+    r_sample = spec.submit(prompt, 16, temperature=0.8)
+    spec.run_until_idle()
+    assert len(spec.result(r_greedy)) == 16
+    assert len(spec.result(r_sample)) == 16
+
+
+def test_spec_composes_with_prefix_cache_and_chunked(trained_params_cfg):
+    params, cfg, pattern = trained_params_cfg
+    kw = dict(prefix_cache=True, max_prefixes=4)
+    plain = build(params, cfg, spec=None, **kw)
+    spec = build(params, cfg, spec=3, **kw)
+    long_prompt = list(np.tile(pattern, 6))[:44]  # > largest bucket (32)
+    short = list(np.tile(pattern, 3))  # 24: prefix bucket 16 + tail
+    for p in (short, long_prompt, short, long_prompt):
+        assert spec.generate(p, 20) == plain.generate(p, 20)
+    assert spec.metrics()["prefix_hits"] >= 1
+
+
+@pytest.mark.parametrize("kv_quantize", [None, "int8"])
+def test_spec_int8_kv(trained_params_cfg, kv_quantize):
+    """int8 KV + speculative: exactness holds vs the SAME-quantization
+    plain engine (int8 rounding may flip near-ties vs bf16, so compare
+    within the quantization mode)."""
+    params, cfg, pattern = trained_params_cfg
+    plain = build(params, cfg, spec=None, kv_quantize=kv_quantize)
+    spec = build(params, cfg, spec=3, kv_quantize=kv_quantize)
+    prompt = list(np.tile(pattern, 3))
+    assert spec.generate(prompt, 24) == plain.generate(prompt, 24)
+
+
+def test_runtime_forwards_speculative():
+    """`config: {speculative: k}` on an InferenceService must reach the
+    engine (the serving-stack path, not just direct construction)."""
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+
+    m = LLMModel("llm", model=dict(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=128, max_seq_len=128, rope_theta=10000.0),
+        n_slots=2, max_len=64, buckets=(16,), speculative=3, spec_ngram=2)
+    m.load()
+    try:
+        assert m._engine.spec == 3 and m._engine.spec_ngram == 2
+        out = m.predict({"prompt_tokens": [1, 2, 3, 4],
+                         "max_new_tokens": 8})
+        assert len(out["output_tokens"]) == 8
+        assert m.metrics()["spec_verify_rounds"] >= 1
+    finally:
+        m.unload()
+
+
+def test_spec_eos_mid_round(trained_params_cfg):
+    """EOS inside an accepted run: surplus tokens are dropped and the
+    request finishes at the EOS with finish_reason 'stop'."""
+    params, cfg, pattern = trained_params_cfg
+    # the trained model emits the cycle deterministically; pick the token
+    # the cycle emits a few steps in as the EOS id
+    plain = build(params, cfg, spec=None)
+    prompt = list(np.tile(pattern, 3))
+    out = plain.generate(prompt, 12)
+    eos = out[5]
+    spec = build(params, cfg, spec=4, eos_id=eos)
+    rid = spec.submit(prompt, 40)
+    spec.run_until_idle()
+    got = spec.result(rid)
+    assert got == out[:out.index(eos) + 1]
+    assert spec.finish_reason(rid) == "stop"
