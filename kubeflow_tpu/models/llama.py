@@ -428,65 +428,12 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
     mask discards anyway. Decode is HBM-bound on those KV reads at long
     max_len, so the slice is the throughput lever. Caller guarantees
     lengths < span; writes still land in the full cache.
+
+    This IS verify_step at S_v=1 — one attention body, so a masking or
+    quantization change can never diverge the plain and speculative paths.
     """
-    b = last_tokens.shape[0]
-    max_len = cache["k"].shape[2]
-    span = max_len if span is None else min(span, max_len)
-    quantized = "k_s" in cache
-    x = params["embed"].astype(cfg.dtype)[last_tokens][:, None]  # [B,1,D]
-    rows = jnp.arange(b)
-    k_pos = jnp.arange(span)
-
-    def body(carry, inp):
-        x = carry
-        if quantized:
-            layer, ck, cv, cks, cvs = inp  # int8 [B,max_len,kv,hd] + scales
-        else:
-            layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
-        q, k_new, v_new = _project_qkv(cfg, layer, x, lengths[:, None])
-        if quantized:
-            kq, ksc = quantize_kv(k_new[:, 0])
-            vq, vsc = quantize_kv(v_new[:, 0])
-            ck = ck.at[rows, lengths].set(kq)
-            cv = cv.at[rows, lengths].set(vq)
-            cks = cks.at[rows, lengths].set(ksc)
-            cvs = cvs.at[rows, lengths].set(vsc)
-            k_att = dequantize_kv(
-                jax.lax.slice_in_dim(ck, 0, span, axis=1),
-                jax.lax.slice_in_dim(cks, 0, span, axis=1), cfg.dtype)
-            v_att = dequantize_kv(
-                jax.lax.slice_in_dim(cv, 0, span, axis=1),
-                jax.lax.slice_in_dim(cvs, 0, span, axis=1), cfg.dtype)
-        else:
-            ck = ck.at[rows, lengths].set(k_new[:, 0])
-            cv = cv.at[rows, lengths].set(v_new[:, 0])
-            k_att = jax.lax.slice_in_dim(ck, 0, span, axis=1)
-            v_att = jax.lax.slice_in_dim(cv, 0, span, axis=1)
-        nh, nkv = cfg.n_heads, cfg.n_kv_heads
-        kf = repeat_kv(k_att, nh // nkv)
-        vf = repeat_kv(v_att, nh // nkv)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
-                            preferred_element_type=jnp.float32)
-        logits *= 1.0 / (cfg.head_dim ** 0.5)
-        mask = (k_pos[None, :] <= lengths[:, None])[:, None, None, :]
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
-        x = x + quant.matmul(out.reshape(b, 1, -1), layer["wo"], cfg.dtype)
-        x = _mlp(cfg, x, layer)
-        return x, ((ck, cv, cks, cvs) if quantized else (ck, cv))
-
-    if quantized:
-        x, (ks, vs, kss, vss) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["k_s"], cache["v_s"]))
-        new_cache = {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
-    else:
-        x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                             cache["k"], cache["v"]))
-        new_cache = {"k": ks, "v": vs}
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
+    logits, new_cache = verify_step(params, last_tokens[:, None], cache,
+                                    lengths, cfg, span=span)
     return logits[:, 0], new_cache
 
 

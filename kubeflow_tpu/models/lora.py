@@ -1,0 +1,144 @@
+"""LoRA fine-tuning for the llama family (PEFT parity).
+
+The reference platform fine-tunes via user containers (PEFT/HF inside a
+PyTorchJob — SURVEY.md L7); newer kubeflow trainer ships LoRA trainers as
+first-class blueprints. Here LoRA is a registered model family
+(``model: llama_lora`` in a JAXJob) so every platform surface — trainer,
+HPO sweeps over rank/alpha, checkpointing, serving export — composes with
+it unchanged.
+
+Design (TPU-first):
+  - params = {"base": <frozen llama tree>, "lora": {target: {"a", "b"}}} —
+    the base rides under ``jax.lax.stop_gradient``, so its backward pass is
+    never computed; the optimizer additionally freezes it structurally
+    (OptimizerConfig.trainable_prefix="lora"), so Adam moments exist ONLY
+    for adapter leaves — the memory win that makes 8B fine-tune fit where
+    full fine-tune would not.
+  - the merged weight W + (alpha/r)·A@B is materialized per step as a
+    stacked-layer einsum ("ldr,lro->ldo") and fed to the unmodified llama
+    forward: one extra O(params·r/d) matmul, zero change to the hot path,
+    and every attention mode (flash/ring/ulysses) plus the pipeline/TP/FSDP
+    shardings keep working because the merged tree IS a llama tree.
+  - export: ``merge(params, cfg)`` returns plain llama params for the
+    serving engine; ``adapter_only(params)`` is the checkpoint-sized
+    artifact (rank·(d_in+d_out) per target per layer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraLlamaConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # any stacked-layer matmul leaf of the llama tree can be a target
+    targets: tuple = ("wq", "wk", "wv", "wo")
+    # base-model fields (LlamaConfig kwargs); a JAXJob spec writes
+    # model_overrides: {rank: 8, llama: {d_model: ..., n_layers: ...}}
+    llama: dict = dataclasses.field(default_factory=dict)
+    # optional pretrained base: an HF safetensors dir or an orbax params
+    # checkpoint (the realistic fine-tune path); None = random init (tests)
+    base_checkpoint: str | None = None
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError("lora rank must be >= 1")
+        known = set(llama.QUANT_LEAVES)
+        bad = set(self.targets) - known
+        if bad:
+            raise ValueError(f"unknown lora targets {sorted(bad)}; "
+                             f"known: {sorted(known)}")
+
+    @property
+    def base_cfg(self) -> llama.LlamaConfig:
+        return llama.LlamaConfig(**self.llama)
+
+    # the trainer logs MFU against the model config; delegate the fields
+    # it reads so llama_lora quacks like its base where it matters
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.base_cfg, name)
+
+
+def _load_base(cfg: LoraLlamaConfig) -> llama.Params:
+    path = cfg.base_checkpoint
+    if llama.is_hf_checkpoint(path):
+        params, _ = llama.load_hf(path, cfg.base_cfg)
+        return params
+    from kubeflow_tpu.training.checkpoint import restore_params
+
+    abstract = jax.eval_shape(
+        lambda: llama.init(jax.random.key(0), cfg.base_cfg))
+    return restore_params(path, abstract)
+
+
+def init(rng: jax.Array, cfg: LoraLlamaConfig) -> llama.Params:
+    bcfg = cfg.base_cfg
+    if cfg.base_checkpoint:
+        base = _load_base(cfg)
+    else:
+        base = llama.init(rng, bcfg)
+    pd = bcfg.param_dtype
+    adapters = {}
+    for i, t in enumerate(cfg.targets):
+        leaf = base["layers"][t]  # [L, d_in, d_out]
+        _, d_in, d_out = leaf.shape
+        k = jax.random.fold_in(rng, 1000 + i)
+        adapters[t] = {
+            # standard LoRA init: a ~ N(0, 1/d_in), b = 0 — the merged
+            # model equals the base exactly at step 0
+            "a": (jax.random.normal(k, (bcfg.n_layers, d_in, cfg.rank),
+                                    jnp.float32) / (d_in ** 0.5)).astype(pd),
+            "b": jnp.zeros((bcfg.n_layers, cfg.rank, d_out), pd),
+        }
+    return {"base": base, "lora": adapters}
+
+
+def merge(params: llama.Params, cfg: LoraLlamaConfig,
+          *, stop_base_gradient: bool = True) -> llama.Params:
+    """base + (alpha/rank)·A@B for every target — a plain llama tree (feed
+    it to llama.apply, the serving engine, or quantize_params)."""
+    base = (jax.tree.map(jax.lax.stop_gradient, params["base"])
+            if stop_base_gradient else params["base"])
+    scale = cfg.alpha / cfg.rank
+    layers = dict(base["layers"])
+    for t in cfg.targets:
+        ab = params["lora"][t]
+        delta = jnp.einsum("ldr,lro->ldo", ab["a"].astype(jnp.float32),
+                           ab["b"].astype(jnp.float32)) * scale
+        layers[t] = (base["layers"][t]
+                     + delta.astype(base["layers"][t].dtype))
+    return {**base, "layers": layers}
+
+
+def adapter_only(params: llama.Params) -> llama.Params:
+    """The checkpoint-sized artifact: just the adapter leaves."""
+    return {"lora": params["lora"]}
+
+
+def apply(params, tokens, cfg: LoraLlamaConfig, **kw):
+    return llama.apply(merge(params, cfg), tokens, cfg.base_cfg, **kw)
+
+
+def loss_fn(params, batch, cfg: LoraLlamaConfig):
+    return llama.loss_fn(merge(params, cfg), batch, cfg.base_cfg)
+
+
+def logical_axes(cfg: LoraLlamaConfig) -> llama.Params:
+    """Adapters shard like their target's matching dimension: a keeps the
+    input axis (rank replicated), b keeps the output axis — under TP/FSDP
+    the A@B einsum then contracts locally exactly like the base matmul."""
+    base = llama.logical_axes(cfg.base_cfg)
+    lora = {}
+    for t in cfg.targets:
+        _, in_ax, out_ax = base["layers"][t]  # ("layers", in, out)
+        lora[t] = {"a": ("layers", in_ax, None),
+                   "b": ("layers", None, out_ax)}
+    return {"base": base, "lora": lora}

@@ -66,11 +66,14 @@ def _populate() -> None:
 
 
 def _do_populate() -> None:
-    from kubeflow_tpu.models import (bert, llama, mnist_cnn, moe_llama,
-                                     nas_cnn, resnet, vit)
+    from kubeflow_tpu.models import (bert, llama, lora, mnist_cnn,
+                                     moe_llama, nas_cnn, resnet, vit)
 
     register("llama", ModelDef(llama.LlamaConfig, llama.init, llama.apply,
                                llama.loss_fn, llama.logical_axes))
+    register("llama_lora", ModelDef(lora.LoraLlamaConfig, lora.init,
+                                    lora.apply, lora.loss_fn,
+                                    lora.logical_axes))
     register("mixtral", ModelDef(moe_llama.MoELlamaConfig, moe_llama.init,
                                  moe_llama.apply, moe_llama.loss_fn,
                                  moe_llama.logical_axes))

@@ -56,6 +56,10 @@ class _AbstractEngine:
     def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None):
         self.cfg = cfg
         self.kv_quantize = kv_quantize
+        # the proof covers the non-speculative menu (spec mode swaps the
+        # decode program for _spec_decode; its HBM profile is the same
+        # cache + weights with an S_v-wide query — covered by the margin)
+        self.spec = None
 
 
 def _abstract_tree(tree, shardings):

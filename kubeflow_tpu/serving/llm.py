@@ -88,8 +88,11 @@ class LLMEngine:
             raise ValueError(f"unknown kv_quantize mode {kv_quantize!r}")
         if speculative is not None and not 1 <= speculative <= 16:
             raise ValueError("speculative must be 1..16 draft tokens")
-        if spec_ngram < 1:
-            raise ValueError("spec_ngram must be >= 1")
+        if not 1 <= spec_ngram <= 8:
+            # an upper bound too: a gram longer than the history window
+            # would trace a zero-size reduction in _ngram_draft — fail
+            # loudly at construction, not deep inside warmup
+            raise ValueError("spec_ngram must be 1..8")
         # -- speculative decoding (prompt-lookup/n-gram drafting, fully
         # device-resident): each "decode" dispatch becomes a scan of verify
         # steps — draft k tokens by matching the context's trailing n-gram

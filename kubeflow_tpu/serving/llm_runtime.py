@@ -45,7 +45,8 @@ class LLMModel(Model):
                  quantize: str | None = None,
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
-                 spec_ngram: int = 3, **_ignored: Any):
+                 spec_ngram: int = 3,
+                 lora: dict[str, Any] | None = None, **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
@@ -65,6 +66,11 @@ class LLMModel(Model):
         self._kv_quantize = kv_quantize
         self._speculative = speculative
         self._spec_ngram = spec_ngram
+        # config.lora {rank, alpha, targets?}: the checkpoint is a
+        # llama_lora fine-tune ({"base","lora"} tree); restore it and serve
+        # the MERGED model — zero serving-path overhead, the engine never
+        # knows LoRA existed
+        self._lora = dict(lora) if lora else None
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -137,6 +143,32 @@ class LLMModel(Model):
 
         from kubeflow_tpu.models import llama
 
+        if self._lora is not None:
+            # a llama_lora trainer checkpoint: restore {"base","lora"} and
+            # merge the adapters into plain llama params
+            from kubeflow_tpu.models import lora as lora_lib
+            from kubeflow_tpu.serving.model import ModelError
+            from kubeflow_tpu.training.checkpoint import restore_params
+
+            if not self._checkpoint:
+                raise ModelError("config.lora requires a checkpoint")
+            lcfg_kw = dict(self._lora)
+            # the trainer checkpoint already CONTAINS the base weights —
+            # never re-read the original base here (eval_shape must stay IO
+            # free)
+            lcfg_kw.pop("base_checkpoint", None)
+            if "targets" in lcfg_kw:
+                lcfg_kw["targets"] = tuple(lcfg_kw["targets"])
+            lcfg = lora_lib.LoraLlamaConfig(
+                llama=dict(self._cfg_overrides), **lcfg_kw)
+            abstract = jax.eval_shape(
+                lambda: lora_lib.init(jax.random.key(0), lcfg))
+            try:
+                restored = restore_params(self._checkpoint, abstract)
+            except FileNotFoundError as e:
+                raise ModelError(str(e)) from e
+            return lora_lib.merge(restored, lcfg,
+                                  stop_base_gradient=False)
         if self._checkpoint:
             # orbax trainer checkpoint: restore the params subtree against
             # the model's abstract shapes (opt_state is not needed to

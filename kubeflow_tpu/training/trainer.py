@@ -45,6 +45,12 @@ class OptimizerConfig:
     # read+write traffic each step (+1 MFU pt at the bench shape); the
     # second moment stays f32 (its dynamic range matters for the rsqrt)
     mu_dtype: str | None = None
+    # parameter-efficient fine-tuning: only params whose tree path starts
+    # with this "/"-joined prefix train (e.g. "lora" for llama_lora);
+    # everything else is frozen with optax.set_to_zero, so optimizer
+    # moments exist ONLY for the trainable leaves — the memory contract
+    # that lets an 8B LoRA fine-tune fit where full Adam state would not
+    trainable_prefix: str | None = None
 
 
 @dataclasses.dataclass
@@ -67,6 +73,15 @@ class TrainerConfig:
     profile_num_steps: int = 3
 
 
+def _path_keys(path) -> tuple[str, ...]:
+    """Normalize a jax tree path (DictKey/GetAttrKey/SequenceKey entries) to
+    plain strings — trainable_prefix matching and the optimizer-state
+    suffix-sharding fallback MUST normalize identically, so there is
+    exactly one implementation."""
+    return tuple(str(getattr(p, "key", getattr(p, "name",
+                             getattr(p, "idx", p)))) for p in path)
+
+
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     if cfg.schedule == "cosine":
         sched = optax.warmup_cosine_decay_schedule(
@@ -87,6 +102,18 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
         "sgd": lambda: optax.sgd(sched, momentum=0.9,
                                  accumulator_dtype=mu_dtype),
     }[cfg.name]()
+    if cfg.trainable_prefix:
+        prefix = tuple(cfg.trainable_prefix.split("/"))
+
+        def labels(params):
+            def lab(path, _):
+                keys = _path_keys(path)
+                return ("train" if keys[:len(prefix)] == prefix
+                        else "freeze")
+            return jax.tree_util.tree_map_with_path(lab, params)
+
+        opt = optax.multi_transform(
+            {"train": opt, "freeze": optax.set_to_zero()}, labels)
     if cfg.grad_clip:
         opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), opt)
     return opt
@@ -142,16 +169,45 @@ class Trainer:
     def _state_sharding(self, abstract_state):
         """Param shardings for params; optimizer momenta follow their params
         *structurally* (optax.tree_map_params — shape matching would confuse
-        transposed same-shape weights like wq/wo); non-param leaves replicate."""
-        opt_sh = optax.tree_map_params(
-            self.optimizer,
-            lambda _, sh: sh,
-            abstract_state["opt_state"],
-            self.param_sharding,
-            transform_non_params=lambda _: self.repl,
-        )
+        transposed same-shape weights like wq/wo); non-param leaves replicate.
+        Wrapped optimizers optax can't traverse (multi_transform for
+        trainable_prefix freezing) fall back to exact path-SUFFIX matching:
+        a momentum leaf's trailing dict path IS its param's path (mu/nu
+        mirror the params tree), so the match is as exact as the structural
+        one — a same-shape transposed weight still can't confuse it."""
+        try:
+            opt_sh = optax.tree_map_params(
+                self.optimizer,
+                lambda _, sh: sh,
+                abstract_state["opt_state"],
+                self.param_sharding,
+                transform_non_params=lambda _: self.repl,
+            )
+        except (ValueError, TypeError):
+            opt_sh = self._suffix_path_sharding(abstract_state)
         return {"params": self.param_sharding, "opt_state": opt_sh,
                 "step": self.repl}
+
+    def _suffix_path_sharding(self, abstract_state):
+        norm = _path_keys
+        flat_sh = {norm(p): sh for p, sh in
+                   jax.tree_util.tree_flatten_with_path(
+                       self.param_sharding,
+                       is_leaf=lambda x: isinstance(x, NamedSharding))[0]}
+        flat_shape = {norm(p): leaf.shape for p, leaf in
+                      jax.tree_util.tree_flatten_with_path(
+                          abstract_state["params"])[0]}
+
+        def assign(path, leaf):
+            keys = norm(path)
+            for i in range(len(keys)):  # longest suffix first
+                suf = keys[i:]
+                if suf in flat_sh and flat_shape[suf] == leaf.shape:
+                    return flat_sh[suf]
+            return self.repl
+
+        return jax.tree_util.tree_map_with_path(
+            assign, abstract_state["opt_state"])
 
     def abstract_state(self) -> dict[str, Any]:
         """Sharding-annotated ShapeDtypeStructs of the train state — the

@@ -1,0 +1,190 @@
+"""LoRA fine-tuning (model family "llama_lora" + OptimizerConfig
+trainable_prefix). The contracts: merged == base at init, ONLY adapter
+leaves train (base byte-frozen, Adam moments exist only for adapters),
+the merged tree serves through the unmodified llama engine, and the
+whole thing runs through the platform Trainer on a sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models import llama, lora, registry
+from kubeflow_tpu.parallel import MeshConfig
+from kubeflow_tpu.training import (OptimizerConfig, Trainer, TrainerConfig)
+from kubeflow_tpu.training import data as data_lib
+
+TINY = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+            d_ff=128, max_seq_len=128, rope_theta=10000.0)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        lora.LoraLlamaConfig(rank=0)
+    with pytest.raises(ValueError):
+        lora.LoraLlamaConfig(targets=("nonsense",))
+    cfg = lora.LoraLlamaConfig(rank=4, llama=TINY)
+    assert cfg.vocab_size == 256  # base-field delegation
+
+
+def test_merged_equals_base_at_init():
+    cfg = lora.LoraLlamaConfig(rank=4, llama=TINY)
+    params = lora.init(jax.random.key(0), cfg)
+    merged = lora.merge(params, cfg)
+    for t in cfg.targets:
+        np.testing.assert_array_equal(
+            np.asarray(merged["layers"][t]),
+            np.asarray(params["base"]["layers"][t]))
+    toks = jnp.arange(1, 17)[None]
+    base_logits = llama.apply(params["base"], toks, cfg.base_cfg)
+    lora_logits = lora.apply(params, toks, cfg)
+    np.testing.assert_allclose(np.asarray(base_logits),
+                               np.asarray(lora_logits), atol=1e-6)
+
+
+def test_trainer_freezes_base_and_trains_adapters():
+    cfg = TrainerConfig(
+        model="llama_lora",
+        model_overrides=dict(rank=4, alpha=8.0, llama=TINY),
+        batch_size=4,
+        optimizer=OptimizerConfig(learning_rate=1e-2, warmup_steps=2,
+                                  total_steps=50, trainable_prefix="lora"),
+        mesh=MeshConfig(data=1), log_every=1000)
+    trainer = Trainer(cfg)
+    trainer.metrics.echo = False
+    data = data_lib.for_model("llama_lora", trainer.model_cfg, 4, seq_len=64)
+    state = trainer.init_state()
+    base_before = jax.tree.map(np.asarray, state["params"]["base"])
+    b0 = trainer.shard_batch(next(data))
+    step = trainer.compiled_step(state, b0)
+    first = None
+    for i in range(30):
+        state, metrics = step(state, trainer.shard_batch(next(data)))
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first, (first, last)
+    # base byte-frozen
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 base_before, state["params"]["base"])
+    # adapters moved (b was zero-init)
+    for t in ("wq", "wo"):
+        assert float(jnp.abs(state["params"]["lora"][t]["b"]).max()) > 0
+
+
+def test_optimizer_state_only_for_adapters():
+    """The PEFT memory contract: Adam moments exist only under the
+    trainable prefix — frozen leaves carry optax MaskedNode, not mu/nu."""
+    cfg = lora.LoraLlamaConfig(rank=2, llama=TINY)
+    params = lora.init(jax.random.key(0), cfg)
+    from kubeflow_tpu.training.trainer import make_optimizer
+
+    opt = make_optimizer(OptimizerConfig(trainable_prefix="lora",
+                                         grad_clip=0.0,
+                                         schedule="constant",
+                                         learning_rate=1e-2))
+    opt_state = opt.init(params)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    n_adapters = sum(x.size for x in jax.tree.leaves(params["lora"]))
+    n_opt = sum(x.size for x in jax.tree.leaves(opt_state)
+                if hasattr(x, "size"))
+    # mu+nu for adapters plus scalar counts — nothing base-sized
+    assert n_opt < 2 * n_adapters + 64, (n_opt, n_adapters, n_params)
+    # and the frozen grads apply as exact zeros
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, _ = opt.update(grads, opt_state, params)
+    assert float(jnp.abs(updates["base"]["embed"]).max()) == 0.0
+    assert float(jnp.abs(updates["lora"]["wq"]["a"]).max()) > 0.0
+
+
+def test_lora_sharded_mesh(devices8):
+    """fsdp x tensor layout: adapter shardings follow their target's in/out
+    axes; a step runs and matches the single-device loss."""
+    overrides = dict(rank=4, llama=TINY)
+    data = data_lib.for_model(
+        "llama_lora", lora.LoraLlamaConfig(**overrides), 4, seq_len=64)
+    batch = next(data)
+
+    def run(mesh_cfg):
+        t = Trainer(TrainerConfig(
+            model="llama_lora", model_overrides=overrides, batch_size=4,
+            optimizer=OptimizerConfig(learning_rate=1e-2, warmup_steps=2,
+                                      total_steps=50,
+                                      trainable_prefix="lora"),
+            mesh=mesh_cfg, log_every=1000))
+        t.metrics.echo = False
+        state = t.init_state()
+        b = t.shard_batch(batch)
+        step = t.compiled_step(state, b)
+        state, m = step(state, b)
+        return float(m["loss"])
+
+    single = run(MeshConfig(data=1))
+    sharded = run(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert abs(single - sharded) < 5e-2, (single, sharded)
+
+
+def test_merged_serves_through_engine():
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    cfg = lora.LoraLlamaConfig(rank=4, llama=TINY)
+    params = lora.init(jax.random.key(0), cfg)
+    # nudge an adapter so the merged model differs from the base
+    params["lora"]["wq"]["b"] = jnp.ones_like(params["lora"]["wq"]["b"]) * 0.1
+    merged = lora.merge(params, cfg, stop_base_gradient=False)
+    eng = LLMEngine(merged, cfg.base_cfg, n_slots=2, max_len=64,
+                    buckets=(16,))
+    out = eng.generate([1, 2, 3, 4], 8)
+    assert len(out) == 8
+    # adapter_only is the small artifact
+    small = lora.adapter_only(params)
+    n_small = sum(x.size for x in jax.tree.leaves(small))
+    n_full = sum(x.size for x in jax.tree.leaves(params))
+    assert n_small < n_full * 0.2
+
+
+def test_registered_in_registry():
+    assert "llama_lora" in registry.names()
+
+
+def test_serve_lora_checkpoint_through_runtime(tmp_path):
+    """The train->serve loop: a llama_lora trainer checkpoint served by an
+    InferenceService with `config: {lora: {rank: ...}}` — the runtime
+    restores {base, lora} and serves the MERGED model."""
+    from kubeflow_tpu.serving.llm import LLMEngine
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+    cfg = lora.LoraLlamaConfig(rank=4, alpha=8.0, llama=TINY)
+    params = lora.init(jax.random.key(0), cfg)
+    params["lora"]["wq"]["b"] = jnp.ones_like(params["lora"]["wq"]["b"]) * 0.1
+    ckpt = str(tmp_path / "lora-ckpt")
+    mgr = CheckpointManager(ckpt)
+    mgr.save(7, {"params": params, "step": jnp.asarray(7, jnp.int32)},
+             force=True)
+    mgr.close()
+
+    m = LLMModel("ft", model=dict(TINY), n_slots=2, max_len=64,
+                 buckets=(16,), checkpoint=ckpt,
+                 lora=dict(rank=4, alpha=8.0))
+    m.load()
+    try:
+        out = m.predict({"prompt_tokens": [1, 2, 3, 4],
+                         "max_new_tokens": 8})["output_tokens"]
+    finally:
+        m.unload()
+    # must equal serving the merged params directly
+    merged = lora.merge(params, cfg, stop_base_gradient=False)
+    eng = LLMEngine(merged, cfg.base_cfg, n_slots=2, max_len=64,
+                    buckets=(16,))
+    assert out == eng.generate([1, 2, 3, 4], 8)
+
+
+def test_serve_lora_requires_checkpoint():
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelError
+
+    m = LLMModel("ft", model=dict(TINY), lora=dict(rank=4))
+    with pytest.raises(ModelError):
+        m.load()
