@@ -226,11 +226,8 @@ def _attention(cfg: LlamaConfig, x, layer, positions, segment_ids):
 
 
 def _mlp(cfg: LlamaConfig, x, layer):
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = quant.matmul(h, layer["w_gate"], cfg.dtype)
-    up = quant.matmul(h, layer["w_up"], cfg.dtype)
-    return x + quant.matmul(jax.nn.silu(gate) * up, layer["w_down"],
-                            cfg.dtype)
+    # delegates to the serving MLP with no adapters — one SwiGLU body
+    return _serving_mlp(cfg, x, layer)
 
 
 def _layer_body(cfg: LlamaConfig, carry, layer, positions, segment_ids):
@@ -341,37 +338,80 @@ def dequantize_kv(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
     return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
 
 
-def _project_qkv(cfg: LlamaConfig, layer, x, positions):
+def _adapted(h, layer, t: str, lora_layer, ids, dtype):
+    """One serving matmul with an optional per-row LoRA path.
+
+    h: [B, S, d_in]; lora_layer[t] = {"a": [A, d_in, r], "b": [A, r, d_out]}
+    (adapter-stacked, THIS layer's slice; b is pre-scaled by alpha/rank);
+    ids: [B] adapter index per row (0 = the zero adapter = base only).
+    Multi-adapter batched serving: x@W once for the batch, plus the
+    low-rank bypass gathered per row — S-LoRA's trick, XLA-shaped (the
+    gather is tiny next to the W read decode is bound on)."""
+    y = quant.matmul(h, layer[t], dtype)
+    if lora_layer is None or t not in lora_layer:
+        return y
+    a = lora_layer[t]["a"][ids].astype(jnp.float32)  # [B, d_in, r]
+    b = lora_layer[t]["b"][ids].astype(jnp.float32)  # [B, r, d_out]
+    z = jnp.einsum("bsd,bdr->bsr", h.astype(jnp.float32), a)
+    return y + jnp.einsum("bsr,bro->bso", z, b).astype(y.dtype)
+
+
+def _project_qkv(cfg: LlamaConfig, layer, x, positions, lora_layer=None,
+                 ids=None):
     b, s, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = quant.matmul(h, layer["wq"], cfg.dtype).reshape(b, s, nh, hd)
-    k = quant.matmul(h, layer["wk"], cfg.dtype).reshape(b, s, nkv, hd)
-    v = quant.matmul(h, layer["wv"], cfg.dtype).reshape(b, s, nkv, hd)
+    q = _adapted(h, layer, "wq", lora_layer, ids, cfg.dtype).reshape(
+        b, s, nh, hd)
+    k = _adapted(h, layer, "wk", lora_layer, ids, cfg.dtype).reshape(
+        b, s, nkv, hd)
+    v = _adapted(h, layer, "wv", lora_layer, ids, cfg.dtype).reshape(
+        b, s, nkv, hd)
     return (apply_rope(q, positions, theta=cfg.rope_theta),
             apply_rope(k, positions, theta=cfg.rope_theta), v)
 
 
-def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig):
+def _serving_mlp(cfg: LlamaConfig, x, layer, lora_layer=None, ids=None):
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = _adapted(h, layer, "w_gate", lora_layer, ids, cfg.dtype)
+    up = _adapted(h, layer, "w_up", lora_layer, ids, cfg.dtype)
+    return x + _adapted(jax.nn.silu(gate) * up, layer, "w_down",
+                        lora_layer, ids, cfg.dtype)
+
+
+def _wo(cfg: LlamaConfig, out, layer, lora_layer=None, ids=None):
+    return _adapted(out, layer, "wo", lora_layer, ids, cfg.dtype)
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            lora: Params | None = None, ids: jax.Array | None = None):
     """Forward a (right-padded) prompt, returning logits and per-layer KV.
 
     tokens: [B, S] → (logits [B, S, vocab] fp32, k, v [L, B, S, kv, hd]).
     Pad positions produce garbage KV past the true length — callers track
     lengths and decode masks them out.
+
+    `lora`/`ids`: optional multi-adapter batch (serving/llm.py
+    `adapters=`): lora = {target: {"a": [L, A, d_in, r], "b": [L, A, r,
+    d_out]}} (adapter-stacked per layer, b pre-scaled by alpha/rank),
+    ids = [B] adapter index per row, 0 = base-only.
     """
     b, s = tokens.shape
     positions = jnp.arange(s)
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    def body(carry, layer):
+    def body(carry, inp):
         x = carry
-        q, k, v = _project_qkv(cfg, layer, x, positions)
+        layer, ll = inp if lora is not None else (inp, None)
+        q, k, v = _project_qkv(cfg, layer, x, positions, ll, ids)
         out = mha(q, k, v, causal=True)
-        x = x + quant.matmul(out.reshape(b, s, -1), layer["wo"], cfg.dtype)
-        x = _mlp(cfg, x, layer)
+        x = x + _wo(cfg, out.reshape(b, s, -1), layer, ll, ids)
+        x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    xs = ((params["layers"], lora) if lora is not None
+          else params["layers"])
+    x, (ks, vs) = jax.lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits, ks, vs
@@ -379,7 +419,8 @@ def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig):
 
 def prefill_continue(params: Params, tail_tokens: jax.Array,
                      k_prefix: jax.Array, v_prefix: jax.Array,
-                     cfg: LlamaConfig):
+                     cfg: LlamaConfig, lora: Params | None = None,
+                     ids: jax.Array | None = None):
     """Continuation prefill: forward only the TAIL of a prompt whose prefix
     KV is already computed (prefix caching — serving/llm.py).
 
@@ -396,17 +437,21 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
 
     def body(carry, inp):
         x = carry
-        layer, kp, vp = inp  # kp/vp: [B, P, kv, hd]
-        q, k_new, v_new = _project_qkv(cfg, layer, x, positions)
+        if lora is not None:
+            layer, kp, vp, ll = inp
+        else:
+            (layer, kp, vp), ll = inp, None  # kp/vp: [B, P, kv, hd]
+        q, k_new, v_new = _project_qkv(cfg, layer, x, positions, ll, ids)
         k_full = jnp.concatenate([kp.astype(cfg.dtype), k_new], axis=1)
         v_full = jnp.concatenate([vp.astype(cfg.dtype), v_new], axis=1)
         out = mha(q, k_full, v_full, causal=True, q_offset=p)
-        x = x + quant.matmul(out.reshape(b, t, -1), layer["wo"], cfg.dtype)
-        x = _mlp(cfg, x, layer)
+        x = x + _wo(cfg, out.reshape(b, t, -1), layer, ll, ids)
+        x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, (k_new, v_new)
 
-    x, (ks, vs) = jax.lax.scan(body, x,
-                               (params["layers"], k_prefix, v_prefix))
+    xs = ((params["layers"], k_prefix, v_prefix, lora)
+          if lora is not None else (params["layers"], k_prefix, v_prefix))
+    x, (ks, vs) = jax.lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits, ks, vs
@@ -414,7 +459,8 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
 
 def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
                 lengths: jax.Array, cfg: LlamaConfig,
-                span: int | None = None):
+                span: int | None = None, lora: Params | None = None,
+                ids: jax.Array | None = None):
     """One continuous-batching decode step over all cache slots.
 
     last_tokens: [B] token per slot; lengths: [B] current KV lengths
@@ -433,13 +479,15 @@ def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
     quantization change can never diverge the plain and speculative paths.
     """
     logits, new_cache = verify_step(params, last_tokens[:, None], cache,
-                                    lengths, cfg, span=span)
+                                    lengths, cfg, span=span, lora=lora,
+                                    ids=ids)
     return logits[:, 0], new_cache
 
 
 def verify_step(params: Params, tokens: jax.Array, cache: Params,
                 lengths: jax.Array, cfg: LlamaConfig,
-                span: int | None = None):
+                span: int | None = None, lora: Params | None = None,
+                ids: jax.Array | None = None):
     """Speculative-verify step: forward S_v tokens per slot in ONE pass.
 
     tokens: [B, S_v] — row b holds the slot's pending last token followed by
@@ -475,11 +523,14 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
 
     def body(carry, inp):
         x = carry
+        ll = None
+        if lora is not None:
+            *inp, ll = inp
         if quantized:
             layer, ck, cv, cks, cvs = inp
         else:
             layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
-        q, k_new, v_new = _project_qkv(cfg, layer, x, positions)
+        q, k_new, v_new = _project_qkv(cfg, layer, x, positions, ll, ids)
         if quantized:
             kq, ksc = quantize_kv(k_new)
             vq, vsc = quantize_kv(v_new)
@@ -507,18 +558,20 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
-        x = x + quant.matmul(out.reshape(b, s_v, -1), layer["wo"], cfg.dtype)
-        x = _mlp(cfg, x, layer)
+        x = x + _wo(cfg, out.reshape(b, s_v, -1), layer, ll, ids)
+        x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, ((ck, cv, cks, cvs) if quantized else (ck, cv))
 
+    xs = ((params["layers"], cache["k"], cache["v"], cache["k_s"],
+           cache["v_s"]) if quantized
+          else (params["layers"], cache["k"], cache["v"]))
+    if lora is not None:
+        xs = xs + (lora,)
     if quantized:
-        x, (ks, vs, kss, vss) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["k_s"], cache["v_s"]))
+        x, (ks, vs, kss, vss) = jax.lax.scan(body, x, xs)
         new_cache = {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
     else:
-        x, (ks, vs) = jax.lax.scan(body, x, (params["layers"],
-                                             cache["k"], cache["v"]))
+        x, (ks, vs) = jax.lax.scan(body, x, xs)
         new_cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
@@ -676,7 +729,12 @@ def load_hf(path: str, cfg: LlamaConfig | None = None, *,
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Training FLOPs/token (fwd+bwd ~ 6*N params + attention quadratic term)
-    for MFU accounting. Matches the standard 6N + 12*L*H*S approximation."""
+    for MFU accounting. Matches the standard 6N + 12*L*H*S approximation
+    (PaLM-appendix convention: the causal attention term is NOT halved,
+    even though the Pallas kernel skips fully-masked KV blocks — at the
+    bench shape attention is ~11% of the total, so the convention flatters
+    causal MFU by a few percent of that share; kept because every public
+    MFU number this is compared against uses the same convention)."""
     d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
     nh, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
     matmul_params = L * (d * nh * hd + 2 * d * nkv * hd + nh * hd * d + 3 * d * f)

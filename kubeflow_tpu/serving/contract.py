@@ -47,6 +47,7 @@ class _AbstractEngine:
 
     _prefill = LLMEngine._prefill
     _prefill_cont = LLMEngine._prefill_cont
+    _unpack_wave = LLMEngine._unpack_wave
     _extract_prefix = LLMEngine._extract_prefix
     _decode = LLMEngine._decode
     _cache_write = LLMEngine._cache_write
@@ -56,10 +57,12 @@ class _AbstractEngine:
     def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None):
         self.cfg = cfg
         self.kv_quantize = kv_quantize
-        # the proof covers the non-speculative menu (spec mode swaps the
-        # decode program for _spec_decode; its HBM profile is the same
-        # cache + weights with an S_v-wide query — covered by the margin)
+        # the proof covers the non-speculative, single-adapter menu (spec
+        # mode swaps the decode program for _spec_decode and adapters add
+        # a rank-r bypass — both ride within the margin)
         self.spec = None
+        self.adapters = None
+        self._row_extra = 3
 
 
 def _abstract_tree(tree, shardings):

@@ -79,7 +79,8 @@ class LLMEngine:
                  warm_cont_pairs: int | None = 4,
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3,
+                 adapters: dict[str, dict[str, Any]] | None = None):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
@@ -109,6 +110,24 @@ class LLMEngine:
         self._spec_fns: dict[tuple[int, int], Any] = {}
         self._spec_tokens = 0
         self._spec_verifies = 0
+        # -- multi-adapter LoRA serving (S-LoRA-style, XLA-shaped): many
+        # fine-tunes of ONE base share the continuous batch. adapters =
+        # {name: {"lora": {target: {"a": [L,d,r], "b": [L,r,out]}},
+        #         "alpha": float}} — stacked on device as [L, A+1, ...]
+        # with index 0 the all-zero adapter (base-only rows), b pre-scaled
+        # by alpha/rank so no per-adapter scalar rides the programs. Every
+        # program gathers each row's (a, b) by the slot's adapter id; the
+        # low-rank bypass is tiny next to the W reads decode is bound on.
+        self.adapters = None
+        self._adapter_idx: dict[str, int] = {}
+        self._req_aids: dict[int, int] = {}
+        self._raw_adapters = dict(adapters) if adapters else None
+        if adapters:
+            self._adapter_idx = {n: i + 1
+                                 for i, n in enumerate(sorted(adapters))}
+        # packed wave rows end with [slot, prompt_len, temp_milli] and,
+        # under multi-adapter serving, an adapter-id column
+        self._row_extra = 4 if adapters else 3
         # int8 KV cache: decode re-reads the whole (span of the) cache
         # every step, so int8 storage halves that HBM traffic vs bf16 and
         # halves cache residency (2x slots or context at 8B scale);
@@ -131,6 +150,10 @@ class LLMEngine:
         self.mesh = None
         if mesh is not None:
             self._shard_over(mesh)
+        if self._raw_adapters:
+            # after mesh setup so the stack lands replicated on the mesh
+            self.adapters = self._stack_adapters(self._raw_adapters)
+            del self._raw_adapters
         self.cache = self._alloc_cache()
         self.lengths = self._put(np.zeros((n_slots,), np.int32))
         self.last_tokens = self._put(np.zeros((n_slots,), np.int32))
@@ -224,6 +247,8 @@ class LLMEngine:
             if self.spec:
                 cache["hist"] = jnp.zeros((self.n_slots, self.max_len),
                                           jnp.int32)
+            if self.adapters is not None:
+                cache["aids"] = jnp.zeros((self.n_slots,), jnp.int32)
             return cache
         # schema derives from init_cache — ONE source of truth for the
         # cache layout (shared with serving/contract.py)
@@ -248,6 +273,9 @@ class LLMEngine:
             # the token-history buffer is tiny: replicate it
             cache["hist"] = jax.device_put(
                 np.zeros((self.n_slots, self.max_len), np.int32), self._repl)
+        if self.adapters is not None:
+            cache["aids"] = jax.device_put(
+                np.zeros((self.n_slots,), np.int32), self._repl)
         return cache
 
     def _put(self, x):
@@ -256,6 +284,41 @@ class LLMEngine:
         if self.mesh is None:
             return jnp.asarray(x)
         return jax.device_put(jnp.asarray(x), self._repl)
+
+    def _stack_adapters(self, adapters: dict[str, dict]):
+        """{name: {"lora": {t: {"a","b"}}, "alpha": f}} → device stacks
+        {t: {"a": [L, A+1, d_in, r], "b": [L, A+1, r, d_out]}}. Index 0 is
+        the all-zero adapter (base-only rows); b carries alpha/rank so the
+        programs need no per-adapter scalar. All adapters must agree on
+        rank and targets (they share one compiled gather shape)."""
+        names = sorted(adapters)
+        first = adapters[names[0]]["lora"]
+        targets = sorted(first)
+        rank = first[targets[0]]["a"].shape[-1]
+        stack = {}
+        for t in targets:
+            a_rows, b_rows = [], []
+            for n in names:
+                tree = adapters[n]["lora"]
+                if sorted(tree) != targets:
+                    raise ValueError(
+                        f"adapter {n!r} targets {sorted(tree)} != {targets}")
+                a, b = tree[t]["a"], tree[t]["b"]
+                if a.shape[-1] != rank:
+                    raise ValueError(
+                        f"adapter {n!r} rank {a.shape[-1]} != {rank}; "
+                        "all adapters in one engine share a rank")
+                scale = float(adapters[n].get("alpha", rank)) / rank
+                a_rows.append(np.asarray(a, np.float32))
+                b_rows.append(np.asarray(b, np.float32) * scale)
+            a0 = np.zeros_like(a_rows[0])
+            b0 = np.zeros_like(b_rows[0])
+            # [L, A+1, ...]: layer-leading for the lax.scan over layers
+            stack[t] = {
+                "a": self._put(np.stack([a0] + a_rows, axis=1)),
+                "b": self._put(np.stack([b0] + b_rows, axis=1)),
+            }
+        return stack
 
     # -- compiled programs ---------------------------------------------------
     # params are an explicit argument, never a closure: a closed-over pytree
@@ -276,20 +339,30 @@ class LLMEngine:
                                          axis=-1).astype(jnp.int32)
         return jnp.where(temps > 0, sampled, greedy)
 
+    def _unpack_wave(self, wave):
+        """Row layout: tokens ++ [slot, prompt_len, temp_milli(, aid)].
+        Returns (tokens, slots, prompt_lens, row_temps, aids|None)."""
+        ex = self._row_extra
+        tokens = wave[:, :-ex]
+        slots, prompt_lens = wave[:, -ex], wave[:, -ex + 1]
+        row_temps = wave[:, -ex + 2].astype(jnp.float32) / 1000.0
+        aids = wave[:, -1] if self.adapters is not None else None
+        return tokens, slots, prompt_lens, row_temps, aids
+
     def _prefill(self, params, cache, lengths, last_tokens, temps, key,
-                 wave):
+                 wave, lora=None):
         """Batched prefill wave. `wave` is ONE packed int32 array
         [W, bucket+3] — row i = prompt tokens (right-padded) ++ [slot,
-        prompt_len, temperature*1000] — because on a tunneled device every
-        host->device transfer costs a full RTT: one packed transfer + one
-        dispatch covers a whole burst of arrivals. Padded wave rows
-        duplicate a real row (same slot, same data) and sampling keys
-        derive from the slot id, so duplicate writes are idempotent even
-        for sampled requests."""
-        tokens, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
-                                      wave[:, -2])
-        row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
-        logits, ks, vs = llama.prefill(params, tokens, self.cfg)
+        prompt_len, temperature*1000] (++ adapter id under multi-adapter
+        serving) — because on a tunneled device every host->device
+        transfer costs a full RTT: one packed transfer + one dispatch
+        covers a whole burst of arrivals. Padded wave rows duplicate a
+        real row (same slot, same data) and sampling keys derive from the
+        slot id, so duplicate writes are idempotent even for sampled
+        requests."""
+        tokens, slots, prompt_lens, row_temps, aids = self._unpack_wave(wave)
+        logits, ks, vs = llama.prefill(params, tokens, self.cfg,
+                                       lora=lora, ids=aids)
         bucket = tokens.shape[1]
         cache = dict(cache)
         lasts = []
@@ -298,6 +371,8 @@ class LLMEngine:
                                       ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
             temps = temps.at[slots[i]].set(row_temps[i])
+            if aids is not None:
+                cache["aids"] = cache["aids"].at[slots[i]].set(aids[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
                 logits[i], prompt_lens[i] - 1, keepdims=False))
         key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots, key)
@@ -349,26 +424,26 @@ class LLMEngine:
         return key, jnp.where(row_temps > 0, sampled, greedy)
 
     def _prefill_cont(self, params, cache, lengths, last_tokens, temps, key,
-                      wave, k_prefix, v_prefix):
+                      wave, k_prefix, v_prefix, lora=None):
         """Batched continuation prefill against cached prefixes. `wave` is
         [W, T+3] — tail tokens (prompt[P:], right-padded to the tail
-        bucket) ++ [slot, full_prompt_len, temp_milli] per row; k/v_prefix:
-        [L, W, P, kv, hd] (row i's prefix — different requests may hit
-        DIFFERENT store entries of the same P). With speculative decoding
-        on, rows are [tail(T) ++ prefix(P) ++ slot, len, temp] — the prefix
-        KV alone can't populate the token-history buffer the n-gram drafter
-        reads, so the prefix TOKENS ride the same packed transfer. Writes
-        prefix+tail KV into each slot and samples next tokens from the
-        tails' last rows; padded duplicate rows repeat their source row
-        (idempotent writes), exactly like _prefill."""
-        tokens_all, slots, prompt_lens = (wave[:, :-3], wave[:, -3],
-                                          wave[:, -2])
-        row_temps = wave[:, -1].astype(jnp.float32) / 1000.0
+        bucket) ++ [slot, full_prompt_len, temp_milli(, aid)] per row;
+        k/v_prefix: [L, W, P, kv, hd] (row i's prefix — different requests
+        may hit DIFFERENT store entries of the same P). With speculative
+        decoding on, rows are [tail(T) ++ prefix(P) ++ slot, len, temp] —
+        the prefix KV alone can't populate the token-history buffer the
+        n-gram drafter reads, so the prefix TOKENS ride the same packed
+        transfer. Writes prefix+tail KV into each slot and samples next
+        tokens from the tails' last rows; padded duplicate rows repeat
+        their source row (idempotent writes), exactly like _prefill."""
+        tokens_all, slots, prompt_lens, row_temps, aids = \
+            self._unpack_wave(wave)
         p = k_prefix.shape[2]
         t_bucket = tokens_all.shape[1] - (p if self.spec else 0)
         tokens = tokens_all[:, :t_bucket]
         logits, ks, vs = llama.prefill_continue(params, tokens, k_prefix,
-                                                v_prefix, self.cfg)
+                                                v_prefix, self.cfg,
+                                                lora=lora, ids=aids)
         cache = dict(cache)
         lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
@@ -378,6 +453,8 @@ class LLMEngine:
                                       ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
             temps = temps.at[slots[i]].set(row_temps[i])
+            if aids is not None:
+                cache["aids"] = cache["aids"].at[slots[i]].set(aids[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
                 logits[i], prompt_lens[i] - p - 1, keepdims=False))
         key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots,
@@ -412,7 +489,7 @@ class LLMEngine:
         return k, v
 
     def _decode(self, params, cache, lengths, last_tokens, temps, key,
-                active, *, steps: int, span: int | None = None):
+                active, lora=None, *, steps: int, span: int | None = None):
         """`steps` chained decode iterations inside ONE program (lax.scan):
         a K-token chunk costs one dispatch round-trip instead of K. Slots
         that finish (EOS) mid-chunk keep decoding on device; the host drops
@@ -421,8 +498,13 @@ class LLMEngine:
         decode — see llama.decode_step)."""
         def body(carry, _):
             cache, lengths, last_tokens, key = carry
-            logits, cache = llama.decode_step(params, last_tokens, cache,
-                                              lengths, self.cfg, span=span)
+            aids = cache.get("aids")
+            logits, kv = llama.decode_step(params, last_tokens, cache,
+                                           lengths, self.cfg, span=span,
+                                           lora=lora, ids=aids)
+            if aids is not None:
+                kv["aids"] = aids  # decode never re-assigns slots
+            cache = kv
             key, sub = jax.random.split(key)
             toks = self._pick(logits, temps, sub)
             lengths = lengths + active.astype(jnp.int32)
@@ -435,7 +517,7 @@ class LLMEngine:
         return cache, lengths, last_tokens, temps, key, toks
 
     def _spec_decode(self, params, cache, lengths, last_tokens, temps, key,
-                     active, *, steps: int, span: int):
+                     active, lora=None, *, steps: int, span: int):
         """`steps` speculative verify rounds inside ONE program: each round
         records the pending token into the history buffer, drafts up to
         `self.spec` tokens by n-gram lookup (_ngram_draft), verifies all
@@ -463,9 +545,11 @@ class LLMEngine:
             count = jnp.where(active & (temps <= 0), count, 0)
             tokens_in = jnp.concatenate([last_tokens[:, None], drafts],
                                         axis=1)
+            aids = cache.get("aids")
             kv = {k: v for k, v in cache.items() if k != "hist"}
             logits, kv = llama.verify_step(params, tokens_in, kv, lengths,
-                                           self.cfg, span=span)
+                                           self.cfg, span=span, lora=lora,
+                                           ids=aids)
             preds = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, k+1]
             match = ((preds[:, :k_spec] == drafts)
                      & (jnp.arange(k_spec)[None] < count[:, None]))
@@ -494,6 +578,8 @@ class LLMEngine:
                            jnp.where(wmask, wpos, max_len)].set(
                 drafts, mode="drop")
             kv["hist"] = hist
+            if aids is not None:
+                kv["aids"] = aids
             new_len = lengths + emit_count
             new_last = jnp.where(active, bonus, last_tokens)
             packed = jnp.concatenate([emit_count[:, None], emit], axis=1)
@@ -554,7 +640,7 @@ class LLMEngine:
         p = self._prefix_len_for(len(prompt))
         if p is None:
             return None
-        key = tuple(prompt[:p])
+        key = self._prefix_key(action.req_id, prompt[:p])
         entry = self._prefix_store.get(key)
         if entry is None:
             return None
@@ -620,13 +706,21 @@ class LLMEngine:
         return plan
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0,
+               adapter: str | None = None) -> int:
         import math
 
         # a NaN/inf/huge value would blow up later INSIDE the engine loop
         # thread (wave packing), killing serving for every request
         if not (math.isfinite(temperature) and 0 <= temperature <= 100):
             raise ValueError("temperature must be finite and in [0, 100]")
+        aid = 0
+        if adapter is not None:
+            if adapter not in self._adapter_idx:
+                raise ValueError(
+                    f"unknown adapter {adapter!r}; "
+                    f"loaded: {sorted(self._adapter_idx)}")
+            aid = self._adapter_idx[adapter]
         sched_len = len(prompt)
         if sched_len > self.buckets[-1]:
             # chunked prefill: validate the chain now (fail at submit, not
@@ -653,6 +747,8 @@ class LLMEngine:
             self._results[req_id] = []
             self._max_new[req_id] = max_new_tokens
             self._req_temps[req_id] = float(temperature)
+            if aid:
+                self._req_aids[req_id] = aid
             self._submit_t[req_id] = time.monotonic()
         return req_id
 
@@ -750,29 +846,32 @@ class LLMEngine:
         # prefix-cache composition: a banked largest-bucket prefix (the
         # shared-system-prompt case) replaces the first full prefill — the
         # chain starts at the first continuation instead
+        aid = self._req_aids.get(action.req_id, 0)
+        big_key = self._prefix_key(action.req_id, prompt[:big])
         hit = None
         if self.prefix_cache_enabled:
-            hit = self._prefix_store.get(tuple(prompt[:big]))
+            hit = self._prefix_store.get(big_key)
             if hit is not None:
-                self._prefix_store.move_to_end(tuple(prompt[:big]))
+                self._prefix_store.move_to_end(big_key)
                 self._prefix_hits += 1
         if hit is None:
             packed = self._pack_rows(1, big,
-                                     [(prompt[:big], slot, big, temp)])
+                                     [(prompt[:big], slot, big, temp,
+                                       aid)])
             (self.cache, self.lengths, self.last_tokens, self.temps,
              self.rng_key, toks) = self._prefill_fn(big, 1)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                self.temps, self.rng_key, self._put(packed))
+                self.temps, self.rng_key, self._put(packed),
+                *self._extra())
         done = big
         pending = None if hit is None else (hit["k"], hit["v"])
         for chunk_len, t in plan[1:]:
-            chunk = prompt[done:done + chunk_len]
             ek, ev = (pending if pending is not None
                       else self._extract_fn(done)(self.cache, slot))
             if (done == big and hit is None and self.prefix_cache_enabled):
                 # bank the largest-bucket prefix from the boundary-1
                 # extract we just ran — no second extract dispatch
-                self._store_prefix_entry(tuple(prompt[:big]), ek, ev)
+                self._store_prefix_entry(big_key, ek, ev)
             pending = None
             # the chain boundary is a continuation with the request's OWN
             # prefix (p == done), so the row layout comes from the same
@@ -781,11 +880,12 @@ class LLMEngine:
                 list(prompt[:done + chunk_len]), done, t)
             packed = self._pack_rows(1, t + (done if self.spec else 0),
                                      [(row_toks, slot,
-                                       done + chunk_len, temp)])
+                                       done + chunk_len, temp, aid)])
             (self.cache, self.lengths, self.last_tokens, self.temps,
              self.rng_key, toks) = self._cont_fn(done, t, 1)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                self.temps, self.rng_key, self._put(packed), ek, ev)
+                self.temps, self.rng_key, self._put(packed), ek, ev,
+                *self._extra())
             done += chunk_len
         return toks
 
@@ -803,20 +903,21 @@ class LLMEngine:
         NOT pre-warmed: the chunked-prefill chain programs (extract +
         continuation per chunk boundary) — the first prompt longer than
         the largest bucket pays their compile, later ones are warm."""
+        ex = self._row_extra
         for bucket in self.buckets:
             width = 1
             while True:   # every power of two through next-pow2(n_slots):
                 # a wave of n_slots actions pads UP to that width, so for
                 # e.g. n_slots=6 width 8 must be warm too
-                packed = np.zeros((width, bucket + 3), np.int32)
+                packed = np.zeros((width, bucket + ex), np.int32)
                 packed[:, :2] = 1   # token + prompt_len floor
-                packed[:, -3] = np.arange(width) % self.n_slots
-                packed[:, -2] = 1
+                packed[:, -ex] = np.arange(width) % self.n_slots
+                packed[:, -ex + 1] = 1
                 (self.cache, self.lengths, self.last_tokens, self.temps,
                  self.rng_key, _) = self._prefill_fn(bucket, width)(
                     self.params, self.cache, self.lengths,
                     self.last_tokens, self.temps, self.rng_key,
-                    self._put(packed))
+                    self._put(packed), *self._extra())
                 if width >= self.n_slots:
                     break
                 width *= 2
@@ -841,11 +942,11 @@ class LLMEngine:
                 ek, ev = extracts[p]
                 width = 1
                 while True:
-                    cols = t + (p if self.spec else 0) + 3
+                    cols = t + (p if self.spec else 0) + ex
                     packed = np.zeros((width, cols), np.int32)
                     packed[:, 0] = 1
-                    packed[:, -3] = np.arange(width) % self.n_slots
-                    packed[:, -2] = p + 1   # last-row index stays valid
+                    packed[:, -ex] = np.arange(width) % self.n_slots
+                    packed[:, -ex + 1] = p + 1  # last-row index stays valid
                     kw = jnp.concatenate([ek] * width, axis=1)
                     vw = jnp.concatenate([ev] * width, axis=1)
                     (self.cache, self.lengths, self.last_tokens,
@@ -853,7 +954,7 @@ class LLMEngine:
                         self._cont_fn(p, t, width)(
                             self.params, self.cache, self.lengths,
                             self.last_tokens, self.temps, self.rng_key,
-                            self._put(packed), kw, vw)
+                            self._put(packed), kw, vw, *self._extra())
                     if width >= self.n_slots:
                         break
                     width *= 2
@@ -878,7 +979,8 @@ class LLMEngine:
              self.rng_key, toks) = fn(c, span)(
                 self.params, self.cache, self.lengths, self.last_tokens,
                 self.temps, self.rng_key,
-                self._put(np.zeros((self.n_slots,), bool)))
+                self._put(np.zeros((self.n_slots,), bool)),
+                *self._extra())
         float(np.asarray(toks).flat[0])  # sync: compile + execute finished
         # (axon-safe: a value fetch, not block_until_ready)
         # reset via _put, not zeros_like: under a mesh the reset arrays must
@@ -918,8 +1020,10 @@ class LLMEngine:
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
-                 temperature: float = 0.0) -> list[int]:
-        rid = self.submit(prompt, max_new_tokens, temperature)
+                 temperature: float = 0.0,
+                 adapter: str | None = None) -> list[int]:
+        rid = self.submit(prompt, max_new_tokens, temperature,
+                          adapter=adapter)
         while not self.is_done(rid):
             if not self.step():
                 raise RuntimeError("engine idle with request outstanding")
@@ -940,6 +1044,8 @@ class LLMEngine:
             out["prefix_hits"] = self._prefix_hits
             out["prefix_misses"] = self._prefix_misses
             out["prefix_entries"] = len(self._prefix_store)
+        if self.adapters is not None:
+            out["adapters_loaded"] = sorted(self._adapter_idx)
         if self.spec:
             out["spec_verify_rounds"] = self._spec_verifies
             out["spec_tokens_emitted"] = self._spec_tokens
@@ -954,6 +1060,19 @@ class LLMEngine:
 
     # -- internals -----------------------------------------------------------
 
+    def _extra(self) -> tuple:
+        """Trailing program args: the adapter stack rides as an explicit
+        argument (a closure would inline it into the HLO as constants)."""
+        return () if self.adapters is None else (self.adapters,)
+
+    def _prefix_key(self, req_id: int, toks) -> tuple:
+        """Prefix-store key. Under multi-adapter serving the adapter id is
+        part of the key: a prefix prefilled through adapter X is WRONG KV
+        for adapter Y even at identical tokens."""
+        if self.adapters is None:
+            return tuple(toks)
+        return (self._req_aids.get(req_id, 0),) + tuple(toks)
+
     @staticmethod
     def _pack_temp(temp: float) -> int:
         """Nearest-milli quantization; sub-milli temps still sample (floor
@@ -962,16 +1081,21 @@ class LLMEngine:
         return max(1, round(temp * 1000)) if temp > 0 else 0
 
     def _pack_rows(self, width: int, bucket: int, rows) -> np.ndarray:
-        """[tokens ++ slot ++ prompt_len ++ temp_milli] per row, padded up
-        to `width` by repeating the last row (idempotent duplicate writes).
-        rows: list of (tokens, slot, prompt_len, temp)."""
+        """[tokens ++ slot ++ prompt_len ++ temp_milli(, aid)] per row,
+        padded up to `width` by repeating the last row (idempotent
+        duplicate writes). rows: list of (tokens, slot, prompt_len, temp
+        [, adapter_idx])."""
+        ex = self._row_extra
         padded = list(rows) + [rows[-1]] * (width - len(rows))
-        packed = np.zeros((width, bucket + 3), np.int32)
-        for i, (toks, slot, plen, temp) in enumerate(padded):
+        packed = np.zeros((width, bucket + ex), np.int32)
+        for i, row in enumerate(padded):
+            toks, slot, plen, temp = row[:4]
             packed[i, :len(toks)] = toks
-            packed[i, -3] = slot
-            packed[i, -2] = plen
-            packed[i, -1] = self._pack_temp(temp)
+            packed[i, -ex] = slot
+            packed[i, -ex + 1] = plen
+            packed[i, -ex + 2] = self._pack_temp(temp)
+            if ex == 4:
+                packed[i, -1] = row[4] if len(row) > 4 else 0
         return packed
 
     def _cont_row_tokens(self, prompt: list[int], p: int, t: int):
@@ -995,7 +1119,8 @@ class LLMEngine:
         padded = list(pairs) + [pairs[-1]] * (width - len(pairs))
         rows = [(self._cont_row_tokens(self._prompts[a.req_id], p, t),
                  a.slot, a.prompt_len,
-                 self._req_temps.get(a.req_id, 0.0)) for a, _ in padded]
+                 self._req_temps.get(a.req_id, 0.0),
+                 self._req_aids.get(a.req_id, 0)) for a, _ in padded]
         packed = self._pack_rows(width, t + (p if self.spec else 0), rows)
         k_prefix = jnp.concatenate([e["k"] for _, e in padded], axis=1)
         v_prefix = jnp.concatenate([e["v"] for _, e in padded], axis=1)
@@ -1003,7 +1128,7 @@ class LLMEngine:
          self.rng_key, toks) = self._cont_fn(p, t, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
             self.temps, self.rng_key, self._put(packed),
-            k_prefix, v_prefix)
+            k_prefix, v_prefix, *self._extra())
         return toks
 
     def _store_prefix_entry(self, key: tuple, k, v) -> None:
@@ -1021,7 +1146,7 @@ class LLMEngine:
         p = self._prefix_len_for(len(prompt))
         if p is None:
             return
-        key = tuple(prompt[:p])
+        key = self._prefix_key(action.req_id, prompt[:p])
         if key in self._prefix_store:
             return
         k, v = self._extract_fn(p)(self.cache, action.slot)
@@ -1040,12 +1165,13 @@ class LLMEngine:
         # one packed transfer: [tokens ++ slot ++ prompt_len ++ temp_milli]
         # per row (a tunneled device pays ~an RTT per transfer)
         rows = [(self._prompts[a.req_id], a.slot, a.prompt_len,
-                 self._req_temps.get(a.req_id, 0.0)) for a in wave]
+                 self._req_temps.get(a.req_id, 0.0),
+                 self._req_aids.get(a.req_id, 0)) for a in wave]
         packed = self._pack_rows(width, bucket, rows)
         (self.cache, self.lengths, self.last_tokens, self.temps,
          self.rng_key, next_toks) = self._prefill_fn(bucket, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(packed))
+            self.temps, self.rng_key, self._put(packed), *self._extra())
         return next_toks
 
     def _do_decode(self) -> None:
@@ -1086,7 +1212,7 @@ class LLMEngine:
         (self.cache, self.lengths, self.last_tokens, self.temps,
          self.rng_key, toks) = self._decode_fn(k, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(active))
+            self.temps, self.rng_key, self._put(active), *self._extra())
         toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk
         done_slots: set[int] = set()
         for row in toks_np:
@@ -1129,7 +1255,7 @@ class LLMEngine:
         (self.cache, self.lengths, self.last_tokens, self.temps,
          self.rng_key, out) = self._spec_fn(steps, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(active))
+            self.temps, self.rng_key, self._put(active), *self._extra())
         out_np = np.asarray(out)   # [steps, n_slots, spec+2]; one fetch
         done_slots: set[int] = set()
         for s in range(steps):
@@ -1171,4 +1297,5 @@ class LLMEngine:
             self._prompts.pop(req_id, None)
             self._max_new.pop(req_id, None)
             self._req_temps.pop(req_id, None)
+            self._req_aids.pop(req_id, None)
         return freed

@@ -46,7 +46,9 @@ class LLMModel(Model):
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
-                 lora: dict[str, Any] | None = None, **_ignored: Any):
+                 lora: dict[str, Any] | None = None,
+                 adapters: dict[str, Any] | None = None,
+                 **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
         self._mesh = dict(mesh) if mesh else None
@@ -71,6 +73,11 @@ class LLMModel(Model):
         # the MERGED model — zero serving-path overhead, the engine never
         # knows LoRA existed
         self._lora = dict(lora) if lora else None
+        # config.adapters {name: {checkpoint: <llama_lora ckpt dir>,
+        # rank: r, alpha: a}}: multi-adapter serving — each request picks
+        # an adapter ("adapter" in the payload), all share the base and
+        # the continuous batch
+        self._adapters_cfg = dict(adapters) if adapters else None
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -128,7 +135,8 @@ class LLMModel(Model):
                                  quantize=self._quantize,
                                  kv_quantize=self._kv_quantize,
                                  speculative=self._speculative,
-                                 spec_ngram=self._spec_ngram)
+                                 spec_ngram=self._spec_ngram,
+                                 adapters=self._load_adapters(cfg))
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()
@@ -137,6 +145,37 @@ class LLMModel(Model):
                                         name=f"llm-engine-{self.name}")
         self._thread.start()
         self._mark_ready()
+
+    def _load_adapters(self, cfg):
+        """config.adapters -> engine adapter stacks: restore each named
+        llama_lora checkpoint's ADAPTER subtree (the base stays the
+        engine's own params — that is the whole point of multi-adapter
+        serving)."""
+        if not self._adapters_cfg:
+            return None
+        import jax
+
+        from kubeflow_tpu.models import lora as lora_lib
+        from kubeflow_tpu.serving.model import ModelError
+        from kubeflow_tpu.training.checkpoint import restore_params
+
+        out = {}
+        for name, spec in self._adapters_cfg.items():
+            lcfg = lora_lib.LoraLlamaConfig(
+                rank=int(spec.get("rank", 8)),
+                alpha=float(spec.get("alpha", 16.0)),
+                targets=tuple(spec["targets"]) if "targets" in spec
+                else lora_lib.LoraLlamaConfig.targets,
+                llama=dict(self._cfg_overrides))
+            abstract = jax.eval_shape(
+                lambda lc=lcfg: lora_lib.init(jax.random.key(0), lc))
+            try:
+                restored = restore_params(spec["checkpoint"],
+                                          {"lora": abstract["lora"]})
+            except FileNotFoundError as e:
+                raise ModelError(f"adapter {name!r}: {e}") from e
+            out[name] = {"lora": restored["lora"], "alpha": lcfg.alpha}
+        return out
 
     def _load_params(self, cfg):
         import jax
@@ -243,7 +282,9 @@ class LLMModel(Model):
         prompt = [int(t) for t in payload["prompt_tokens"]]
         max_new = int(payload.get("max_new_tokens", 32))
         temperature = float(payload.get("temperature", 0.0))
-        rid = self._engine.submit(prompt, max_new, temperature)
+        adapter = payload.get("adapter")
+        rid = self._engine.submit(prompt, max_new, temperature,
+                                  adapter=adapter)
         self._wake.set()
         return rid
 

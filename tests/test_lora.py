@@ -98,6 +98,7 @@ def test_optimizer_state_only_for_adapters():
     assert float(jnp.abs(updates["lora"]["wq"]["a"]).max()) > 0.0
 
 
+@pytest.mark.slow
 def test_lora_sharded_mesh(devices8):
     """fsdp x tensor layout: adapter shardings follow their target's in/out
     axes; a step runs and matches the single-device loss."""
@@ -125,6 +126,7 @@ def test_lora_sharded_mesh(devices8):
     assert abs(single - sharded) < 5e-2, (single, sharded)
 
 
+@pytest.mark.slow
 def test_merged_serves_through_engine():
     from kubeflow_tpu.serving.llm import LLMEngine
 
@@ -148,6 +150,7 @@ def test_registered_in_registry():
     assert "llama_lora" in registry.names()
 
 
+@pytest.mark.slow
 def test_serve_lora_checkpoint_through_runtime(tmp_path):
     """The train->serve loop: a llama_lora trainer checkpoint served by an
     InferenceService with `config: {lora: {rank: ...}}` — the runtime

@@ -130,6 +130,7 @@ def build(params, cfg, spec=None, **kw):
     return e
 
 
+@pytest.mark.slow
 def test_spec_greedy_exactness_random_model(params_cfg):
     """Acceptance ~0 on an untrained model — the degenerate case must still
     be exactly greedy."""
@@ -157,6 +158,7 @@ def test_spec_greedy_exactness_and_acceptance_trained(trained_params_cfg):
     assert m["spec_verify_rounds"] * 2 < len(out_spec) * 1.5 + 8
 
 
+@pytest.mark.slow
 def test_spec_batch_mixed_with_sampling(trained_params_cfg):
     """temp>0 slots coexist: they draft nothing (degrade to plain decode)
     while greedy slots accept; everyone terminates with the right lengths."""
@@ -170,6 +172,7 @@ def test_spec_batch_mixed_with_sampling(trained_params_cfg):
     assert len(spec.result(r_sample)) == 16
 
 
+@pytest.mark.slow
 def test_spec_composes_with_prefix_cache_and_chunked(trained_params_cfg):
     params, cfg, pattern = trained_params_cfg
     kw = dict(prefix_cache=True, max_prefixes=4)
@@ -182,6 +185,7 @@ def test_spec_composes_with_prefix_cache_and_chunked(trained_params_cfg):
     assert spec.metrics()["prefix_hits"] >= 1
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kv_quantize", [None, "int8"])
 def test_spec_int8_kv(trained_params_cfg, kv_quantize):
     """int8 KV + speculative: exactness holds vs the SAME-quantization
@@ -194,6 +198,7 @@ def test_spec_int8_kv(trained_params_cfg, kv_quantize):
     assert spec.generate(prompt, 24) == plain.generate(prompt, 24)
 
 
+@pytest.mark.slow
 def test_runtime_forwards_speculative():
     """`config: {speculative: k}` on an InferenceService must reach the
     engine (the serving-stack path, not just direct construction)."""
@@ -214,6 +219,7 @@ def test_runtime_forwards_speculative():
         m.unload()
 
 
+@pytest.mark.slow
 def test_spec_eos_mid_round(trained_params_cfg):
     """EOS inside an accepted run: surplus tokens are dropped and the
     request finishes at the EOS with finish_reason 'stop'."""
