@@ -56,14 +56,24 @@ class LoraLlamaConfig:
         if bad:
             raise ValueError(f"unknown lora targets {sorted(bad)}; "
                              f"known: {sorted(known)}")
+        # build (and cache) the base config NOW: a bad key in the llama
+        # dict must fail at config construction, not as a TypeError from
+        # some later arbitrary attribute read via __getattr__
+        try:
+            base = llama.LlamaConfig(**self.llama)
+        except TypeError as e:
+            raise ValueError(f"bad llama base-config fields: {e}") from None
+        object.__setattr__(self, "_base_cfg", base)
 
     @property
     def base_cfg(self) -> llama.LlamaConfig:
-        return llama.LlamaConfig(**self.llama)
+        return self._base_cfg
 
     # the trainer logs MFU against the model config; delegate the fields
     # it reads so llama_lora quacks like its base where it matters
     def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):   # e.g. _base_cfg before __post_init__
+            raise AttributeError(name)
         return getattr(self.base_cfg, name)
 
 

@@ -50,19 +50,26 @@ class _AbstractEngine:
     _unpack_wave = LLMEngine._unpack_wave
     _extract_prefix = LLMEngine._extract_prefix
     _decode = LLMEngine._decode
+    _spec_decode = LLMEngine._spec_decode
     _cache_write = LLMEngine._cache_write
     _sample_last = staticmethod(LLMEngine._sample_last)
     _pick = staticmethod(LLMEngine._pick)
 
-    def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None):
+    def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None,
+                 *, n_slots: int = 0, max_len: int = 0,
+                 speculative: int | None = None, adapters: bool = False):
         self.cfg = cfg
         self.kv_quantize = kv_quantize
-        # the proof covers the non-speculative, single-adapter menu (spec
-        # mode swaps the decode program for _spec_decode and adapters add
-        # a rank-r bypass — both ride within the margin)
-        self.spec = None
-        self.adapters = None
-        self._row_extra = 3
+        # spec mode swaps the decode program for _spec_decode and adapters
+        # add a rank-r gathered bypass to every matmul; both variants are
+        # compiled by aot_serving_report when requested (r3 advisor: the
+        # exclusion used to be asserted, not proven)
+        self.spec = speculative
+        self.spec_ngram = 3
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.adapters = True if adapters else None
+        self._row_extra = 4 if adapters else 3
 
 
 def _abstract_tree(tree, shardings):
@@ -98,6 +105,9 @@ def aot_serving_report(
     decode_steps: int = 8,
     do_compile: bool = True,
     model_overrides: dict[str, Any] | None = None,
+    speculative: int | None = None,
+    n_adapters: int = 0,
+    adapter_rank: int = 16,
 ) -> dict[str, Any]:
     """Compile the engine's 8B program menu for a v5e target; return the
     memory evidence. `topology=None` targets `n_devices` local devices
@@ -121,9 +131,12 @@ def aot_serving_report(
     mesh = make_mesh(MeshConfig(tensor=n_devices), devices=devices)
     eng = _AbstractEngine(cfg, kv_quantize=kv_quantize)
 
+    # one abstract trace of the full init, shared by the weight shardings,
+    # the adapter target dims, and the n_params count
+    init_sds = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
+
     # -- weights: bf16 (cast) or weight-only int8, sharded by logical axes
-    def build_params():
-        p = llama.init(jax.random.key(0), cfg)
+    def build_params(p):
         p = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
@@ -131,7 +144,7 @@ def aot_serving_report(
             p = llama.quantize_params(p)
         return p
 
-    p_sds = jax.eval_shape(build_params)
+    p_sds = jax.eval_shape(build_params, init_sds)
     p_sh = tree_logical_to_sharding(
         llama.logical_axes_for(p_sds, cfg), mesh)
     params = _abstract_tree(p_sds, p_sh)
@@ -185,14 +198,114 @@ def aot_serving_report(
         functools.partial(eng._extract_prefix, p=p_max)).lower(
         cache, jax.ShapeDtypeStruct((), jnp.int32, sharding=repl))
 
+    extra_lowered: dict[str, Any] = {}
+    if speculative:
+        # the speculative verify program (scan of _spec_decode rounds) at
+        # full span — the worst-HBM member of the spec menu: its verify
+        # forward carries S_v = spec+1 query rows plus the history buffer
+        spec_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
+                                   n_slots=n_slots, max_len=max_len,
+                                   speculative=speculative)
+        spec_cache = dict(cache)
+        spec_cache["hist"] = jax.ShapeDtypeStruct(
+            (n_slots, max_len), jnp.int32, sharding=repl)
+        extra_lowered[f"spec_k{speculative}_x{decode_steps}"] = jax.jit(
+            functools.partial(spec_eng._spec_decode, steps=decode_steps,
+                              span=max_len),
+            donate_argnums=(1, 2, 3, 4, 5)).lower(
+            params, spec_cache, lengths, last, temps, key, active)
+    if n_adapters:
+        # multi-adapter serving: the adapter stack rides as a trailing
+        # program arg ([L, A+1, ...] per target, index 0 = zero adapter)
+        # and the cache carries per-slot adapter ids. Target dims come from
+        # the model's own (unquantized) layer leaves — one source of truth
+        # for the layout, exactly like lora.init reads them.
+        ad_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
+                                 n_slots=n_slots, max_len=max_len,
+                                 adapters=True)
+        base_sds = init_sds
+        lora = {}
+        for t in ("wq", "wk", "wv", "wo"):
+            _, di, do = base_sds["layers"][t].shape
+            lora[t] = {"a": jax.ShapeDtypeStruct(
+                           (cfg.n_layers, n_adapters + 1, di, adapter_rank),
+                           jnp.float32, sharding=repl),
+                       "b": jax.ShapeDtypeStruct(
+                           (cfg.n_layers, n_adapters + 1, adapter_rank, do),
+                           jnp.float32, sharding=repl)}
+        ad_cache = dict(cache)
+        ad_cache["aids"] = jax.ShapeDtypeStruct(
+            (n_slots,), jnp.int32, sharding=repl)
+        ad_wave = i32((width, bucket + 4))
+        extra_lowered[f"adapter_prefill_a{n_adapters}_r{adapter_rank}"] = \
+            jax.jit(ad_eng._prefill, donate_argnums=(1, 2, 3, 4, 5)).lower(
+                params, ad_cache, lengths, last, temps, key, ad_wave, lora)
+        extra_lowered[f"adapter_decode_a{n_adapters}_r{adapter_rank}"] = \
+            jax.jit(functools.partial(ad_eng._decode, steps=decode_steps,
+                                      span=max_len),
+                    donate_argnums=(1, 2, 3, 4, 5)).lower(
+                params, ad_cache, lengths, last, temps, key, active, lora)
+        if speculative:
+            # the live engine dispatches spec AND adapters in ONE program
+            # (_do_spec_decode passes the adapter stack into _spec_decode);
+            # the combined member carries the spec+1 query rows, the hist
+            # buffer, and the gathered rank-r bypass simultaneously — it,
+            # not either variant alone, is the true worst of this menu
+            both_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
+                                       n_slots=n_slots, max_len=max_len,
+                                       speculative=speculative,
+                                       adapters=True)
+            both_cache = dict(ad_cache)
+            both_cache["hist"] = jax.ShapeDtypeStruct(
+                (n_slots, max_len), jnp.int32, sharding=repl)
+            extra_lowered[
+                f"spec_k{speculative}_adapter_a{n_adapters}_x{decode_steps}"
+            ] = jax.jit(
+                functools.partial(both_eng._spec_decode, steps=decode_steps,
+                                  span=max_len),
+                donate_argnums=(1, 2, 3, 4, 5)).lower(
+                params, both_cache, lengths, last, temps, key, active, lora)
+
     weight_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(params))
     cache_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(cache))
+    if speculative or n_adapters:
+        # the worst-peak member of the BASE menu is the largest-boundary
+        # continuation (cont_p_max); its spec/adapter variant — extra
+        # prefix-token wave columns + hist writes under spec, the gathered
+        # rank-r bypass under adapters — is the true worst of the combined
+        # menu, so it must be compiled too, not asserted to ride the margin
+        worst_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
+                                    n_slots=n_slots, max_len=max_len,
+                                    speculative=speculative,
+                                    adapters=bool(n_adapters))
+        worst_cache = dict(cache)
+        if speculative:
+            worst_cache["hist"] = jax.ShapeDtypeStruct(
+                (n_slots, max_len), jnp.int32, sharding=repl)
+        if n_adapters:
+            worst_cache["aids"] = jax.ShapeDtypeStruct(
+                (n_slots,), jnp.int32, sharding=repl)
+        ex = 4 if n_adapters else 3
+        worst_wave = i32((1, bucket + (p_max if speculative else 0) + ex))
+        worst_prefix = jax.ShapeDtypeStruct(
+            (cfg.n_layers, 1, p_max, cfg.n_kv_heads, cfg.head_dim),
+            jnp.dtype(cfg.dtype), sharding=cache_sh)
+        worst_args = (params, worst_cache, lengths, last, temps, key,
+                      worst_wave, worst_prefix, worst_prefix)
+        if n_adapters:
+            worst_args = worst_args + (lora,)
+        worst_name = (f"cont_p{p_max}_t{bucket}"
+                      + (f"_spec{speculative}" if speculative else "")
+                      + (f"_a{n_adapters}" if n_adapters else ""))
+        extra_lowered[worst_name] = jax.jit(
+            worst_eng._prefill_cont,
+            donate_argnums=(1, 2, 3, 4, 5)).lower(*worst_args)
+
     report: dict[str, Any] = {
         "model": ("llama3-8b" if model_overrides is None
                   else f"llama-custom(d{cfg.d_model}xL{cfg.n_layers})"),
         "n_params": sum(
-            math.prod(l.shape) for l in jax.tree.leaves(
-                jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg)))),
+            math.prod(l.shape) for l in jax.tree.leaves(init_sds)),
         "target": topology or str(devices[0].platform),
         "n_devices": n_devices,
         "tensor_parallel": n_devices,
@@ -203,6 +316,8 @@ def aot_serving_report(
         "prefill_bucket": bucket,
         "wave_width": width,
         "decode_steps": decode_steps,
+        "speculative": speculative,
+        "n_adapters": n_adapters,
         "weight_bytes_per_device": weight_bytes,
         "kv_cache_bytes_per_device": cache_bytes,
         "lowered": True,
@@ -215,6 +330,8 @@ def aot_serving_report(
             f"cont_p{p_max}_t{bucket}": _peak(cont_max_lowered.compile()),
             f"extract_p{p_max}": _peak(extract_lowered.compile()),
         }
+        peaks.update({name: _peak(low.compile())
+                      for name, low in extra_lowered.items()})
         report["compiled"] = True
         report["peak_bytes_per_device"] = peaks
         worst = max(peaks.values())

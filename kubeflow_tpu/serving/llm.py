@@ -294,6 +294,13 @@ class LLMEngine:
         names = sorted(adapters)
         first = adapters[names[0]]["lora"]
         targets = sorted(first)
+        bad = set(targets) - set(llama.QUANT_LEAVES)
+        if bad:
+            # mirror LoraLlamaConfig.__post_init__: a typo'd target (e.g.
+            # 'Wq') through the direct engine API must fail loudly here —
+            # _adapted would otherwise silently serve the base weights
+            raise ValueError(f"unknown adapter targets {sorted(bad)}; "
+                             f"known: {sorted(llama.QUANT_LEAVES)}")
         rank = first[targets[0]]["a"].shape[-1]
         stack = {}
         for t in targets:

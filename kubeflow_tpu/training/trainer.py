@@ -184,6 +184,11 @@ class Trainer:
                 transform_non_params=lambda _: self.repl,
             )
         except (ValueError, TypeError):
+            # the ONLY known-untraversable optimizer is the multi_transform
+            # wrapper trainable_prefix builds; any other failure here is a
+            # real sharding-spec bug that must not hide behind the fallback
+            if not self.config.optimizer.trainable_prefix:
+                raise
             opt_sh = self._suffix_path_sharding(abstract_state)
         return {"params": self.param_sharding, "opt_state": opt_sh,
                 "step": self.repl}

@@ -21,9 +21,13 @@ def _require_v5e():
 
 def test_8b_serving_programs_lower_on_8_device_mesh(devices8):
     # lower-only on the virtual CPU mesh: proves sharding propagation
-    # through the REAL engine program methods at true 8B dims
-    report = aot_serving_report(topology=None, n_devices=8, do_compile=False)
+    # through the REAL engine program methods at true 8B dims — including
+    # the speculative verify program and the multi-adapter prefill/decode
+    # (r3 advisor: these used to be asserted in range, not lowered)
+    report = aot_serving_report(topology=None, n_devices=8, do_compile=False,
+                                speculative=4, n_adapters=2)
     assert report["lowered"]
+    assert report["speculative"] == 4 and report["n_adapters"] == 2
     assert report["n_params"] == 8030261248
     assert report["tensor_parallel"] == 8
     # bf16 weights over 8 chips: ~2.01 GB/device
@@ -34,15 +38,17 @@ def test_8b_serving_programs_lower_on_8_device_mesh(devices8):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("quantize,kv_quantize", [
-    (None, None),            # bf16 weights, bf16 KV
-    ("int8", None),          # int8 weights
-    ("int8", "int8"),        # full production decode config
+@pytest.mark.parametrize("quantize,kv_quantize,spec,n_adapters", [
+    (None, None, None, 0),       # bf16 weights, bf16 KV
+    ("int8", None, None, 0),     # int8 weights
+    ("int8", "int8", 4, 2),      # full production decode config, plus the
+                                 # speculative + multi-adapter programs
 ])
-def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(quantize,
-                                                           kv_quantize):
+def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(
+        quantize, kv_quantize, spec, n_adapters):
     _require_v5e()
-    report = aot_serving_report(quantize=quantize, kv_quantize=kv_quantize)
+    report = aot_serving_report(quantize=quantize, kv_quantize=kv_quantize,
+                                speculative=spec, n_adapters=n_adapters)
     assert report["compiled"]
     assert report["fits_v5e_hbm"], report
     # int8 halves weight residency vs bf16 (scales add ~1%)
@@ -53,8 +59,20 @@ def test_8b_serving_menu_compiles_for_real_v5e8_within_hbm(quantize,
         bf16_cache = 32 * 8 * 8192 * 1 * 128 * 2 * 2
         assert report["kv_cache_bytes_per_device"] < 0.6 * bf16_cache
     peaks = report["peak_bytes_per_device"]
-    assert set(peaks) == {"prefill_b2048_w4", "decode_x8",
-                          "cont_p2048_t2048",   # prefix-hit / 1st boundary
-                          "cont_p6144_t2048",   # largest chain boundary
-                          "extract_p6144"}      # the extract feeding it
+    expected = {"prefill_b2048_w4", "decode_x8",
+                "cont_p2048_t2048",   # prefix-hit / 1st boundary
+                "cont_p6144_t2048",   # largest chain boundary
+                "extract_p6144"}      # the extract feeding it
+    if spec:
+        expected.add(f"spec_k{spec}_x8")
+    if n_adapters:
+        expected.add(f"adapter_prefill_a{n_adapters}_r16")
+        expected.add(f"adapter_decode_a{n_adapters}_r16")
+    if spec and n_adapters:   # the combined decode program
+        expected.add(f"spec_k{spec}_adapter_a{n_adapters}_x8")
+    if spec or n_adapters:    # worst-boundary continuation, full feature set
+        expected.add("cont_p6144_t2048"
+                     + (f"_spec{spec}" if spec else "")
+                     + (f"_a{n_adapters}" if n_adapters else ""))
+    assert set(peaks) == expected
     assert all(p > 0 for p in peaks.values())
