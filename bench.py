@@ -159,6 +159,14 @@ def main() -> None:
         extras["spec_decode"] = spec_decode_bench(on_tpu)
     except Exception as e:
         extras["spec_decode_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["mfu_8b_layer"] = mfu_8b_layer_bench(on_tpu)
+    except Exception as e:
+        extras["mfu_8b_layer_error"] = f"{type(e).__name__}: {e}"
+    try:
+        extras["serving_8b"] = serving_8b_bench(on_tpu)
+    except Exception as e:
+        extras["serving_8b_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(achieved_mfu, 4),
@@ -294,14 +302,20 @@ def decode_span_bench(on_tpu: bool) -> dict:
 
 
 def spec_decode_bench(on_tpu: bool) -> dict:
-    """Speculative decoding point: serve a model that has LEARNED its text
-    (trained to near-zero loss on a repeating 64-gram — the low-entropy
-    regime copy-heavy serving hits in practice, where greedy continuations
-    are predictable) and compare decode tok/s with prompt-lookup
-    speculation ON vs OFF. The speedup is acceptance-dependent by design:
-    the engine reports tokens-per-verify-round so the number explains
-    itself. Greedy outputs are byte-identical either way (exactness is the
-    tested contract, tests/test_spec_decode.py)."""
+    """Speculative decoding, TWO operating points from one training run:
+
+    - `full_acceptance`: the model trained to near-zero loss on a
+      repeating 64-gram, serving that same text — the best case by
+      construction (copy-heavy/low-entropy serving), kept for r2/r3
+      continuity.
+    - `realistic` (VERDICT r3 ask #4): the SAME model at a PARTIAL
+      training snapshot (loss well above zero) serving the same prompt —
+      its greedy continuations only locally match the prompt-lookup
+      drafts, so acceptance sits materially below k+1 and the speedup
+      shows what mixed-predictability text actually gets.
+
+    Greedy outputs are byte-identical spec-vs-plain at BOTH points
+    (exactness is the tested contract, tests/test_spec_decode.py)."""
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -328,11 +342,36 @@ def spec_decode_bench(on_tpu: bool) -> dict:
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    @jax.jit
+    def greedy_acc(p):
+        logits = llama.apply(p, tokens, cfg)[:, :-1]
+        return jnp.mean(jnp.argmax(logits, -1) == tokens[:, 1:])
+
+    total_steps = 150 if on_tpu else 120
     loss = None
-    for _ in range(150 if on_tpu else 120):
+    partial_at, partial_acc, partial_loss = 0, 0.0, 0.0
+    params_partial = fallback = None
+    for i in range(total_steps):
         params, opt_state, loss = train_step(params, opt_state)
+        if params_partial is None:
+            # adaptive snapshot keyed on ARGMAX accuracy, not loss: Adam
+            # drives argmax-perfect prediction while the loss is still
+            # ~0.7 (measured), so a loss/step-index rule lands at full
+            # acceptance and the "realistic" point degenerates. The first
+            # step predicting 55-92% of tokens is the mixed regime —
+            # drafts accept in runs and reject at the mispredictions.
+            a = float(greedy_acc(params))
+            if a < 0.92:
+                fallback = (jax.tree.map(lambda x: x + 0, params), a,
+                            float(loss), i + 1)
+            if 0.55 <= a <= 0.92:
+                params_partial = jax.tree.map(lambda x: x + 0, params)
+                partial_acc, partial_loss = a, float(loss)
+                partial_at = i + 1
+    if params_partial is None:   # curve jumped over the band: last <0.92
+        params_partial, partial_acc, partial_loss, partial_at = fallback
     loss = float(loss)
-    del opt_state
+    del opt_state, fallback
 
     n_slots = 8 if on_tpu else 2
     new_tokens = 96 if on_tpu else 16
@@ -350,25 +389,214 @@ def spec_decode_bench(on_tpu: bool) -> dict:
             engine.release(r)
         return n_slots * new_tokens / dt, outs
 
-    plain = LLMEngine(params, cfg, **kw)
-    plain.warmup()
-    plain_tps, plain_out = run(plain)
-    del plain
-    spec = LLMEngine(params, cfg, speculative=6, spec_ngram=3, **kw)
-    spec.warmup()
-    spec_tps, spec_out = run(spec)
-    tokens_per_round = spec.metrics()["spec_tokens_per_round"]
-    del spec
-    assert spec_out == plain_out, "speculative output diverged from greedy"
-    return {
-        "train_loss": round(loss, 4),
-        "n_req": n_slots, "new_tokens": new_tokens,
-        "tok_per_s_plain": round(plain_tps, 1),
-        "tok_per_s_spec": round(spec_tps, 1),
-        "speedup": round(spec_tps / plain_tps, 2),
-        "spec_tokens_per_round": tokens_per_round,
-        "drafts_per_round": 6,
+    def point(p):
+        plain = LLMEngine(p, cfg, **kw)
+        plain.warmup()
+        plain_tps, plain_out = run(plain)
+        del plain
+        spec = LLMEngine(p, cfg, speculative=6, spec_ngram=3, **kw)
+        spec.warmup()
+        spec_tps, spec_out = run(spec)
+        tokens_per_round = spec.metrics()["spec_tokens_per_round"]
+        del spec
+        assert spec_out == plain_out, \
+            "speculative output diverged from greedy"
+        return {
+            "n_req": n_slots, "new_tokens": new_tokens,
+            "tok_per_s_plain": round(plain_tps, 1),
+            "tok_per_s_spec": round(spec_tps, 1),
+            "speedup": round(spec_tps / plain_tps, 2),
+            "spec_tokens_per_round": tokens_per_round,
+            "drafts_per_round": 6,
+        }
+
+    full = dict(point(params), train_loss=round(loss, 4))
+    realistic = dict(point(params_partial),
+                     train_loss=round(partial_loss, 4),
+                     greedy_train_acc=round(partial_acc, 3),
+                     note=(f"partial snapshot at step {partial_at}/"
+                           f"{total_steps} (first step with 55-92% argmax "
+                           "accuracy): greedy continuations only locally "
+                           "match the drafts"))
+    del params, params_partial
+    # top-level keys mirror the r3 full-acceptance point for continuity
+    return dict(full, full_acceptance=full, realistic=realistic)
+
+
+def mfu_8b_layer_bench(on_tpu: bool) -> dict:
+    """Measured train MFU at the CONTRACT geometry (VERDICT r3 ask #2):
+    one true-dims Llama-3-8B layer (d4096/ff14336, GQA 32/8) at seq 8192
+    with FULL remat and the Pallas flash kernel, fwd+bwd+SGD in a loop on
+    the chip. The 0.63 headline is a 0.6B proxy; this point shows what the
+    contract dims' remat policy actually sustains per layer. Same FLOPs
+    convention as the headline (llama.flops_per_token: 6N + 12·L·H·S); the
+    vocab-256 head makes the embed/lm_head term negligible, so the number
+    is effectively the LAYER MFU."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.training.mfu import mfu as mfu_fn
+
+    seq = 8192 if on_tpu else 512
+    cfg = llama.LlamaConfig(
+        vocab_size=256, d_model=4096, n_layers=1, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=seq, remat=True, remat_policy="full",
+        attention_impl="flash", scan_layers=False,
+    ) if on_tpu else llama.LlamaConfig.tiny()
+    rng = jax.random.key(0)
+
+    def attempt(batch: int) -> dict:
+        params = llama.init(rng, cfg)
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                    cfg.vocab_size, jnp.int32)
+
+        @jax.jit
+        def step(p, toks):
+            def loss(pp):
+                return llama.loss_fn(pp, {"tokens": toks}, cfg)[0]
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree.map(lambda w, gw: w - 1e-4 * gw.astype(w.dtype),
+                                p, g), l
+
+        for _ in range(2):
+            params, l = step(params, tokens)
+        float(l)   # sync (axon: fetch, not block_until_ready)
+        n_meas = 6
+        t0 = time.perf_counter()
+        for _ in range(n_meas):
+            params, l = step(params, tokens)
+        assert float(l) == float(l)
+        dt = (time.perf_counter() - t0) / n_meas
+        tokens_per_step = batch * seq
+        flops = llama.flops_per_token(cfg, seq) * tokens_per_step
+        return {
+            "mfu": round(mfu_fn(flops, dt, 1), 4),
+            "tokens_per_sec_per_chip": round(tokens_per_step / dt, 1),
+            "step_time_s": round(dt, 4),
+            "batch": batch, "seq_len": seq,
+            "geometry": (f"d{cfg.d_model}/ff{cfg.d_ff} "
+                         f"GQA{cfg.n_heads}:{cfg.n_kv_heads} "
+                         f"x{cfg.n_layers} layer"),
+            "remat": cfg.remat_policy, "attention": cfg.attention_impl,
+        }
+
+    last = "no config attempted"
+    for batch in ((4, 2, 1) if on_tpu else (2,)):
+        try:
+            return attempt(batch)
+        except Exception as e:   # OOM at this batch: walk down
+            last = f"{type(e).__name__}: {e}"
+    raise RuntimeError(last)
+
+
+def _init_llama_int8_serving(cfg, seed: int = 0):
+    """Random-init llama params DIRECTLY in the serving int8 layout, leaf
+    by leaf on device — the f32 8B tree (~32 GiB) never exists anywhere.
+    Layer payloads are generated as raw random bytes ([L, in, out] uint8 →
+    bitcast int8, ~1 byte/param of HBM and no int32 temps); scales are the
+    1/(127·sqrt(fan_in)) constant that makes activations O(1); embed is
+    bf16 (it is a gather, never quantized — models/llama.quantize_params).
+    Random weights are the perf-honest stand-in BASELINE #5 allows: the
+    programs, layouts, and byte traffic are exactly the production ones."""
+    import functools
+
+    import jax.numpy as jnp
+
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    nh, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+
+    @functools.partial(jax.jit, static_argnames=("shape",))
+    def rand_i8(key, shape):
+        bits = jax.random.bits(key, shape, dtype=jnp.uint8)
+        return jax.lax.bitcast_convert_type(bits, jnp.int8)
+
+    def qleaf(key, shape):
+        return {"q": rand_i8(key, shape),
+                "s": jnp.full(shape[:-2] + (shape[-1],),
+                              1.0 / (127.0 * shape[-2] ** 0.5),
+                              jnp.float32)}
+
+    keys = jax.random.split(jax.random.key(seed), 16)
+    layer_shapes = {
+        "wq": (L, d, nh * hd), "wk": (L, d, nkv * hd),
+        "wv": (L, d, nkv * hd), "wo": (L, nh * hd, d),
+        "w_gate": (L, d, f), "w_up": (L, d, f), "w_down": (L, f, d),
     }
+    layers = {name: qleaf(keys[i], shape)
+              for i, (name, shape) in enumerate(layer_shapes.items())}
+    layers["attn_norm"] = jnp.ones((L, d), jnp.float32)
+    layers["mlp_norm"] = jnp.ones((L, d), jnp.float32)
+    embed = (jax.jit(lambda k: jax.random.normal(
+        k, (cfg.vocab_size, d), jnp.bfloat16) / (d ** 0.5))(keys[8]))
+    return {"embed": embed, "layers": layers,
+            "final_norm": jnp.ones((d,), jnp.float32),
+            "lm_head": qleaf(keys[9], (d, cfg.vocab_size))}
+
+
+def serving_8b_bench(on_tpu: bool) -> dict:
+    """BASELINE config #5 at TRUE dims, LIVE on the chip (VERDICT r3 ask
+    #1): Llama-3-8B geometry (d4096/L32/ff14336, GQA 32/8, vocab 128256)
+    actually serving tokens through the continuous-batching engine —
+    int8 weights (~8.6 GiB with the bf16 embed) + int8 KV cache (4 slots
+    × 2048, ~0.3 GiB) resident in the 16 GiB HBM. Reports measured TTFT
+    under Poisson load, sustained decode tok/s, and the byte residency.
+    The r3 story was AOT-compile-only; this is tokens on the wire."""
+    if not on_tpu:
+        # exercise the code path with toy dims off-TPU
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+            d_ff=128, max_seq_len=256)
+        n_slots, max_len, bucket = 2, 128, 16
+        prompt_len, new_tokens, n_req = 8, 8, 4
+    else:
+        cfg = llama.LlamaConfig.llama3_8b()
+        n_slots, max_len, bucket = 4, 2048, 128
+        prompt_len, new_tokens, n_req = 100, 64, 16
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    params = _init_llama_int8_serving(cfg)
+    weight_bytes = sum(l.nbytes for l in jax.tree.leaves(params))
+    t0 = time.perf_counter()
+    engine = LLMEngine(params, cfg, n_slots=n_slots, max_len=max_len,
+                       buckets=(bucket,), decode_chunk=8,
+                       kv_quantize="int8")
+    cache_bytes = sum(l.nbytes for l in jax.tree.leaves(engine.cache))
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size,
+                          size=(prompt_len,)).astype(int).tolist()
+    # sustained decode: all slots busy, long generations
+    rids = [engine.submit(prompt, new_tokens * 2) for _ in range(n_slots)]
+    t0 = time.perf_counter()
+    engine.run_until_idle()
+    dt = time.perf_counter() - t0
+    assert all(engine.is_done(r) for r in rids)
+    for r in rids:
+        engine.release(r)
+    decode_tps = n_slots * new_tokens * 2 / dt
+    # open-loop Poisson arrivals: TTFT with queueing under load
+    load = _poisson_run(engine, prompt, new_tokens, n_req,
+                        0.5 if on_tpu else 0.05)
+    out = {
+        "model": "llama3-8b(true-dims)" if on_tpu else "llama-tiny(cpu)",
+        "weights": "int8(+bf16 embed)", "kv_cache": "int8",
+        "n_params": 8030261248 if on_tpu else None,
+        "weight_gib": round(weight_bytes / 1024**3, 3),
+        "kv_cache_gib": round(cache_bytes / 1024**3, 3),
+        "n_slots": n_slots, "max_len": max_len, "prefill_bucket": bucket,
+        "warmup_s": round(warmup_s, 1),
+        "decode_tok_per_s": round(decode_tps, 1),
+        "ttft_p50_ms": load["ttft_p50_ms"],
+        "ttft_p99_ms": load["ttft_p99_ms"],
+        "poisson": load,
+    }
+    del engine, params
+    return out
 
 
 def _poisson_run(engine, prompt, new_tokens: int, n_req: int,

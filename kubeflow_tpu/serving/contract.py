@@ -52,8 +52,9 @@ class _AbstractEngine:
     _decode = LLMEngine._decode
     _spec_decode = LLMEngine._spec_decode
     _cache_write = LLMEngine._cache_write
-    _sample_last = staticmethod(LLMEngine._sample_last)
-    _pick = staticmethod(LLMEngine._pick)
+    _choose = LLMEngine._choose
+    _pack_out = LLMEngine._pack_out
+    _out_cols = LLMEngine._out_cols
 
     def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None,
                  *, n_slots: int = 0, max_len: int = 0,
@@ -69,7 +70,10 @@ class _AbstractEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.adapters = True if adapters else None
-        self._row_extra = 4 if adapters else 3
+        self._row_extra = 6 if adapters else 5
+        # production sampler defaults (serving/llm.py __init__)
+        self.sample_k_max = 64
+        self.logprobs_topk = 0
 
 
 def _abstract_tree(tree, shardings):
@@ -129,7 +133,8 @@ def aot_serving_report(
     if cfg.n_kv_heads % n_devices:
         raise ValueError(f"kv heads {cfg.n_kv_heads} vs tensor={n_devices}")
     mesh = make_mesh(MeshConfig(tensor=n_devices), devices=devices)
-    eng = _AbstractEngine(cfg, kv_quantize=kv_quantize)
+    eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
+                          n_slots=n_slots, max_len=max_len)
 
     # one abstract trace of the full init, shared by the weight shardings,
     # the adapter target dims, and the n_params count
@@ -161,26 +166,27 @@ def aot_serving_report(
     i32 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32,
                             sharding=repl)
     lengths, last = i32((n_slots,)), i32((n_slots,))
-    temps = jax.ShapeDtypeStruct((n_slots,), jnp.float32, sharding=repl)
+    # per-slot sampling state [temperature, top_k, top_p]
+    samp = jax.ShapeDtypeStruct((n_slots, 3), jnp.float32, sharding=repl)
     key_sds = jax.eval_shape(lambda: jax.random.key(0))
     key = jax.ShapeDtypeStruct(key_sds.shape, key_sds.dtype, sharding=repl)
-    wave = i32((width, bucket + 3))
+    wave = i32((width, bucket + 5))
     active = jax.ShapeDtypeStruct((n_slots,), jnp.bool_, sharding=repl)
 
     prefill_lowered = jax.jit(
         eng._prefill, donate_argnums=(1, 2, 3, 4, 5)).lower(
-        params, cache, lengths, last, temps, key, wave)
+        params, cache, lengths, last, samp, key, wave)
     decode_lowered = jax.jit(
         functools.partial(eng._decode, steps=decode_steps),
         donate_argnums=(1, 2, 3, 4, 5)).lower(
-        params, cache, lengths, last, temps, key, active)
+        params, cache, lengths, last, samp, key, active)
     # chunked-prefill / prefix-cache continuation steps. Every chain
     # boundary compiles a DIFFERENT (p, t) program with a growing prefix
     # tensor, so the contract covers the FIRST boundary (p=bucket — the
     # prefix-cache hit shape) and the LARGEST possible boundary
     # (p = max_len - bucket — the worst-peak program of the longest
     # admissible prompt), plus the extract feeding it.
-    cont_wave = i32((1, bucket + 3))
+    cont_wave = i32((1, bucket + 5))
 
     def cont_lower(p):
         kv_prefix = jax.ShapeDtypeStruct(
@@ -188,7 +194,7 @@ def aot_serving_report(
             jnp.dtype(cfg.dtype), sharding=cache_sh)
         return jax.jit(
             eng._prefill_cont, donate_argnums=(1, 2, 3, 4, 5)).lower(
-            params, cache, lengths, last, temps, key, cont_wave,
+            params, cache, lengths, last, samp, key, cont_wave,
             kv_prefix, kv_prefix)
 
     p_max = max_len - bucket
@@ -213,7 +219,7 @@ def aot_serving_report(
             functools.partial(spec_eng._spec_decode, steps=decode_steps,
                               span=max_len),
             donate_argnums=(1, 2, 3, 4, 5)).lower(
-            params, spec_cache, lengths, last, temps, key, active)
+            params, spec_cache, lengths, last, samp, key, active)
     if n_adapters:
         # multi-adapter serving: the adapter stack rides as a trailing
         # program arg ([L, A+1, ...] per target, index 0 = zero adapter)
@@ -236,15 +242,15 @@ def aot_serving_report(
         ad_cache = dict(cache)
         ad_cache["aids"] = jax.ShapeDtypeStruct(
             (n_slots,), jnp.int32, sharding=repl)
-        ad_wave = i32((width, bucket + 4))
+        ad_wave = i32((width, bucket + 6))
         extra_lowered[f"adapter_prefill_a{n_adapters}_r{adapter_rank}"] = \
             jax.jit(ad_eng._prefill, donate_argnums=(1, 2, 3, 4, 5)).lower(
-                params, ad_cache, lengths, last, temps, key, ad_wave, lora)
+                params, ad_cache, lengths, last, samp, key, ad_wave, lora)
         extra_lowered[f"adapter_decode_a{n_adapters}_r{adapter_rank}"] = \
             jax.jit(functools.partial(ad_eng._decode, steps=decode_steps,
                                       span=max_len),
                     donate_argnums=(1, 2, 3, 4, 5)).lower(
-                params, ad_cache, lengths, last, temps, key, active, lora)
+                params, ad_cache, lengths, last, samp, key, active, lora)
         if speculative:
             # the live engine dispatches spec AND adapters in ONE program
             # (_do_spec_decode passes the adapter stack into _spec_decode);
@@ -264,7 +270,7 @@ def aot_serving_report(
                 functools.partial(both_eng._spec_decode, steps=decode_steps,
                                   span=max_len),
                 donate_argnums=(1, 2, 3, 4, 5)).lower(
-                params, both_cache, lengths, last, temps, key, active, lora)
+                params, both_cache, lengths, last, samp, key, active, lora)
 
     weight_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(params))
     cache_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(cache))
@@ -285,12 +291,12 @@ def aot_serving_report(
         if n_adapters:
             worst_cache["aids"] = jax.ShapeDtypeStruct(
                 (n_slots,), jnp.int32, sharding=repl)
-        ex = 4 if n_adapters else 3
+        ex = 6 if n_adapters else 5
         worst_wave = i32((1, bucket + (p_max if speculative else 0) + ex))
         worst_prefix = jax.ShapeDtypeStruct(
             (cfg.n_layers, 1, p_max, cfg.n_kv_heads, cfg.head_dim),
             jnp.dtype(cfg.dtype), sharding=cache_sh)
-        worst_args = (params, worst_cache, lengths, last, temps, key,
+        worst_args = (params, worst_cache, lengths, last, samp, key,
                       worst_wave, worst_prefix, worst_prefix)
         if n_adapters:
             worst_args = worst_args + (lora,)

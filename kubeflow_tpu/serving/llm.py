@@ -67,7 +67,9 @@ def _ngram_draft(hist, lengths, k: int, n: int):
 
 
 class LLMEngine:
-    """Greedy continuous-batching generation over llama-family params."""
+    """Continuous-batching generation over llama-family params: greedy by
+    default, per-request temperature/top-k/top-p sampling, stop sequences,
+    logprobs, and chunk-boundary cancellation."""
 
     def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
@@ -80,7 +82,9 @@ class LLMEngine:
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
-                 adapters: dict[str, dict[str, Any]] | None = None):
+                 adapters: dict[str, dict[str, Any]] | None = None,
+                 logprobs_topk: int = 0,
+                 sample_k_max: int = 64):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
@@ -94,6 +98,21 @@ class LLMEngine:
             # would trace a zero-size reduction in _ngram_draft — fail
             # loudly at construction, not deep inside warmup
             raise ValueError("spec_ngram must be 1..8")
+        if not 0 <= logprobs_topk <= 16:
+            raise ValueError("logprobs_topk must be 0..16")
+        if sample_k_max < 1:
+            raise ValueError("sample_k_max must be >= 1")
+        # -- sampling parity (⊘ kserve huggingfaceserver, SURVEY §2.4): the
+        # decode/prefill/verify programs sample with per-request
+        # temperature + top-k + top-p INSIDE the compiled programs (static
+        # shapes: nucleus filtering runs over the top `sample_k_max`
+        # candidates via lax.top_k — requests may not ask for a larger
+        # top_k). Every program also emits the chosen token's raw-model
+        # logprob; logprobs_topk > 0 additionally emits the top-N
+        # alternatives per position (a static program-output width, so it
+        # is an engine-level knob, not a per-request one).
+        self.logprobs_topk = logprobs_topk
+        self.sample_k_max = sample_k_max
         # -- speculative decoding (prompt-lookup/n-gram drafting, fully
         # device-resident): each "decode" dispatch becomes a scan of verify
         # steps — draft k tokens by matching the context's trailing n-gram
@@ -125,9 +144,10 @@ class LLMEngine:
         if adapters:
             self._adapter_idx = {n: i + 1
                                  for i, n in enumerate(sorted(adapters))}
-        # packed wave rows end with [slot, prompt_len, temp_milli] and,
-        # under multi-adapter serving, an adapter-id column
-        self._row_extra = 4 if adapters else 3
+        # packed wave rows end with [slot, prompt_len, temp_milli, top_k,
+        # top_p_micro] and, under multi-adapter serving, an adapter-id
+        # column
+        self._row_extra = 6 if adapters else 5
         # int8 KV cache: decode re-reads the whole (span of the) cache
         # every step, so int8 storage halves that HBM traffic vs bf16 and
         # halves cache residency (2x slots or context at 8B scale);
@@ -157,13 +177,17 @@ class LLMEngine:
         self.cache = self._alloc_cache()
         self.lengths = self._put(np.zeros((n_slots,), np.int32))
         self.last_tokens = self._put(np.zeros((n_slots,), np.int32))
-        # per-slot sampling temperature (0 = greedy) + the program-threaded
-        # PRNG key: both live on device like the rest of the slot state
-        self.temps = self._put(np.zeros((n_slots,), np.float32))
+        # per-slot sampling state [temperature, top_k, top_p] (0/0/0 =
+        # greedy, filters off) + the program-threaded PRNG key: both live
+        # on device like the rest of the slot state
+        self.samp = self._put(np.zeros((n_slots, 3), np.float32))
         self.rng_key = (jax.random.key(sample_seed) if self.mesh is None
                         else jax.device_put(jax.random.key(sample_seed),
                                             self._repl))
-        self._req_temps: dict[int, float] = {}
+        # per-request (temperature, top_k, top_p) mirror for wave packing
+        self._req_samp: dict[int, tuple[float, int, float]] = {}
+        # host-side stop-sequence suffix matching at chunk boundaries
+        self._req_stop: dict[int, list[list[int]]] = {}
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
         self._max_new: dict[int, int] = {}
@@ -171,9 +195,19 @@ class LLMEngine:
 
         self._prompts: dict[int, list[int]] = {}
         self._results: dict[int, list[int]] = {}
+        self._logprobs: dict[int, list[float]] = {}
+        self._toplogprobs: dict[int, list[dict[int, float]]] = {}
         self._submit_t: dict[int, float] = {}
         self._first_token_t: dict[int, float] = {}
         self._done: set[int] = set()
+        # -- cancellation (SURVEY §2.6 Triton-class runtimes support
+        # request cancellation; a CB engine without it leaks decode
+        # capacity under dropped clients). cancel() only QUEUES the id —
+        # the engine thread applies it at the next chunk boundary (top of
+        # step()), so no lock covers a device dispatch.
+        self._cancel_pending: list[int] = []
+        self._deadlines: dict[int, float] = {}
+        self._cancelled_count = 0
         self._ttft_window: collections.deque[float] = collections.deque(
             maxlen=1024)
         # Guards submit vs. the engine-loop thread: held across
@@ -336,38 +370,115 @@ class LLMEngine:
     # iteration (the new tokens), which is what keeps per-step latency at
     # dispatch cost instead of several tunnel round-trips.
 
-    @staticmethod
-    def _pick(logits, temps, key):
-        """Greedy where temps==0, temperature sampling elsewhere — per ROW
-        (slot/wave entry), so one continuous batch mixes both."""
+    def _choose(self, logits, samp, key, slots):
+        """ONE sampler for every program. logits [R, V] f32 raw model
+        logits; samp [R, 3] = (temperature, top_k, top_p) per row; slots
+        [R] per-row slot ids — sampling keys derive from the SLOT id, so
+        padded duplicate rows (same slot, same data) sample identically
+        and duplicate writes stay idempotent. Returns (next_key, tokens).
+
+        Per-row semantics (mixing freely within one continuous batch):
+          temp == 0              → greedy (bit-exact argmax, filters moot)
+          temp > 0, no filters   → categorical over the full vocab
+          top_k > 0 / top_p < 1  → nucleus/top-k over the top
+                                   `sample_k_max` candidates (lax.top_k —
+                                   the static-shape TPU form; submit()
+                                   rejects top_k > sample_k_max, and a
+                                   top_p nucleus wider than sample_k_max
+                                   candidates is truncated there)
+        top_p uses the standard smallest-prefix rule: keep candidate j
+        while the cumulative mass BEFORE j is < p (so the first candidate
+        always survives)."""
+        temps, topks, topps = samp[:, 0], samp[:, 1], samp[:, 2]
+        key, sub = jax.random.split(key)
+        row_keys = jax.vmap(lambda s: jax.random.fold_in(sub, s))(slots)
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)
         scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, scaled,
-                                         axis=-1).astype(jnp.int32)
-        return jnp.where(temps > 0, sampled, greedy)
+        # ONE categorical serves both modes: the filters reduce to a
+        # per-row probability THRESHOLD (the smallest admitted candidate's
+        # mass, from the sorted top-sample_k_max prefix), and rows with
+        # filters off get threshold 0 — the mask is then all-pass and the
+        # draw is BIT-IDENTICAL to an unfiltered categorical, so the
+        # "top_p=1/top_k=0 matches plain sampling" contract holds by
+        # construction, not by a second code path.
+        kmax = min(self.sample_k_max, logits.shape[-1])
+        probs = jax.nn.softmax(scaled, axis=-1)
+        top_vals, _ = jax.lax.top_k(probs, kmax)         # sorted desc
+        cum = jnp.cumsum(top_vals, axis=-1)
+        # admit candidate j while the mass BEFORE j is < p (p off => 2.0
+        # admits all) and j < top_k (off => kmax)
+        keep_p = (cum - top_vals) < jnp.where(
+            (topps > 0) & (topps < 1), topps, 2.0)[:, None]
+        kk = jnp.where(topks > 0, jnp.minimum(topks, kmax), kmax)
+        keep = keep_p & (jnp.arange(kmax)[None] < kk[:, None])
+        n_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+        thr = jnp.take_along_axis(top_vals, n_keep[:, None] - 1,
+                                  axis=1)[:, 0]
+        use_filter = (topks > 0) | ((topps > 0) & (topps < 1))
+        thr = jnp.where(use_filter, thr, 0.0)
+        masked = jnp.where(probs >= thr[:, None], scaled, -jnp.inf)
+        sampled = jax.vmap(
+            lambda rk, row: jax.random.categorical(rk, row))(
+            row_keys, masked).astype(jnp.int32)
+        return key, jnp.where(temps > 0, sampled, greedy)
+
+    def _pack_out(self, toks, logits):
+        """Program output row per sampled token: [tok, logprob(, top-N ids,
+        top-N logprobs)] as ONE f32 array — a single packed fetch keeps the
+        host loop at one RTT per iteration (token ids are exact in f32 for
+        any vocab < 2^24). Logprobs are of the RAW model distribution
+        (temperature-independent), the OpenAI convention."""
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lp = jnp.take_along_axis(logits, toks[..., None],
+                                 axis=-1)[..., 0] - lse
+        cols = [toks.astype(jnp.float32)[..., None], lp[..., None]]
+        if self.logprobs_topk:
+            tv, tid = jax.lax.top_k(logits, self.logprobs_topk)
+            cols += [tid.astype(jnp.float32), tv - lse[..., None]]
+        return jnp.concatenate(cols, axis=-1)
+
+    @property
+    def _out_cols(self) -> int:
+        return 2 + 2 * self.logprobs_topk
+
+    def _unpack_out(self, row):
+        """Host twin of _pack_out: np row → (tok, lp, top|None) where top
+        is a {token_id: logprob} dict of the top-N alternatives."""
+        tok, lp = int(row[0]), float(row[1])
+        if not self.logprobs_topk:
+            return tok, lp, None
+        n = self.logprobs_topk
+        return tok, lp, {int(t): float(l)
+                         for t, l in zip(row[2:2 + n], row[2 + n:2 + 2 * n])}
 
     def _unpack_wave(self, wave):
-        """Row layout: tokens ++ [slot, prompt_len, temp_milli(, aid)].
-        Returns (tokens, slots, prompt_lens, row_temps, aids|None)."""
+        """Row layout: tokens ++ [slot, prompt_len, temp_milli, top_k,
+        top_p_micro(, aid)]. Returns (tokens, slots, prompt_lens,
+        row_samp [W, 3], aids|None)."""
         ex = self._row_extra
         tokens = wave[:, :-ex]
         slots, prompt_lens = wave[:, -ex], wave[:, -ex + 1]
-        row_temps = wave[:, -ex + 2].astype(jnp.float32) / 1000.0
+        row_samp = jnp.stack([
+            wave[:, -ex + 2].astype(jnp.float32) / 1000.0,
+            wave[:, -ex + 3].astype(jnp.float32),
+            wave[:, -ex + 4].astype(jnp.float32) / 1e6,
+        ], axis=1)
         aids = wave[:, -1] if self.adapters is not None else None
-        return tokens, slots, prompt_lens, row_temps, aids
+        return tokens, slots, prompt_lens, row_samp, aids
 
-    def _prefill(self, params, cache, lengths, last_tokens, temps, key,
+    def _prefill(self, params, cache, lengths, last_tokens, samp, key,
                  wave, lora=None):
         """Batched prefill wave. `wave` is ONE packed int32 array
-        [W, bucket+3] — row i = prompt tokens (right-padded) ++ [slot,
-        prompt_len, temperature*1000] (++ adapter id under multi-adapter
-        serving) — because on a tunneled device every host->device
-        transfer costs a full RTT: one packed transfer + one dispatch
-        covers a whole burst of arrivals. Padded wave rows duplicate a
-        real row (same slot, same data) and sampling keys derive from the
-        slot id, so duplicate writes are idempotent even for sampled
-        requests."""
-        tokens, slots, prompt_lens, row_temps, aids = self._unpack_wave(wave)
+        [W, bucket+ex] — row i = prompt tokens (right-padded) ++ [slot,
+        prompt_len, temp_milli, top_k, top_p_micro] (++ adapter id under
+        multi-adapter serving) — because on a tunneled device every
+        host->device transfer costs a full RTT: one packed transfer + one
+        dispatch covers a whole burst of arrivals. Padded wave rows
+        duplicate a real row (same slot, same data) and sampling keys
+        derive from the slot id, so duplicate writes are idempotent even
+        for sampled requests. Returns packed [W, out_cols] rows
+        (_pack_out)."""
+        tokens, slots, prompt_lens, row_samp, aids = self._unpack_wave(wave)
         logits, ks, vs = llama.prefill(params, tokens, self.cfg,
                                        lora=lora, ids=aids)
         bucket = tokens.shape[1]
@@ -377,12 +488,13 @@ class LLMEngine:
             cache = self._cache_write(cache, slots[i], 0, bucket,
                                       ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
-            temps = temps.at[slots[i]].set(row_temps[i])
+            samp = samp.at[slots[i]].set(row_samp[i])
             if aids is not None:
                 cache["aids"] = cache["aids"].at[slots[i]].set(aids[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
                 logits[i], prompt_lens[i] - 1, keepdims=False))
-        key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots, key)
+        stacked = jnp.stack(lasts)
+        key, toks = self._choose(stacked, row_samp, key, slots)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
         if self.spec:
@@ -393,7 +505,8 @@ class LLMEngine:
             for i in range(tokens.shape[0]):
                 hist = hist.at[slots[i], :bucket].set(tokens[i])
             cache["hist"] = hist
-        return (cache, lengths, last_tokens, temps, key, toks)
+        return (cache, lengths, last_tokens, samp, key,
+                self._pack_out(toks, stacked))
 
     def _cache_write(self, cache, slot, start: int, count: int, ks, vs):
         """Write [L, count, kv, hd] KV rows into a slot's [start, start+count)
@@ -415,35 +528,21 @@ class LLMEngine:
                 vs.astype(cache["v"].dtype))
         return out
 
-    @staticmethod
-    def _sample_last(stacked, row_temps, slots, key):
-        """Greedy/temperature pick over a wave's last-position logits.
-        Per-row keys derive from the SLOT id: padded duplicate rows share
-        their source row's slot, so they sample the identical token and
-        duplicate last_tokens writes stay idempotent."""
-        key, sub = jax.random.split(key)
-        row_keys = jax.vmap(lambda s: jax.random.fold_in(sub, s))(slots)
-        greedy = jnp.argmax(stacked, -1).astype(jnp.int32)
-        scaled = stacked / jnp.maximum(row_temps, 1e-6)[:, None]
-        sampled = jax.vmap(
-            lambda rk, row: jax.random.categorical(rk, row).astype(
-                jnp.int32))(row_keys, scaled)
-        return key, jnp.where(row_temps > 0, sampled, greedy)
-
-    def _prefill_cont(self, params, cache, lengths, last_tokens, temps, key,
+    def _prefill_cont(self, params, cache, lengths, last_tokens, samp, key,
                       wave, k_prefix, v_prefix, lora=None):
         """Batched continuation prefill against cached prefixes. `wave` is
-        [W, T+3] — tail tokens (prompt[P:], right-padded to the tail
-        bucket) ++ [slot, full_prompt_len, temp_milli(, aid)] per row;
-        k/v_prefix: [L, W, P, kv, hd] (row i's prefix — different requests
-        may hit DIFFERENT store entries of the same P). With speculative
-        decoding on, rows are [tail(T) ++ prefix(P) ++ slot, len, temp] —
-        the prefix KV alone can't populate the token-history buffer the
-        n-gram drafter reads, so the prefix TOKENS ride the same packed
-        transfer. Writes prefix+tail KV into each slot and samples next
-        tokens from the tails' last rows; padded duplicate rows repeat
-        their source row (idempotent writes), exactly like _prefill."""
-        tokens_all, slots, prompt_lens, row_temps, aids = \
+        [W, T+ex] — tail tokens (prompt[P:], right-padded to the tail
+        bucket) ++ [slot, full_prompt_len, temp_milli, top_k, top_p_micro
+        (, aid)] per row; k/v_prefix: [L, W, P, kv, hd] (row i's prefix —
+        different requests may hit DIFFERENT store entries of the same P).
+        With speculative decoding on, rows are [tail(T) ++ prefix(P) ++
+        extras] — the prefix KV alone can't populate the token-history
+        buffer the n-gram drafter reads, so the prefix TOKENS ride the
+        same packed transfer. Writes prefix+tail KV into each slot and
+        samples next tokens from the tails' last rows; padded duplicate
+        rows repeat their source row (idempotent writes), exactly like
+        _prefill. Returns packed [W, out_cols] rows."""
+        tokens_all, slots, prompt_lens, row_samp, aids = \
             self._unpack_wave(wave)
         p = k_prefix.shape[2]
         t_bucket = tokens_all.shape[1] - (p if self.spec else 0)
@@ -459,13 +558,13 @@ class LLMEngine:
             cache = self._cache_write(cache, slots[i], p, t_bucket,
                                       ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
-            temps = temps.at[slots[i]].set(row_temps[i])
+            samp = samp.at[slots[i]].set(row_samp[i])
             if aids is not None:
                 cache["aids"] = cache["aids"].at[slots[i]].set(aids[i])
             lasts.append(jax.lax.dynamic_index_in_dim(
                 logits[i], prompt_lens[i] - p - 1, keepdims=False))
-        key, toks = self._sample_last(jnp.stack(lasts), row_temps, slots,
-                                      key)
+        stacked = jnp.stack(lasts)
+        key, toks = self._choose(stacked, row_samp, key, slots)
         for i in range(tokens.shape[0]):
             last_tokens = last_tokens.at[slots[i]].set(toks[i])
         if self.spec:
@@ -475,7 +574,8 @@ class LLMEngine:
                 hist = hist.at[slots[i], :p].set(prefix_toks[i])
                 hist = hist.at[slots[i], p:p + t_bucket].set(tokens[i])
             cache["hist"] = hist
-        return (cache, lengths, last_tokens, temps, key, toks)
+        return (cache, lengths, last_tokens, samp, key,
+                self._pack_out(toks, stacked))
 
     def _extract_prefix(self, cache, slot, *, p: int):
         """Slice a freshly prefilled slot's first `p` KV rows into a
@@ -495,14 +595,17 @@ class LLMEngine:
             v = llama.dequantize_kv(v, vsc, self.cfg.dtype)
         return k, v
 
-    def _decode(self, params, cache, lengths, last_tokens, temps, key,
+    def _decode(self, params, cache, lengths, last_tokens, samp, key,
                 active, lora=None, *, steps: int, span: int | None = None):
         """`steps` chained decode iterations inside ONE program (lax.scan):
         a K-token chunk costs one dispatch round-trip instead of K. Slots
         that finish (EOS) mid-chunk keep decoding on device; the host drops
         their surplus tokens, and the slot's next prefill resets its
         state. `span` statically bounds the attention window (length-aware
-        decode — see llama.decode_step)."""
+        decode — see llama.decode_step). Emits packed [steps, n_slots,
+        out_cols] rows (_pack_out)."""
+        slots = jnp.arange(self.n_slots)
+
         def body(carry, _):
             cache, lengths, last_tokens, key = carry
             aids = cache.get("aids")
@@ -512,18 +615,17 @@ class LLMEngine:
             if aids is not None:
                 kv["aids"] = aids  # decode never re-assigns slots
             cache = kv
-            key, sub = jax.random.split(key)
-            toks = self._pick(logits, temps, sub)
+            key, toks = self._choose(logits, samp, key, slots)
             lengths = lengths + active.astype(jnp.int32)
             last_tokens = jnp.where(active, toks, last_tokens)
-            return (cache, lengths, last_tokens, key), toks
+            return ((cache, lengths, last_tokens, key),
+                    self._pack_out(toks, logits))
 
-        (cache, lengths, last_tokens, key), toks = jax.lax.scan(
+        (cache, lengths, last_tokens, key), out = jax.lax.scan(
             body, (cache, lengths, last_tokens, key), None, length=steps)
-        # toks [steps, n_slots]
-        return cache, lengths, last_tokens, temps, key, toks
+        return cache, lengths, last_tokens, samp, key, out
 
-    def _spec_decode(self, params, cache, lengths, last_tokens, temps, key,
+    def _spec_decode(self, params, cache, lengths, last_tokens, samp, key,
                      active, lora=None, *, steps: int, span: int):
         """`steps` speculative verify rounds inside ONE program: each round
         records the pending token into the history buffer, drafts up to
@@ -532,12 +634,14 @@ class LLMEngine:
         argmax-matching prefix plus the model's own bonus token — 1..spec+1
         tokens per round per slot, at ~one decode-step's HBM cost. Greedy
         slots get EXACT greedy output (verification IS the greedy model);
-        sampled slots (temp>0) draft nothing and sample the bonus, i.e.
-        degrade to plain decode. Emits [steps, B, spec+2] int32 rows:
-        [count ++ tokens] per slot per round."""
+        sampled slots (temp>0) draft nothing and sample the bonus (through
+        the same top-k/top-p filters as plain decode), i.e. degrade to
+        plain decode. Emits [steps, B, 1 + (spec+1)*out_cols] f32 rows:
+        count ++ flattened _pack_out rows per emit position."""
         k_spec = self.spec
         rows = jnp.arange(self.n_slots)
         max_len = self.max_len
+        temps = samp[:, 0]
 
         def body(carry, _):
             cache, lengths, last_tokens, key = carry
@@ -565,10 +669,8 @@ class LLMEngine:
                             axis=1)
             bonus_greedy = jnp.take_along_axis(preds, n_acc[:, None],
                                                axis=1)[:, 0]
-            key, sub = jax.random.split(key)
-            bonus = jnp.where(temps > 0,
-                              self._pick(logits[:, 0], temps, sub),
-                              bonus_greedy)
+            key, bonus_sampled = self._choose(logits[:, 0], samp, key, rows)
+            bonus = jnp.where(temps > 0, bonus_sampled, bonus_greedy)
             jj = jnp.arange(k_spec + 1)[None]
             drafts_pad = jnp.concatenate(
                 [drafts, jnp.zeros((self.n_slots, 1), jnp.int32)], axis=1)
@@ -589,12 +691,18 @@ class LLMEngine:
                 kv["aids"] = aids
             new_len = lengths + emit_count
             new_last = jnp.where(active, bonus, last_tokens)
-            packed = jnp.concatenate([emit_count[:, None], emit], axis=1)
+            # emitted token j's distribution is logits[:, j] (the verify
+            # forward consumed tokens_in[:j+1] to produce it), so one
+            # _pack_out over [B, k+1] yields every emit's logprob row
+            out_rows = self._pack_out(emit, logits)  # [B, k+1, out_cols]
+            packed = jnp.concatenate(
+                [emit_count[:, None].astype(jnp.float32),
+                 out_rows.reshape(self.n_slots, -1)], axis=1)
             return (kv, new_len, new_last, key), packed
 
         (cache, lengths, last_tokens, key), out = jax.lax.scan(
             body, (cache, lengths, last_tokens, key), None, length=steps)
-        return cache, lengths, last_tokens, temps, key, out
+        return cache, lengths, last_tokens, samp, key, out
 
     def _spec_fn(self, steps: int, span: int | None = None):
         """Compiled speculative program per (rounds, attention span) — the
@@ -714,13 +822,43 @@ class LLMEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0,
-               adapter: str | None = None) -> int:
+               adapter: str | None = None,
+               top_k: int = 0, top_p: float = 1.0,
+               stop: Sequence[Sequence[int]] | None = None,
+               deadline_s: float | None = None) -> int:
+        """Queue one request. top_k (0 = off) / top_p (1.0 = off) filter
+        the sampled distribution inside the compiled programs (only when
+        temperature > 0 — greedy rows stay bit-exact argmax). `stop`:
+        token-id sequences; generation ends (finish_reason "stop") when
+        the output ends with one, and the matched sequence is excluded
+        from the result (OpenAI semantics; matching is host-side at chunk
+        boundaries, so at most one decode chunk of surplus is computed).
+        `deadline_s`: wall-clock budget; past it the request is cancelled
+        at the next chunk boundary (finish_reason "cancelled")."""
         import math
 
         # a NaN/inf/huge value would blow up later INSIDE the engine loop
         # thread (wave packing), killing serving for every request
         if not (math.isfinite(temperature) and 0 <= temperature <= 100):
             raise ValueError("temperature must be finite and in [0, 100]")
+        top_k = int(top_k)
+        if not 0 <= top_k <= self.sample_k_max:
+            raise ValueError(
+                f"top_k must be 0..{self.sample_k_max} (the engine's "
+                "static sample_k_max candidate window)")
+        top_p = float(top_p)
+        if not (math.isfinite(top_p) and 0 < top_p <= 1):
+            raise ValueError("top_p must be in (0, 1]")
+        stop_seqs: list[list[int]] = []
+        for ss in (stop or ()):
+            seq = [int(t) for t in ss]
+            if not seq or len(seq) > 64:
+                raise ValueError("each stop sequence must be 1..64 tokens")
+            stop_seqs.append(seq)
+        if len(stop_seqs) > 8:
+            raise ValueError("at most 8 stop sequences per request")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
         aid = 0
         if adapter is not None:
             if adapter not in self._adapter_idx:
@@ -752,12 +890,55 @@ class LLMEngine:
                                            time.monotonic())
             self._prompts[req_id] = list(prompt)
             self._results[req_id] = []
+            self._logprobs[req_id] = []
+            if self.logprobs_topk:
+                self._toplogprobs[req_id] = []
             self._max_new[req_id] = max_new_tokens
-            self._req_temps[req_id] = float(temperature)
+            self._req_samp[req_id] = (float(temperature), top_k, top_p)
+            if stop_seqs:
+                self._req_stop[req_id] = stop_seqs
+            if deadline_s is not None:
+                self._deadlines[req_id] = time.monotonic() + deadline_s
             if aid:
                 self._req_aids[req_id] = aid
             self._submit_t[req_id] = time.monotonic()
         return req_id
+
+    def cancel(self, req_id: int) -> bool:
+        """Ask the engine to drop a request; takes effect at the NEXT
+        chunk boundary (the engine thread applies pending cancellations at
+        the top of step(), so the freed slot is refillable by the very
+        next prefill wave). Thread-safe; callable from server/SSE threads.
+        Returns True if the request was still in flight."""
+        with self._submit_lock:
+            if req_id in self._done or req_id not in self._results:
+                return False
+            self._cancel_pending.append(req_id)
+            return True
+
+    def _apply_cancellations(self) -> None:
+        """Engine-thread only (top of step()): drain queued cancellations
+        and expired deadlines, free their scheduler state, and mark them
+        finished with reason "cancelled"."""
+        now = time.monotonic()
+        with self._submit_lock:
+            pending = self._cancel_pending
+            self._cancel_pending = []
+            pending += [r for r, dl in self._deadlines.items()
+                        if now >= dl and r not in self._done]
+            for rid in dict.fromkeys(pending):   # dedup, keep order
+                if rid in self._done or rid not in self._results:
+                    continue
+                self.scheduler.cancel(rid)
+                self._finish_reasons[rid] = "cancelled"
+                self._done.add(rid)
+                self._cancelled_count += 1
+                self._prompts.pop(rid, None)
+                self._max_new.pop(rid, None)
+                self._req_samp.pop(rid, None)
+                self._req_stop.pop(rid, None)
+                self._req_aids.pop(rid, None)
+                self._deadlines.pop(rid, None)
 
     def step(self) -> bool:
         """One engine iteration: a prefill wave or a batched decode.
@@ -768,7 +949,12 @@ class LLMEngine:
         token fetch, so a burst of n arrivals pays ~one program dispatch +
         one RTT instead of n of each. Exception: prompts longer than the
         largest bucket run as per-request chained dispatches (2 per chunk
-        boundary) — long-prompt TTFT scales with the chain length."""
+        boundary) — long-prompt TTFT scales with the chain length.
+
+        Chunk boundary = here: pending cancellations and expired deadlines
+        are applied first, so a freed slot is refillable by this very
+        step's prefill wave."""
+        self._apply_cancellations()
         with self._submit_lock:
             action = self.scheduler.next()
         if action is None:
@@ -826,13 +1012,14 @@ class LLMEngine:
             for wave, _ in dispatched[:len(groups)]:
                 for a in wave:
                     self._maybe_store_prefix(a)
-        for wave, toks in dispatched:
-            toks_np = np.asarray(toks)   # one fetch per wave
+        for wave, out in dispatched:
+            out_np = np.asarray(out)   # one fetch per wave [W, out_cols]
             for i, a in enumerate(wave):
                 # true length, not action.prompt_len: a chunked request's
                 # scheduler-visible length was clamped to the largest bucket
                 self._host_lengths[a.slot] = len(self._prompts[a.req_id])
-                self._record_token(a.req_id, a.slot, int(toks_np[i]),
+                tok, lp, top = self._unpack_out(out_np[i])
+                self._record_token(a.req_id, a.slot, tok, lp, top,
                                    first_token=True)
         return True
 
@@ -848,12 +1035,11 @@ class LLMEngine:
         prompt = self._prompts[action.req_id]
         plan = self._chunk_plan(len(prompt))
         slot = action.slot
-        temp = self._req_temps.get(action.req_id, 0.0)
+        tail = self._row_tail(action.req_id)
         big = self.buckets[-1]
         # prefix-cache composition: a banked largest-bucket prefix (the
         # shared-system-prompt case) replaces the first full prefill — the
         # chain starts at the first continuation instead
-        aid = self._req_aids.get(action.req_id, 0)
         big_key = self._prefix_key(action.req_id, prompt[:big])
         hit = None
         if self.prefix_cache_enabled:
@@ -863,12 +1049,11 @@ class LLMEngine:
                 self._prefix_hits += 1
         if hit is None:
             packed = self._pack_rows(1, big,
-                                     [(prompt[:big], slot, big, temp,
-                                       aid)])
-            (self.cache, self.lengths, self.last_tokens, self.temps,
-             self.rng_key, toks) = self._prefill_fn(big, 1)(
+                                     [(prompt[:big], slot, big) + tail])
+            (self.cache, self.lengths, self.last_tokens, self.samp,
+             self.rng_key, out) = self._prefill_fn(big, 1)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                self.temps, self.rng_key, self._put(packed),
+                self.samp, self.rng_key, self._put(packed),
                 *self._extra())
         done = big
         pending = None if hit is None else (hit["k"], hit["v"])
@@ -887,14 +1072,14 @@ class LLMEngine:
                 list(prompt[:done + chunk_len]), done, t)
             packed = self._pack_rows(1, t + (done if self.spec else 0),
                                      [(row_toks, slot,
-                                       done + chunk_len, temp, aid)])
-            (self.cache, self.lengths, self.last_tokens, self.temps,
-             self.rng_key, toks) = self._cont_fn(done, t, 1)(
+                                       done + chunk_len) + tail])
+            (self.cache, self.lengths, self.last_tokens, self.samp,
+             self.rng_key, out) = self._cont_fn(done, t, 1)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                self.temps, self.rng_key, self._put(packed), ek, ev,
+                self.samp, self.rng_key, self._put(packed), ek, ev,
                 *self._extra())
             done += chunk_len
-        return toks
+        return out
 
     def run_until_idle(self) -> None:
         while self.step():
@@ -920,10 +1105,10 @@ class LLMEngine:
                 packed[:, :2] = 1   # token + prompt_len floor
                 packed[:, -ex] = np.arange(width) % self.n_slots
                 packed[:, -ex + 1] = 1
-                (self.cache, self.lengths, self.last_tokens, self.temps,
+                (self.cache, self.lengths, self.last_tokens, self.samp,
                  self.rng_key, _) = self._prefill_fn(bucket, width)(
                     self.params, self.cache, self.lengths,
-                    self.last_tokens, self.temps, self.rng_key,
+                    self.last_tokens, self.samp, self.rng_key,
                     self._put(packed), *self._extra())
                 if width >= self.n_slots:
                     break
@@ -957,10 +1142,10 @@ class LLMEngine:
                     kw = jnp.concatenate([ek] * width, axis=1)
                     vw = jnp.concatenate([ev] * width, axis=1)
                     (self.cache, self.lengths, self.last_tokens,
-                     self.temps, self.rng_key, _) = \
+                     self.samp, self.rng_key, _) = \
                         self._cont_fn(p, t, width)(
                             self.params, self.cache, self.lengths,
-                            self.last_tokens, self.temps, self.rng_key,
+                            self.last_tokens, self.samp, self.rng_key,
                             self._put(packed), kw, vw, *self._extra())
                     if width >= self.n_slots:
                         break
@@ -977,25 +1162,25 @@ class LLMEngine:
             # chunk at every span; cold combos compile lazily on first use
             combos = ([(c, self.max_len) for c in chunks]
                       + [(chunks[-1], s) for s in spans[:-1]])
-        toks = None
+        out = None
         # spec mode dispatches _spec_fn instead of _decode_fn — warm THAT
         # menu (the plain decode menu would be dead weight)
         fn = self._spec_fn if self.spec else self._decode_fn
         for c, span in combos:
-            (self.cache, self.lengths, self.last_tokens, self.temps,
-             self.rng_key, toks) = fn(c, span)(
+            (self.cache, self.lengths, self.last_tokens, self.samp,
+             self.rng_key, out) = fn(c, span)(
                 self.params, self.cache, self.lengths, self.last_tokens,
-                self.temps, self.rng_key,
+                self.samp, self.rng_key,
                 self._put(np.zeros((self.n_slots,), bool)),
                 *self._extra())
-        float(np.asarray(toks).flat[0])  # sync: compile + execute finished
+        float(np.asarray(out).flat[0])  # sync: compile + execute finished
         # (axon-safe: a value fetch, not block_until_ready)
         # reset via _put, not zeros_like: under a mesh the reset arrays must
         # carry the same committed replicated sharding the programs were
         # traced with, or the first live request retraces (= recompiles)
         self.lengths = self._put(np.zeros((self.n_slots,), np.int32))
         self.last_tokens = self._put(np.zeros((self.n_slots,), np.int32))
-        self.temps = self._put(np.zeros((self.n_slots,), np.float32))
+        self.samp = self._put(np.zeros((self.n_slots, 3), np.float32))
         self._host_lengths[:] = 0
 
     def is_done(self, req_id: int) -> bool:
@@ -1006,10 +1191,31 @@ class LLMEngine:
             raise KeyError(f"request {req_id} not finished")
         return self._results[req_id]
 
+    def result_logprobs(self, req_id: int) -> list[float]:
+        """Per-token raw-model logprobs of result(req_id) (same length;
+        the OpenAI `logprobs` surface)."""
+        if req_id not in self._done:
+            raise KeyError(f"request {req_id} not finished")
+        return self._logprobs[req_id]
+
+    def result_top_logprobs(self, req_id: int) -> list[dict[int, float]]:
+        """Per-position top-N alternative logprobs ({token_id: logprob});
+        requires the engine to be built with logprobs_topk > 0."""
+        if not self.logprobs_topk:
+            raise ValueError("engine built with logprobs_topk=0")
+        if req_id not in self._done:
+            raise KeyError(f"request {req_id} not finished")
+        return self._toplogprobs[req_id]
+
     def partial_result(self, req_id: int) -> list[int]:
         """Tokens generated so far (streaming consumers poll this while
         the request runs). Snapshot copy: the engine thread appends."""
         return list(self._results.get(req_id, ()))
+
+    def partial_logprobs(self, req_id: int) -> list[float]:
+        """Logprobs of the tokens generated so far (streaming twin of
+        result_logprobs)."""
+        return list(self._logprobs.get(req_id, ()))
 
     def finish_reason(self, req_id: int) -> str:
         """Why a finished request stopped: "stop" (EOS) or "length"
@@ -1021,6 +1227,8 @@ class LLMEngine:
         after reading result(), or per-request dicts grow without bound."""
         self._done.discard(req_id)
         self._results.pop(req_id, None)
+        self._logprobs.pop(req_id, None)
+        self._toplogprobs.pop(req_id, None)
         self._submit_t.pop(req_id, None)
         self._first_token_t.pop(req_id, None)
         self._finish_reasons.pop(req_id, None)
@@ -1028,9 +1236,9 @@ class LLMEngine:
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
                  temperature: float = 0.0,
-                 adapter: str | None = None) -> list[int]:
+                 adapter: str | None = None, **kw) -> list[int]:
         rid = self.submit(prompt, max_new_tokens, temperature,
-                          adapter=adapter)
+                          adapter=adapter, **kw)
         while not self.is_done(rid):
             if not self.step():
                 raise RuntimeError("engine idle with request outstanding")
@@ -1046,7 +1254,8 @@ class LLMEngine:
         ttfts = list(self._ttft_window)  # survives release() of old requests
         s = self.scheduler.stats()
         out = {"queued": s.queued, "active": s.active,
-               "completed": s.completed, "rejected": s.rejected}
+               "completed": s.completed, "rejected": s.rejected,
+               "cancelled": self._cancelled_count}
         if self.prefix_cache_enabled:
             out["prefix_hits"] = self._prefix_hits
             out["prefix_misses"] = self._prefix_misses
@@ -1087,22 +1296,35 @@ class LLMEngine:
         full-prefill and continuation row layouts."""
         return max(1, round(temp * 1000)) if temp > 0 else 0
 
+    def _row_tail(self, req_id: int) -> tuple:
+        """The non-token row columns for one request: (temp, top_k, top_p
+        [, adapter_idx]) — ONE source for every wave-packing call site."""
+        tail = self._req_samp.get(req_id, (0.0, 0, 1.0))
+        if self.adapters is not None:
+            tail = tail + (self._req_aids.get(req_id, 0),)
+        return tail
+
     def _pack_rows(self, width: int, bucket: int, rows) -> np.ndarray:
-        """[tokens ++ slot ++ prompt_len ++ temp_milli(, aid)] per row,
-        padded up to `width` by repeating the last row (idempotent
-        duplicate writes). rows: list of (tokens, slot, prompt_len, temp
-        [, adapter_idx])."""
+        """[tokens ++ slot ++ prompt_len ++ temp_milli ++ top_k ++
+        top_p_micro(, aid)] per row, padded up to `width` by repeating the
+        last row (idempotent duplicate writes). rows: list of (tokens,
+        slot, prompt_len, temp, top_k, top_p[, adapter_idx])."""
         ex = self._row_extra
         padded = list(rows) + [rows[-1]] * (width - len(rows))
         packed = np.zeros((width, bucket + ex), np.int32)
         for i, row in enumerate(padded):
-            toks, slot, plen, temp = row[:4]
+            toks, slot, plen, temp, topk, topp = row[:6]
             packed[i, :len(toks)] = toks
             packed[i, -ex] = slot
             packed[i, -ex + 1] = plen
             packed[i, -ex + 2] = self._pack_temp(temp)
-            if ex == 4:
-                packed[i, -1] = row[4] if len(row) > 4 else 0
+            packed[i, -ex + 3] = int(topk)
+            # micro quantization with a floor of 1 (like _pack_temp): a
+            # sub-micro top_p must stay a maximal filter, not flip to OFF
+            packed[i, -ex + 4] = (1_000_000 if topp >= 1
+                                  else max(1, round(topp * 1e6)))
+            if ex == 6:
+                packed[i, -1] = row[6] if len(row) > 6 else 0
         return packed
 
     def _cont_row_tokens(self, prompt: list[int], p: int, t: int):
@@ -1125,18 +1347,17 @@ class LLMEngine:
             width *= 2
         padded = list(pairs) + [pairs[-1]] * (width - len(pairs))
         rows = [(self._cont_row_tokens(self._prompts[a.req_id], p, t),
-                 a.slot, a.prompt_len,
-                 self._req_temps.get(a.req_id, 0.0),
-                 self._req_aids.get(a.req_id, 0)) for a, _ in padded]
+                 a.slot, a.prompt_len) + self._row_tail(a.req_id)
+                for a, _ in padded]
         packed = self._pack_rows(width, t + (p if self.spec else 0), rows)
         k_prefix = jnp.concatenate([e["k"] for _, e in padded], axis=1)
         v_prefix = jnp.concatenate([e["v"] for _, e in padded], axis=1)
-        (self.cache, self.lengths, self.last_tokens, self.temps,
-         self.rng_key, toks) = self._cont_fn(p, t, width)(
+        (self.cache, self.lengths, self.last_tokens, self.samp,
+         self.rng_key, out) = self._cont_fn(p, t, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(packed),
+            self.samp, self.rng_key, self._put(packed),
             k_prefix, v_prefix, *self._extra())
-        return toks
+        return out
 
     def _store_prefix_entry(self, key: tuple, k, v) -> None:
         self._prefix_misses += 1
@@ -1169,17 +1390,16 @@ class LLMEngine:
         width = 1
         while width < len(wave):
             width *= 2
-        # one packed transfer: [tokens ++ slot ++ prompt_len ++ temp_milli]
-        # per row (a tunneled device pays ~an RTT per transfer)
-        rows = [(self._prompts[a.req_id], a.slot, a.prompt_len,
-                 self._req_temps.get(a.req_id, 0.0),
-                 self._req_aids.get(a.req_id, 0)) for a in wave]
+        # one packed transfer: [tokens ++ slot ++ prompt_len ++ sampling
+        # columns] per row (a tunneled device pays ~an RTT per transfer)
+        rows = [(self._prompts[a.req_id], a.slot, a.prompt_len)
+                + self._row_tail(a.req_id) for a in wave]
         packed = self._pack_rows(width, bucket, rows)
-        (self.cache, self.lengths, self.last_tokens, self.temps,
-         self.rng_key, next_toks) = self._prefill_fn(bucket, width)(
+        (self.cache, self.lengths, self.last_tokens, self.samp,
+         self.rng_key, out) = self._prefill_fn(bucket, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(packed), *self._extra())
-        return next_toks
+            self.samp, self.rng_key, self._put(packed), *self._extra())
+        return out
 
     def _do_decode(self) -> None:
         """Scan-fused decode: K steps execute inside ONE compiled program
@@ -1216,18 +1436,19 @@ class LLMEngine:
                           default=0))
         span = self._pick_span(longest + k)
 
-        (self.cache, self.lengths, self.last_tokens, self.temps,
-         self.rng_key, toks) = self._decode_fn(k, span)(
+        (self.cache, self.lengths, self.last_tokens, self.samp,
+         self.rng_key, out) = self._decode_fn(k, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(active), *self._extra())
-        toks_np = np.asarray(toks)   # [k, n_slots] — one fetch per chunk
+            self.samp, self.rng_key, self._put(active), *self._extra())
+        out_np = np.asarray(out)  # [k, n_slots, out_cols] — one fetch
         done_slots: set[int] = set()
-        for row in toks_np:
+        for row in out_np:
             for slot, req in enumerate(slot_req):
                 if req < 0 or slot in done_slots:
                     continue
                 self._host_lengths[slot] += 1
-                if self._record_token(req, slot, int(row[slot])):
+                tok, lp, top = self._unpack_out(row[slot])
+                if self._record_token(req, slot, tok, lp, top):
                     # finished mid-chunk: later tokens are garbage for this
                     # slot; drop them (its cache is reset by the next
                     # prefill into the slot). The local return value — not
@@ -1259,17 +1480,20 @@ class LLMEngine:
                            for s in range(self.n_slots) if active[s]),
                           default=0))
         span = self._pick_span(min(longest + steps * kp1, self.max_len))
-        (self.cache, self.lengths, self.last_tokens, self.temps,
+        (self.cache, self.lengths, self.last_tokens, self.samp,
          self.rng_key, out) = self._spec_fn(steps, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
-            self.temps, self.rng_key, self._put(active), *self._extra())
-        out_np = np.asarray(out)   # [steps, n_slots, spec+2]; one fetch
+            self.samp, self.rng_key, self._put(active), *self._extra())
+        # [steps, n_slots, 1 + (spec+1)*out_cols]; one fetch
+        out_np = np.asarray(out)
+        oc = self._out_cols
         done_slots: set[int] = set()
         for s in range(steps):
             for slot, req in enumerate(slot_req):
                 if req < 0 or slot in done_slots:
                     continue
                 cnt = int(out_np[s, slot, 0])
+                emits = out_np[s, slot, 1:].reshape(kp1, oc)
                 self._spec_verifies += 1
                 for j in range(cnt):
                     self._host_lengths[slot] += 1
@@ -1277,32 +1501,57 @@ class LLMEngine:
                     # a mid-round finish drops the surplus, and the
                     # tokens-per-round metric must not claim them
                     self._spec_tokens += 1
-                    if self._record_token(req, slot,
-                                          int(out_np[s, slot, 1 + j])):
+                    tok, lp, top = self._unpack_out(emits[j])
+                    if self._record_token(req, slot, tok, lp, top):
                         done_slots.add(slot)
                         break
 
     def _record_token(self, req_id: int, slot: int, token: int,
+                      lp: float = 0.0, top: dict[int, float] | None = None,
                       first_token: bool = False) -> bool:
         """Returns True when this token finished the request."""
         if first_token:
             now = time.monotonic()
             self._first_token_t[req_id] = now
             self._ttft_window.append(now - self._submit_t[req_id])
-        self._results[req_id].append(token)
+        res = self._results[req_id]
+        res.append(token)
+        self._logprobs[req_id].append(lp)
+        if top is not None and req_id in self._toplogprobs:
+            self._toplogprobs[req_id].append(top)
         hit_eos = self.eos_id is not None and token == self.eos_id
+        # stop-sequence suffix match (host-side, at chunk-boundary replay):
+        # the matched sequence is EXCLUDED from the result (OpenAI
+        # semantics) — matching over the accumulated output makes
+        # sequences spanning chunk boundaries work for free
+        hit_stop = 0
+        if not hit_eos:
+            for ss in self._req_stop.get(req_id, ()):
+                if len(res) >= len(ss) and res[-len(ss):] == ss:
+                    hit_stop = len(ss)
+                    break
+        if hit_stop:
+            del res[-hit_stop:]
+            del self._logprobs[req_id][-hit_stop:]
+            if req_id in self._toplogprobs:
+                del self._toplogprobs[req_id][-hit_stop:]
         # cache exhaustion: _host_lengths == KV rows written; the NEXT decode
         # writes at that index, which must stay < max_len (the host mirror
         # avoids a device fetch here)
         out_of_room = self._host_lengths[slot] >= self.max_len
-        freed = self.scheduler.token_done(slot, finished=hit_eos or out_of_room)
+        freed = self.scheduler.token_done(
+            slot, finished=hit_eos or bool(hit_stop) or out_of_room)
         if freed:
             # OpenAI finish_reason semantics: "stop" = the model chose to
-            # end (EOS); "length" = budget/cache truncation
-            self._finish_reasons[req_id] = "stop" if hit_eos else "length"
+            # end (EOS) or a stop sequence matched; "length" = budget/cache
+            # truncation
+            self._finish_reasons[req_id] = (
+                "stop" if (hit_eos or hit_stop) else "length")
             self._done.add(req_id)
             self._prompts.pop(req_id, None)
             self._max_new.pop(req_id, None)
-            self._req_temps.pop(req_id, None)
+            self._req_samp.pop(req_id, None)
+            self._req_stop.pop(req_id, None)
             self._req_aids.pop(req_id, None)
+            self._deadlines.pop(req_id, None)
         return freed

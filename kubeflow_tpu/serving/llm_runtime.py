@@ -48,6 +48,8 @@ class LLMModel(Model):
                  spec_ngram: int = 3,
                  lora: dict[str, Any] | None = None,
                  adapters: dict[str, Any] | None = None,
+                 logprobs_topk: int = 0,
+                 sample_k_max: int = 64,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -78,6 +80,8 @@ class LLMModel(Model):
         # an adapter ("adapter" in the payload), all share the base and
         # the continuous batch
         self._adapters_cfg = dict(adapters) if adapters else None
+        self._logprobs_topk = logprobs_topk
+        self._sample_k_max = sample_k_max
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -136,7 +140,9 @@ class LLMModel(Model):
                                  kv_quantize=self._kv_quantize,
                                  speculative=self._speculative,
                                  spec_ngram=self._spec_ngram,
-                                 adapters=self._load_adapters(cfg))
+                                 adapters=self._load_adapters(cfg),
+                                 logprobs_topk=self._logprobs_topk,
+                                 sample_k_max=self._sample_k_max)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()
@@ -274,6 +280,30 @@ class LLMModel(Model):
             return out
         return {"output_tokens": self._wait(self._submit(payload))}
 
+    def _encode_stops(self, stop: Any) -> list[list[int]]:
+        """OpenAI `stop` (a string, a list of strings, or token-id lists)
+        → engine stop sequences. Strings are tokenizer-encoded; for a
+        byte/char tokenizer this is exact, for BPE a stop string spanning
+        merge boundaries may not match token-aligned output (documented —
+        the buffered path additionally truncates decoded TEXT)."""
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list):
+            raise ValueError("stop must be a string or a list")
+        out: list[list[int]] = []
+        for s in stop:
+            if isinstance(s, str):
+                ids = self.tokenizer.encode(s)
+                if ids:
+                    out.append(list(ids))
+            elif isinstance(s, list):
+                out.append([int(t) for t in s])
+            else:
+                raise ValueError("stop entries must be strings or id lists")
+        return out
+
     def _submit(self, payload: Any) -> int:
         if not isinstance(payload, dict) or "prompt_tokens" not in payload:
             raise ValueError(
@@ -283,8 +313,19 @@ class LLMModel(Model):
         max_new = int(payload.get("max_new_tokens", 32))
         temperature = float(payload.get("temperature", 0.0))
         adapter = payload.get("adapter")
-        rid = self._engine.submit(prompt, max_new, temperature,
-                                  adapter=adapter)
+        # engine-enforced deadline: even an abandoned/never-drained request
+        # frees its decode slot once its wall budget passes. The implicit
+        # backstop sits ABOVE timeout_s so the waiter's TimeoutError (the
+        # client-visible contract) always fires first — a request must not
+        # nondeterministically come back 200/"cancelled" instead
+        deadline = float(payload.get("deadline_s")
+                         or (self._timeout_s + 10.0))
+        rid = self._engine.submit(
+            prompt, max_new, temperature, adapter=adapter,
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
+            stop=self._encode_stops(payload.get("stop")),
+            deadline_s=deadline)
         self._wake.set()
         return rid
 
@@ -299,16 +340,26 @@ class LLMModel(Model):
                 f"generation timed out after {self._timeout_s}s")
 
     def stream(self, payload: Any, on_finish=None):
-        """Token-id stream for the SSE-completions backend. Submits
-        EAGERLY (not a generator itself) so unservable requests —
+        """(token_id, logprob) stream for the SSE-completions backend.
+        Submits EAGERLY (not a generator itself) so unservable requests —
         PromptTooLong, QueueFull — raise before the caller commits an
         HTTP status; returns the generator that drains the engine.
         `on_finish(reason)` fires before release with the OpenAI
-        finish_reason ("stop" | "length")."""
-        rid = self._submit(payload)
-        return self._stream_from(rid, on_finish)
+        finish_reason ("stop" | "length" | "cancelled").
 
-    def _stream_from(self, rid: int, on_finish=None):
+        With stop sequences, the last max(len(stop))-many tokens are held
+        back until the request finishes: a stop match truncates the
+        result, and held-back tokens are the only ones a match can
+        remove — so the stream never emits text the buffered path would
+        have trimmed."""
+        stops = self._encode_stops(payload.get("stop"))
+        if stops:   # encode ONCE; token-id lists pass through _submit's
+            payload = dict(payload, stop=stops)   # _encode_stops unchanged
+        rid = self._submit(payload)
+        hold = max((len(s) for s in stops), default=0)
+        return self._stream_from(rid, on_finish, hold)
+
+    def _stream_from(self, rid: int, on_finish=None, hold: int = 0):
         deadline = time.monotonic() + self._timeout_s
         sent = 0
         try:
@@ -316,38 +367,58 @@ class LLMModel(Model):
                 done = self._engine.is_done(rid)   # BEFORE the drain: a
                 # token landing between drain and check is caught next loop
                 toks = self._engine.partial_result(rid)
-                while sent < len(toks):
-                    yield toks[sent]
+                lps = self._engine.partial_logprobs(rid)
+                limit = len(toks) if done else max(0, len(toks) - hold)
+                if not done:
+                    # the engine thread appends token-then-logprob; a
+                    # snapshot between the two would otherwise emit a
+                    # fabricated 0.0 — hold that token one poll instead
+                    limit = min(limit, len(lps))
+                while sent < limit:
+                    yield toks[sent], (lps[sent] if sent < len(lps)
+                                       else 0.0)
                     sent += 1
                 if done:
                     break
                 self._check_alive(deadline)
                 time.sleep(0.001)
         except BaseException:
+            # a dropped SSE client (GeneratorExit via close()), a timeout,
+            # or a dead loop: CANCEL so the decode slot frees at the next
+            # chunk boundary instead of burning to max_new_tokens
+            self._engine.cancel(rid)
             self._abandoned.add(rid)
             raise
         if on_finish is not None:
             on_finish(self._engine.finish_reason(rid))
         self._engine.release(rid)
 
-    def complete(self, payload: Any) -> tuple[list[int], str]:
-        """Buffered generation returning (tokens, finish_reason)."""
+    def complete(self, payload: Any) -> dict[str, Any]:
+        """Buffered generation: {"token_ids", "finish_reason",
+        "logprobs" (per-token raw-model logprobs) and, when the engine is
+        built with logprobs_topk > 0, "top_logprobs"}."""
         rid = self._submit(payload)
-        return self._wait(rid, with_reason=True)
+        return self._wait(rid, full=True)
 
-    def _wait(self, rid: int, with_reason: bool = False):
+    def _wait(self, rid: int, full: bool = False):
         deadline = time.monotonic() + self._timeout_s
         try:
             while not self._engine.is_done(rid):
                 self._check_alive(deadline)
                 time.sleep(0.001)
         except BaseException:
+            # free the slot promptly (deadline/error): see _stream_from
+            self._engine.cancel(rid)
             self._abandoned.add(rid)  # engine thread releases it when done
             raise
         out = self._engine.result(rid)
         reason = self._engine.finish_reason(rid)
+        result = {"token_ids": out, "finish_reason": reason,
+                  "logprobs": self._engine.result_logprobs(rid)}
+        if self._logprobs_topk:
+            result["top_logprobs"] = self._engine.result_top_logprobs(rid)
         self._engine.release(rid)  # long-lived server: drop request state
-        return (out, reason) if with_reason else out
+        return result if full else out
 
     def metrics(self) -> dict[str, Any]:
         return self._engine.metrics() if self._engine else {}

@@ -72,6 +72,8 @@ class NativeScheduler:
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         self._lib.cbs_slot_request.restype = ctypes.c_int64
         self._lib.cbs_slot_request.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        self._lib.cbs_cancel.restype = ctypes.c_int32
+        self._lib.cbs_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         self._lib.cbs_stats.argtypes = [ctypes.c_void_p] + \
             [ctypes.POINTER(ctypes.c_int64)] * 4
 
@@ -113,6 +115,13 @@ class NativeScheduler:
 
     def slot_request(self, slot: int) -> int:
         return int(self._lib.cbs_slot_request(self._h, slot))
+
+    def cancel(self, req_id: int) -> str | None:
+        """Remove a request wherever it lives: "queued" (pulled from the
+        queue before prefill), "active" (slot freed), None (unknown /
+        already finished)."""
+        r = self._lib.cbs_cancel(self._h, req_id)
+        return {1: "queued", 2: "active"}.get(int(r))
 
     def stats(self) -> Stats:
         vals = [ctypes.c_int64() for _ in range(4)]
@@ -191,6 +200,22 @@ class PyScheduler:
         with self._mu:
             sl = self._slots[slot]
             return sl.req_id if sl.active else -1
+
+    def cancel(self, req_id: int) -> str | None:
+        """Same contract as NativeScheduler.cancel (the differential-test
+        oracle): "queued" | "active" | None. Cancelled requests count
+        neither as completed nor rejected — the engine keeps the metric."""
+        with self._mu:
+            for i, (rid, _plen, _mx) in enumerate(self._queue):
+                if rid == req_id:
+                    del self._queue[i]
+                    return "queued"
+            for sl in self._slots:
+                if sl.active and sl.req_id == req_id:
+                    sl.active = False
+                    sl.req_id = -1
+                    return "active"
+            return None
 
     def stats(self) -> Stats:
         with self._mu:

@@ -232,8 +232,56 @@ class ModelServer:
             # json.loads happily parses NaN/Infinity; they must not reach
             # the engine thread
             raise ProtocolError("temperature must be finite and in [0, 100]")
-        return m, {"prompt_tokens": ids, "max_new_tokens": max_new,
-                   "temperature": temperature}
+        payload: dict[str, Any] = {"prompt_tokens": ids,
+                                   "max_new_tokens": max_new,
+                                   "temperature": temperature}
+        # -- sampling parity fields (⊘ kserve huggingfaceserver):
+        # top_k/top_p run INSIDE the engine's compiled programs; stop is
+        # matched host-side at chunk boundaries; logprobs=true returns
+        # per-token logprobs, logprobs=N additionally the top-N
+        # alternatives (N bounded by the engine's logprobs_topk build knob)
+        try:
+            top_k = int(body.get("top_k", 0))
+        except (TypeError, ValueError):
+            raise ProtocolError("top_k must be an int") from None
+        kmax = getattr(m, "_sample_k_max", 64)
+        if not 0 <= top_k <= kmax:
+            raise ProtocolError(f"top_k must be 0..{kmax}")
+        try:
+            top_p = float(body.get("top_p", 1.0))
+        except (TypeError, ValueError):
+            raise ProtocolError("top_p must be a number") from None
+        if not (math.isfinite(top_p) and 0 < top_p <= 1):
+            raise ProtocolError("top_p must be in (0, 1]")
+        stop = body.get("stop")
+        if stop is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            if (not isinstance(stop, list) or len(stop) > 8
+                    or not all(isinstance(s, str) and s for s in stop)):
+                raise ProtocolError(
+                    "stop must be a non-empty string or a list of up to 8")
+            payload["stop"] = stop
+        lp_req = body.get("logprobs", False)
+        if lp_req is not None and not isinstance(lp_req, (bool, int)):
+            raise ProtocolError("logprobs must be a bool or an int")
+        lp_n = int(lp_req or 0) if not isinstance(lp_req, bool) else 0
+        if lp_n < 0 or lp_n > getattr(m, "_logprobs_topk", 0):
+            raise ProtocolError(
+                f"logprobs top-N must be 0..{getattr(m, '_logprobs_topk', 0)}"
+                " (the engine's logprobs_topk build setting)")
+        payload["want_logprobs"] = bool(lp_req)
+        payload["logprobs_n"] = lp_n
+        payload["top_k"] = top_k
+        payload["top_p"] = top_p
+        if body.get("timeout") is not None:
+            try:
+                payload["deadline_s"] = float(body["timeout"])
+            except (TypeError, ValueError):
+                raise ProtocolError("timeout must be a number") from None
+            if payload["deadline_s"] <= 0:
+                raise ProtocolError("timeout must be positive")
+        return m, payload
 
     @staticmethod
     def _completion_error(e: Exception) -> tuple[int, dict[str, Any]]:
@@ -258,13 +306,25 @@ class ModelServer:
         t0 = time.perf_counter()
         try:
             m, payload = self._completion_request(body, chat)
-            tokens, reason = m.complete(payload)
+            result = m.complete(payload)
         except self._completion_exceptions() as e:
             return self._completion_error(e)
         self._observe(m.name, "completions", time.perf_counter() - t0)
+        tokens, reason = result["token_ids"], result["finish_reason"]
         text = m.tokenizer.decode(tokens)
         choice: dict[str, Any] = {"index": 0, "token_ids": tokens,
                                   "finish_reason": reason}
+        if payload.get("want_logprobs"):
+            lp: dict[str, Any] = {"token_ids": tokens,
+                                  "token_logprobs": result["logprobs"]}
+            n = payload.get("logprobs_n", 0)
+            if n:
+                # JSON object keys are strings; ids stay exact as strings
+                lp["top_logprobs"] = [
+                    {str(t): v for t, v in sorted(
+                        d.items(), key=lambda kv: -kv[1])[:n]}
+                    for d in result["top_logprobs"]]
+            choice["logprobs"] = lp
         if chat:
             choice["message"] = {"role": "assistant", "content": text}
         else:
@@ -302,9 +362,11 @@ class ModelServer:
         handler.close_connection = True
         decoder = StreamDecoder(m.tokenizer)
         first = [True]
+        want_lp = payload.get("want_logprobs")
 
         def chunk_of(text: str, token_id: int | None = None,
-                     reason: str | None = None) -> bytes:
+                     reason: str | None = None,
+                     logprob: float | None = None) -> bytes:
             choice: dict[str, Any] = {"index": 0, "finish_reason": reason}
             if chat:
                 choice["delta"] = ({"role": "assistant", "content": text}
@@ -314,6 +376,8 @@ class ModelServer:
                 choice["text"] = text
             if token_id is not None:
                 choice["token_id"] = token_id
+            if logprob is not None:
+                choice["logprob"] = logprob
             return ("data: " + json.dumps(
                 {"object": ("chat.completion.chunk" if chat
                             else "text_completion.chunk"),
@@ -322,10 +386,17 @@ class ModelServer:
         try:   # everything after the headers: a disconnect anywhere here
                # must not fall back to do_POST's JSON 500 on this socket
             try:
-                for tok in token_iter:
-                    handler.wfile.write(chunk_of(decoder.push(tok),
-                                                 token_id=int(tok)))
+                for tok, lp in token_iter:
+                    handler.wfile.write(chunk_of(
+                        decoder.push(tok), token_id=int(tok),
+                        logprob=(float(lp) if want_lp else None)))
                     handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the SOCKET died, not the engine: this must reach the
+                # disconnect path below — the generic handler would "write"
+                # an error chunk into the dead socket's userspace buffer,
+                # appear to succeed, and abandon the request unc ancelled
+                raise
             except Exception as e:
                 handler.wfile.write(
                     f"data: {json.dumps({'error': str(e)})}\n\n".encode())
@@ -334,9 +405,17 @@ class ModelServer:
                 reason = finish[0] if finish else "length"
                 handler.wfile.write(chunk_of(tail, reason=reason))
             handler.wfile.write(b"data: [DONE]\n\n")
+            handler.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
-            return   # client hung up mid-stream; the generator's abandon
-                     # path (GeneratorExit) cleans up the engine request
+            return   # client hung up mid-stream; the finally CLOSES the
+                     # generator, whose GeneratorExit path cancels the
+                     # engine request — the decode slot frees at the next
+                     # chunk boundary instead of burning to max_new_tokens
+                     # (SURVEY §2.6 Triton-class cancellation)
+        finally:
+            # no-op when the stream drained or errored to completion;
+            # the live-generator case (disconnect) cancels + releases
+            token_iter.close()
         self._observe(m.name, "completions", time.perf_counter() - t0)
 
     # -- dataplanes -----------------------------------------------------------

@@ -151,6 +151,30 @@ int32_t cbs_token_done(void* h, int32_t slot, int32_t finished) {
   return 0;
 }
 
+// Cancel a request wherever it lives. Returns 2 if an active slot was
+// freed, 1 if the request was removed from the queue, 0 if unknown (never
+// submitted, already finished, or already cancelled). Cancelled requests
+// count neither as completed nor rejected — the engine layer keeps the
+// cancellation metric (one place, same for both scheduler twins).
+int32_t cbs_cancel(void* h, int64_t req_id) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  for (auto it = s->queue.begin(); it != s->queue.end(); ++it) {
+    if (it->id == req_id) {
+      s->queue.erase(it);
+      return 1;
+    }
+  }
+  for (Slot& sl : s->slots) {
+    if (sl.active && sl.req_id == req_id) {
+      sl.active = false;
+      sl.req_id = -1;
+      return 2;
+    }
+  }
+  return 0;
+}
+
 // Which request occupies a slot (-1 if empty) — lets the engine map decode
 // outputs back to requests without mirroring slot state in Python.
 int64_t cbs_slot_request(void* h, int32_t slot) {

@@ -1,0 +1,376 @@
+"""Sampling parity (top-k / top-p / stop sequences / logprobs) and request
+cancellation through the serving dataplane.
+
+Reference anchors (SURVEY.md §2.4 Python serving SDK / huggingfaceserver
+row — OpenAI-surface sampling fields; §2.6 Triton-class runtime row —
+request cancellation). The filters run INSIDE the engine's compiled
+programs (static shapes, lax.top_k over a bounded candidate window);
+stop matching and cancellation act host-side at chunk boundaries.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.llm import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq_len=64,
+                            attention_impl="xla", dtype=jnp.float32,
+                            remat=False)
+    params = llama.init(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def _ref_logits_seq(params, cfg, prompt, gen):
+    """Reference per-step next-token logits for prompt + generated tokens:
+    logits[i] is the distribution that produced gen[i]."""
+    out = []
+    toks = list(prompt)
+    for t in gen:
+        logits = llama.apply(params, jnp.asarray([toks], jnp.int32), cfg)
+        out.append(np.asarray(logits[0, -1], np.float32))
+        toks.append(int(t))
+    return out
+
+
+def _ref_generate(params, cfg, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = llama.apply(params, jnp.asarray([toks], jnp.int32), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("buckets", (8, 16))
+    return LLMEngine(params, cfg, **kw)
+
+
+# -- top-k / top-p ----------------------------------------------------------
+
+def test_default_filters_byte_match_unfiltered_path(tiny):
+    """top_p=1 / top_k=0 must take the exact unfiltered sampling path:
+    same seed, same order → identical tokens as a plain temperature
+    request."""
+    params, cfg = tiny
+    prompt = [3, 17, 42]
+    a = _engine(params, cfg, sample_seed=11)
+    ra = a.submit(prompt, 6, temperature=1.1)
+    a.run_until_idle()
+    b = _engine(params, cfg, sample_seed=11)
+    rb = b.submit(prompt, 6, temperature=1.1, top_k=0, top_p=1.0)
+    b.run_until_idle()
+    assert a.result(ra) == b.result(rb)
+
+
+def test_top_k1_is_greedy(tiny):
+    """top_k=1 collapses sampling to argmax regardless of temperature."""
+    params, cfg = tiny
+    prompt = [5, 9, 2, 44]
+    eng = _engine(params, cfg, sample_seed=3)
+    rid = eng.submit(prompt, 6, temperature=2.0, top_k=1)
+    eng.run_until_idle()
+    assert eng.result(rid) == _ref_generate(params, cfg, prompt, 6)
+
+
+def test_tiny_top_p_is_greedy(tiny):
+    """A top_p smaller than any single-token mass keeps only the argmax."""
+    params, cfg = tiny
+    prompt = [5, 9, 2, 44]
+    eng = _engine(params, cfg, sample_seed=3)
+    rid = eng.submit(prompt, 6, temperature=2.0, top_p=1e-9)
+    eng.run_until_idle()
+    assert eng.result(rid) == _ref_generate(params, cfg, prompt, 6)
+
+
+def test_top_k_restricts_support(tiny):
+    """Every token sampled under top_k=4 at high temperature lies in the
+    reference top-4 of its step's distribution (conditioned on the
+    engine's own sampled prefix)."""
+    params, cfg = tiny
+    prompt = [7, 7, 7]
+    eng = _engine(params, cfg, sample_seed=1)
+    rid = eng.submit(prompt, 8, temperature=5.0, top_k=4)
+    eng.run_until_idle()
+    gen = eng.result(rid)
+    assert len(gen) == 8
+    for logits, tok in zip(_ref_logits_seq(params, cfg, prompt, gen), gen):
+        top4 = np.argsort(logits)[-4:]
+        assert tok in top4, (tok, top4)
+
+
+def test_top_p_restricts_support(tiny):
+    """Every token sampled under top_p=0.5 lies in the smallest prefix of
+    the sorted (temperature-scaled) distribution reaching mass 0.5."""
+    params, cfg = tiny
+    prompt = [8, 1, 30]
+    temp = 3.0
+    eng = _engine(params, cfg, sample_seed=2)
+    rid = eng.submit(prompt, 8, temperature=temp, top_p=0.5)
+    eng.run_until_idle()
+    gen = eng.result(rid)
+    for logits, tok in zip(_ref_logits_seq(params, cfg, prompt, gen), gen):
+        p = np.exp(logits / temp - np.max(logits / temp))
+        p /= p.sum()
+        order = np.argsort(-p)
+        cum = np.cumsum(p[order])
+        nucleus = set(order[:int(np.searchsorted(cum, 0.5)) + 1].tolist())
+        assert tok in nucleus, (tok, sorted(nucleus))
+
+
+def test_submit_validates_sampling_params(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg)
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, top_k=-1)
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, top_k=eng.sample_k_max + 1)
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, top_p=0.0)
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, top_p=1.5)
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, stop=[[]])
+    with pytest.raises(ValueError):
+        eng.submit([1], 2, deadline_s=0)
+
+
+# -- logprobs ---------------------------------------------------------------
+
+def test_greedy_logprobs_match_reference(tiny):
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9]
+    eng = _engine(params, cfg)
+    rid = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    gen = eng.result(rid)
+    lps = eng.result_logprobs(rid)
+    assert len(lps) == len(gen)
+    for logits, tok, lp in zip(
+            _ref_logits_seq(params, cfg, prompt, gen), gen, lps):
+        ref = logits - np.log(np.sum(np.exp(logits - np.max(logits)))) \
+            - np.max(logits)
+        assert abs(lp - ref[tok]) < 1e-3, (lp, ref[tok])
+
+
+def test_top_logprobs_surface(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg, logprobs_topk=3)
+    rid = eng.submit([4, 40, 4], 4)
+    eng.run_until_idle()
+    gen = eng.result(rid)
+    lps = eng.result_logprobs(rid)
+    tops = eng.result_top_logprobs(rid)
+    assert len(tops) == len(gen)
+    for tok, lp, top in zip(gen, lps, tops):
+        assert len(top) == 3
+        # greedy: the chosen token IS the top-1 alternative, same logprob
+        assert max(top, key=top.get) == tok
+        assert abs(top[tok] - lp) < 1e-5
+
+
+def test_top_logprobs_requires_engine_knob(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg)
+    rid = eng.submit([4], 2)
+    eng.run_until_idle()
+    with pytest.raises(ValueError):
+        eng.result_top_logprobs(rid)
+
+
+# -- stop sequences ---------------------------------------------------------
+
+def test_stop_sequence_truncates_and_reports_stop(tiny):
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9, 55]
+    greedy = _ref_generate(params, cfg, prompt, 6)
+    eng = _engine(params, cfg)
+    rid = eng.submit(prompt, 6, stop=[greedy[2:4]])
+    eng.run_until_idle()
+    assert eng.result(rid) == greedy[:2]
+    assert eng.finish_reason(rid) == "stop"
+    assert len(eng.result_logprobs(rid)) == 2
+
+
+def test_stop_sequence_spanning_chunk_boundary(tiny):
+    """decode_chunk=2 with a 3-token stop: the match spans two chunks and
+    must still truncate exactly (host-side suffix matching accumulates
+    across chunk replays)."""
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9, 55]
+    greedy = _ref_generate(params, cfg, prompt, 8)
+    eng = _engine(params, cfg, decode_chunk=2)
+    rid = eng.submit(prompt, 8, stop=[greedy[1:4]])
+    eng.run_until_idle()
+    assert eng.result(rid) == greedy[:1]
+    assert eng.finish_reason(rid) == "stop"
+
+
+def test_stop_composes_with_spec_decode(tiny):
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9, 55]
+    greedy = _ref_generate(params, cfg, prompt, 8)
+    eng = _engine(params, cfg, speculative=3, spec_ngram=2)
+    rid = eng.submit(prompt, 8, stop=[greedy[3:5]])
+    eng.run_until_idle()
+    assert eng.result(rid) == greedy[:3]
+    assert eng.finish_reason(rid) == "stop"
+
+
+def test_sampling_composes_with_spec_decode(tiny):
+    """Spec engine + top_k=1 at temperature>0: sampled slots draft
+    nothing, and the filtered bonus equals greedy — output must equal the
+    plain greedy sequence exactly."""
+    params, cfg = tiny
+    prompt = [5, 9, 2, 44]
+    eng = _engine(params, cfg, speculative=3, spec_ngram=2, sample_seed=4)
+    rid = eng.submit(prompt, 6, temperature=1.7, top_k=1)
+    eng.run_until_idle()
+    assert eng.result(rid) == _ref_generate(params, cfg, prompt, 6)
+
+
+@pytest.mark.slow
+def test_sampling_composes_with_prefix_cache(tiny):
+    """A prefix-cache continuation wave carries the sampling columns too:
+    the second (cache-hit) request with top_k=1 still greedy-matches."""
+    params, cfg = tiny
+    prompt = list(range(1, 13))   # 12 tokens: 8-prefix + tail
+    greedy = _ref_generate(params, cfg, prompt, 5)
+    eng = _engine(params, cfg, prefix_cache=True)
+    r1 = eng.submit(prompt, 5)
+    eng.run_until_idle()
+    assert eng.result(r1) == greedy
+    r2 = eng.submit(prompt, 5, temperature=2.0, top_k=1)
+    eng.run_until_idle()
+    assert eng.metrics()["prefix_hits"] >= 1
+    assert eng.result(r2) == greedy
+
+
+# -- cancellation -----------------------------------------------------------
+
+def test_cancel_mid_decode_frees_slot_for_queued_request(tiny):
+    """n_slots=1: cancelling the active request at a chunk boundary hands
+    its slot to the queued one, which then completes normally."""
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1, decode_chunk=2)
+    r1 = eng.submit([3, 17, 42], 30)
+    r2 = eng.submit([5, 9, 2], 4)
+    assert eng.step()          # prefill r1
+    assert eng.step()          # one decode chunk for r1
+    assert not eng.is_done(r1)
+    assert eng.cancel(r1)
+    assert eng.step()          # boundary: r1 dropped, r2 prefills
+    assert eng.is_done(r1)
+    assert eng.finish_reason(r1) == "cancelled"
+    assert len(eng.partial_result(r1)) >= 1   # partials preserved
+    eng.run_until_idle()
+    assert eng.is_done(r2)
+    assert eng.result(r2) == _ref_generate(params, cfg, [5, 9, 2], 4)
+    assert eng.metrics()["cancelled"] == 1
+
+
+def test_cancel_queued_request_never_runs(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1)
+    r1 = eng.submit([3, 17, 42], 4)
+    r2 = eng.submit([5, 9, 2], 4)
+    assert eng.cancel(r2)
+    eng.run_until_idle()
+    assert eng.is_done(r1) and eng.is_done(r2)
+    assert eng.finish_reason(r2) == "cancelled"
+    assert eng.partial_result(r2) == []
+    assert eng.result(r1) == _ref_generate(params, cfg, [3, 17, 42], 4)
+
+
+def test_cancel_finished_request_is_noop(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg)
+    rid = eng.submit([1, 2, 3], 2)
+    eng.run_until_idle()
+    assert not eng.cancel(rid)
+    assert eng.finish_reason(rid) in ("stop", "length")
+    assert eng.metrics()["cancelled"] == 0
+
+
+def test_deadline_cancels_at_chunk_boundary(tiny):
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1, decode_chunk=2)
+    rid = eng.submit([3, 17, 42], 500, deadline_s=0.01)
+    assert eng.step()          # prefill
+    time.sleep(0.05)
+    eng.run_until_idle()       # next boundary applies the expired deadline
+    assert eng.is_done(rid)
+    assert eng.finish_reason(rid) == "cancelled"
+    assert eng.metrics()["cancelled"] == 1
+
+
+@pytest.mark.slow
+def test_dropped_stream_client_releases_slot(tiny):
+    """HTTP SSE disconnect → generator close → engine.cancel: the slot
+    frees within a chunk and the engine keeps serving others."""
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.server import ModelServer
+    import http.client
+
+    _, cfg = tiny
+    # a long cache + budget: the stream must still be mid-flight when the
+    # client drops, so the release is attributable to cancellation
+    m = LLMModel("llm", model={k: getattr(cfg, k) for k in
+                               ("vocab_size", "d_model", "n_layers",
+                                "n_heads", "n_kv_heads", "d_ff",
+                                "max_seq_len", "attention_impl", "remat")},
+                 n_slots=1, max_len=2048, buckets=(8,), seed=0)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    try:
+        import json as _json
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        conn.request("POST", "/openai/v1/completions",
+                     body=_json.dumps({"model": "llm", "prompt": "Hi",
+                                       "max_tokens": 2000, "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read(40)          # a first chunk arrived
+        # drop the client mid-stream. BOTH closes matter: the response
+        # object holds its own reference to the socket (makefile), so
+        # conn.close() alone leaves the TCP connection open and the
+        # server would just block on a full send buffer
+        resp.close()
+        conn.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            mm = m.metrics()
+            if mm.get("cancelled", 0) >= 1 and mm.get("active", 1) == 0:
+                break
+            time.sleep(0.05)
+        mm = m.metrics()
+        assert mm["cancelled"] >= 1, mm
+        assert mm["active"] == 0, mm
+        # the freed slot still serves: a fresh buffered request completes
+        conn2 = http.client.HTTPConnection("127.0.0.1", server.port,
+                                           timeout=60)
+        conn2.request("POST", "/openai/v1/completions",
+                      body=_json.dumps({"model": "llm", "prompt": "Yo",
+                                        "max_tokens": 3}),
+                      headers={"Content-Type": "application/json"})
+        out = _json.loads(conn2.getresponse().read())
+        conn2.close()
+        assert len(out["choices"][0]["token_ids"]) == 3
+    finally:
+        server.stop()
+        m.unload()
