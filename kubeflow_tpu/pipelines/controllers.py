@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 from typing import Any
@@ -334,14 +335,30 @@ class PipelineRunController(Controller):
         return params
 
     @staticmethod
+    def _loops(tir: dict[str, Any]) -> list[dict[str, Any]]:
+        """Loop stack of a task IR, outermost first. Accepts both the
+        "loops" list (nested-capable compiler) and the legacy singular
+        "loop" key from specs stored by older compiler versions."""
+        if tir.get("loops"):
+            return tir["loops"]
+        return [tir["loop"]] if tir.get("loop") else []
+
+    @staticmethod
     def _instance_ctx(tir: dict[str, Any], key: str,
                       item: Any) -> dict[str, Any]:
-        loop = tir.get("loop")
-        index = None
-        if loop and "[" in key:
-            index = int(key[key.index("[") + 1:-1])
-        return {"group": loop["group"] if loop else None,
-                "index": index, "item": item}
+        """Instance context: parallel lists of the enclosing loop groups,
+        this instance's index at each level (parsed from the composed key
+        `task[i][j]...`), and the per-level loop items (`item` is the
+        tuple _instances built, or None outside loops)."""
+        loops = PipelineRunController._loops(tir)
+        return {"groups": [l["group"] for l in loops],
+                "indices": [int(i) for i in re.findall(r"\[(\d+)\]", key)],
+                "items": list(item) if isinstance(item, tuple) else
+                ([item] if loops else [])}
+
+    @staticmethod
+    def _instance_key(base: str, indices: list[int]) -> str:
+        return base + "".join(f"[{i}]" for i in indices)
 
     def _resolve_ref(self, run: dict[str, Any], spec: dict[str, Any],
                      binding: dict[str, Any], tasks: dict[str, Any],
@@ -357,17 +374,26 @@ class PipelineRunController(Controller):
                 raise ValueError(f"pipeline parameter {pname!r} not set")
             return params[pname]
         if "loopItem" in binding:
-            if binding["loopItem"] != ctx.get("group"):
+            groups = ctx.get("groups", [])
+            if binding["loopItem"] not in groups:
                 raise ValueError("loop item referenced outside its loop")
-            return ctx["item"]
+            return ctx["items"][groups.index(binding["loopItem"])]
         to = binding["taskOutput"]
         src = to["task"]
         src_tir = spec["root"]["dag"]["tasks"][src]
+        src_loops = self._loops(src_tir)
         src_key = src
-        if (src_tir.get("loop")
-                and src_tir["loop"]["group"] == ctx.get("group")
-                and ctx.get("index") is not None):
-            src_key = f"{src}[{ctx['index']}]"
+        if src_loops:
+            # compiler-enforced PREFIX rule: the producer's loop groups
+            # lead the consumer's, so the consumer's outer indices select
+            # the matching producer instance
+            n = len(src_loops)
+            groups = ctx.get("groups", [])
+            if ([l["group"] for l in src_loops] != groups[:n]
+                    or len(ctx.get("indices", [])) < n):
+                raise ValueError(
+                    f"looped output of {src!r} referenced outside its loop")
+            src_key = self._instance_key(src, ctx["indices"][:n])
         out = tasks[src_key]["outputs"][to["output"]]
         return self.artifacts.get_json(out["uri"])
 
@@ -391,30 +417,58 @@ class PipelineRunController(Controller):
     def _instances(self, run, spec, tname: str, tir: dict[str, Any],
                    tasks: dict[str, Any]
                    ) -> list[tuple[str, Any]] | None:
-        """Instance keys (+ per-instance loop item) for a task; None while a
-        loop's items are not resolvable yet."""
-        loop = tir.get("loop")
-        if not loop:
+        """Instance (key, per-level-items tuple) pairs for a task; None
+        while some loop level's items are not resolvable yet. Nested
+        loops expand multiplicatively, outermost first: keys compose as
+        task[i][j]... and an inner level's items may reference the outer
+        levels (the outer loop's item, or a looped producer's instance)."""
+        loops = self._loops(tir)
+        if not loops:
             return [(tname, None)]
-        binding = loop["items"]
-        if "taskOutput" in binding:
-            # the only genuinely deferred case: wait for the producer;
-            # anything else (unset param, bad type) must raise and FAIL the
-            # run rather than read as "not ready yet" forever
-            src = binding["taskOutput"]["task"]
-            sstate = tasks.get(src, {}).get("state")
-            if sstate == "Skipped":
-                return []
-            if sstate not in ("Succeeded", "Cached"):
-                return None
-        items = self._resolve_ref(run, spec, binding, tasks,
-                                  {"group": None, "index": None,
-                                   "item": None})
-        if not isinstance(items, list):
-            raise ValueError(
-                f"ParallelFor items for {tname!r} must be a list, "
-                f"got {type(items).__name__}")
-        return [(f"{tname}[{i}]", item) for i, item in enumerate(items)]
+        all_groups = [l["group"] for l in loops]
+        insts: list[tuple[list[int], tuple]] = [([], ())]
+        for level, loop in enumerate(loops):
+            binding = loop["items"]
+            new: list[tuple[list[int], tuple]] = []
+            for indices, items_so_far in insts:
+                ctx = {"groups": all_groups[:level], "indices": indices,
+                       "items": list(items_so_far)}
+                if "taskOutput" in binding:
+                    # the only genuinely deferred case: wait for the
+                    # producer (the INSTANCE matching our outer indices
+                    # when the producer is itself looped); anything else
+                    # (unset param, bad type, a producer whose loop stack
+                    # is not a prefix of ours) must raise and FAIL the run
+                    # rather than read as "not ready yet" forever
+                    src = binding["taskOutput"]["task"]
+                    src_loops = self._loops(
+                        spec["root"]["dag"]["tasks"][src])
+                    n_src = len(src_loops)
+                    if ([l["group"] for l in src_loops]
+                            != ctx["groups"][:n_src]):
+                        # unreachable from the bundled compiler (prefix
+                        # rule), but a stored/hand-authored spec could hit
+                        # it — polling the nonexistent bare key would
+                        # wedge the run as "not ready" forever
+                        raise ValueError(
+                            f"ParallelFor items of {tname!r} reference "
+                            f"looped task {src!r} outside its loop")
+                    src_key = self._instance_key(src, indices[:n_src])
+                    sstate = tasks.get(src_key, {}).get("state")
+                    if sstate == "Skipped":
+                        continue   # this branch contributes no instances
+                    if sstate not in ("Succeeded", "Cached"):
+                        return None
+                items = self._resolve_ref(run, spec, binding, tasks, ctx)
+                if not isinstance(items, list):
+                    raise ValueError(
+                        f"ParallelFor items for {tname!r} must be a list, "
+                        f"got {type(items).__name__}")
+                for i, item in enumerate(items):
+                    new.append((indices + [i], items_so_far + (item,)))
+            insts = new
+        return [(self._instance_key(tname, indices), items)
+                for indices, items in insts]
 
     def _deps_state(self, dag: dict[str, Any], tir: dict[str, Any],
                     key: str, item: Any, tasks: dict[str, Any],
@@ -432,12 +486,15 @@ class PipelineRunController(Controller):
                     data_deps.add(b["taskOutput"]["task"])
         for dep in tir["dependencies"]:
             dep_tir = dag[dep]
-            dep_loop = dep_tir.get("loop")
-            if (dep_loop and dep_loop["group"] == ctx["group"]
-                    and ctx["index"] is not None):
-                dep_keys = [f"{dep}[{ctx['index']}]"]
-            elif dep_loop:
-                # depending on a whole loop from outside: every instance
+            dep_groups = [l["group"] for l in self._loops(dep_tir)]
+            n = len(dep_groups)
+            if (dep_groups and dep_groups == ctx["groups"][:n]
+                    and len(ctx["indices"]) >= n):
+                # the dep's loop stack leads ours: the matching instance
+                dep_keys = [self._instance_key(dep, ctx["indices"][:n])]
+            elif dep_groups:
+                # depending on a (deeper or foreign) loop as a whole:
+                # every instance must be terminal
                 exp = expansion.get(dep)
                 if exp is None:
                     return "wait"   # loop not expanded yet

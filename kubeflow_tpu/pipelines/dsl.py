@@ -170,8 +170,8 @@ class Task:
         self.inputs = inputs
         self.dependencies: set[str] = set()
         self.conditions: list[Predicate] = []
-        self.loop_group: str | None = None
-        self.loop_items: Any = None
+        # enclosing ParallelFor groups, OUTERMOST first: [(group, items)]
+        self.loops: list[tuple[str, Any]] = []
         self.retries: int = 0
         for v in inputs.values():
             if isinstance(v, TaskOutput):
@@ -179,6 +179,15 @@ class Task:
             elif isinstance(v, Task):
                 raise DSLError(
                     f"pass {v.name}.output (or .outputs[name]), not the task")
+
+    @property
+    def loop_group(self) -> str | None:
+        """Innermost enclosing loop group (None outside any loop)."""
+        return self.loops[-1][0] if self.loops else None
+
+    @property
+    def group_names(self) -> list[str]:
+        return [g for g, _ in self.loops]
 
     def after(self, *tasks: "Task") -> "Task":
         self.dependencies.update(t.name for t in tasks)
@@ -211,9 +220,12 @@ class Task:
             ir["conditions"] = [
                 {"operand": _encode(c.operand), "operator": c.operator,
                  "value": _encode(c.value)} for c in self.conditions]
-        if self.loop_group is not None:
-            ir["loop"] = {"group": self.loop_group,
-                          "items": _encode(self.loop_items)}
+        if self.loops:
+            # outermost-first loop stack; instance keys compose as
+            # task[i][j].... The engine also accepts the legacy singular
+            # "loop" key from specs stored by older compilers.
+            ir["loops"] = [{"group": g, "items": _encode(items)}
+                           for g, items in self.loops]
         if self.retries:
             ir["retries"] = self.retries
         return ir
@@ -263,13 +275,10 @@ class _PipelineContext:
         # Elif/Else must directly follow its chain, not bind across code
         self.branch_chains.pop(len(self.group_stack), None)
         loops = [g for g in self.group_stack if isinstance(g, ParallelFor)]
-        if len(loops) > 1:
-            raise DSLError("nested ParallelFor is not supported")
-        if loops:
-            task.loop_group = loops[0]._group
-            task.loop_items = loops[0].items
-            if isinstance(loops[0].items, TaskOutput):
-                task.dependencies.add(loops[0].items.task)
+        task.loops = [(g._group, g.items) for g in loops]
+        for g in loops:
+            if isinstance(g.items, TaskOutput):
+                task.dependencies.add(g.items.task)
         for g in self.group_stack:
             for cond in getattr(g, "conditions", ()):
                 task.conditions.append(cond)
@@ -389,21 +398,31 @@ class Else(_Group):
 class ParallelFor(_Group):
     """Fan-out group (kfp dsl.ParallelFor analog): tasks inside run once
     per item; `with ParallelFor(items) as item:` binds the per-instance
-    value. Items may be a constant list, a PipelineParam, or an upstream
-    TaskOutput producing a list. Chains inside the loop stay
-    per-iteration; outputs of looped tasks cannot be consumed outside the
-    loop (no Collected support)."""
+    value. Items may be a constant list, a PipelineParam, an upstream
+    TaskOutput producing a list, or — inside another ParallelFor — the
+    outer loop's item (iterating a list-of-lists). Loops NEST (kfp v2
+    parity): instance keys compose as task[i][j]..., and chains inside a
+    loop stay per-iteration at every level. Outputs of looped tasks still
+    cannot be consumed outside their loop (no Collected support)."""
 
     def __init__(self, items: Any):
-        if not isinstance(items, (list, tuple, PipelineParam, TaskOutput)):
+        if not isinstance(items, (list, tuple, PipelineParam, TaskOutput,
+                                  LoopItem)):
             raise DSLError(
                 "ParallelFor items must be a list, a pipeline parameter, "
-                "or a task output")
+                "a task output, or an enclosing loop's item")
         self.items = list(items) if isinstance(items, (list, tuple)) \
             else items
         self._group = ""
 
     def _pre_push(self, ctx):
+        if isinstance(self.items, LoopItem):
+            enclosing = [g._group for g in ctx.group_stack
+                         if isinstance(g, ParallelFor)]
+            if self.items.group not in enclosing:
+                raise DSLError(
+                    "ParallelFor over a loop item requires that item's "
+                    "loop to be enclosing")
         ctx._loop_seq += 1
         self._group = f"loop-{ctx._loop_seq}"
 
@@ -442,8 +461,32 @@ class Pipeline:
             p.name: (None if p.default is inspect.Parameter.empty
                      else p.default)
             for p in sig.parameters.values()}
+        # params truly without a default (an explicit default of None maps
+        # to None in self.params too, and must NOT read as required)
+        self._required = {p.name for p in sig.parameters.values()
+                         if p.default is inspect.Parameter.empty}
 
     def __call__(self, **kwargs):
+        """Pipeline-as-component (⊘ kfp v2 sub-DAG compilation): calling a
+        Pipeline inside ANOTHER pipeline's trace inlines its tasks into
+        the active context — inputs bind to the caller's arguments
+        (constants, pipeline params, task outputs, or loop items), the
+        enclosing group stack applies (a sub-pipeline under If/ParallelFor
+        is conditioned/fanned out whole), task names de-collide with the
+        standard -N suffixing, and step caching is unchanged because the
+        inlined tasks keep their component digests. The function's return
+        value (typically a Task or TaskOutput) flows back to the caller
+        for downstream wiring. Outside a trace it simply executes."""
+        unknown = set(kwargs) - set(self.params)
+        if unknown:
+            raise DSLError(
+                f"pipeline {self.name!r}: unknown inputs {sorted(unknown)}")
+        if _ACTIVE:
+            missing = sorted(self._required - set(kwargs))
+            if missing:
+                raise DSLError(
+                    f"pipeline {self.name!r} inlined as a component: "
+                    f"missing inputs {missing}")
         return self.fn(**kwargs)
 
 
@@ -506,25 +549,38 @@ def compile_pipeline(p: Pipeline) -> dict[str, Any]:
 
 def _check_group_scoping(ctx: "_PipelineContext") -> None:
     """Loop outputs stay inside their group; LoopItem binds only inside
-    its own loop."""
-    group_of = {t.name: t.loop_group for t in ctx.tasks.values()}
+    its own loop. With nesting, the rule generalizes to a PREFIX rule: a
+    task may consume an output produced under loop groups [A, B] only if
+    its own group stack starts with [A, B] — the consumer then reads the
+    instance matching its own outer indices; anything else would need a
+    Collected aggregation, which (like single-level escape) is
+    unsupported."""
+    groups_of = {t.name: t.group_names for t in ctx.tasks.values()}
     for t in ctx.tasks.values():
+        mine = t.group_names
         cond_refs = [r for c in t.conditions for r in (c.operand, c.value)]
-        if (isinstance(t.loop_items, TaskOutput)
-                and group_of.get(t.loop_items.task) is not None):
-            raise DSLError(
-                f"{t.name}: ParallelFor items come from looped task "
-                f"{t.loop_items.task!r}; looped outputs cannot escape "
-                "their loop")
+        for level, (_g, items) in enumerate(t.loops):
+            outer = mine[:level]
+            if isinstance(items, TaskOutput):
+                src = groups_of.get(items.task, [])
+                if src and src != outer[:len(src)]:
+                    raise DSLError(
+                        f"{t.name}: ParallelFor items come from looped "
+                        f"task {items.task!r} (groups {src}); looped "
+                        "outputs cannot escape their loop")
+            if isinstance(items, LoopItem) and items.group not in outer:
+                raise DSLError(
+                    f"{t.name}: loop items bind {items.group!r} which is "
+                    "not an enclosing loop")
         for v in list(t.inputs.values()) + cond_refs:
             if isinstance(v, TaskOutput):
-                src_group = group_of.get(v.task)
-                if src_group is not None and src_group != t.loop_group:
+                src = groups_of.get(v.task, [])
+                if src and src != mine[:len(src)]:
                     raise DSLError(
                         f"{t.name} consumes {v.task}.{v.output} from inside "
-                        f"ParallelFor group {src_group!r}; looped outputs "
-                        "cannot escape their loop")
-            if isinstance(v, LoopItem) and v.group != t.loop_group:
+                        f"ParallelFor groups {src}; looped outputs cannot "
+                        "escape their loop")
+            if isinstance(v, LoopItem) and v.group not in mine:
                 raise DSLError(
                     f"{t.name} binds the loop item of {v.group!r} outside "
                     "that ParallelFor")

@@ -22,10 +22,24 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# silence XLA:CPU AOT-cache feature-bookkeeping logs (one E-line per
+# persistent-cache load; the pseudo-features ±prefer-no-* never match the
+# detected host string even on the same machine)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache (CI fast-lane diet, VERDICT r3 ask #6):
+# the suite's cost is XLA compiles, and many tests build fresh engines /
+# trainers whose programs are byte-identical HLO — each fresh jit object
+# recompiles them. The disk cache dedupes those WITHIN one session and
+# warms repeat runs + subprocess-spawning tests. Keyed by HLO+flags, so
+# correctness is unaffected; override the location with KTPU_TEST_CACHE.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("KTPU_TEST_CACHE",
+                                 "/tmp/ktpu_test_compile_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import pytest  # noqa: E402
 

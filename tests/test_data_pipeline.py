@@ -112,6 +112,7 @@ def _read_losses(metrics_file):
             if "loss" in r.get("metrics", {})]
 
 
+@pytest.mark.slow
 def test_jaxjob_trains_on_corpus_loss_decreases(tmp_path):
     """The VERDICT missing-#1 contract: a JAXJob over an on-disk corpus,
     through the platform surface (KTPU_TRAINER_CONFIG.dataset), with loss

@@ -114,6 +114,7 @@ def test_storage_resolves_hf_cache(hf_dir, tmp_path, monkeypatch):
         download("hf://absent/model")
 
 
+@pytest.mark.slow
 def test_llm_runtime_serves_hf_dir(hf_dir):
     """InferenceService path: storageUri -> HF dir -> engine serves it
     (weights + architecture from one dir; ⊘ kserve huggingfaceserver)."""

@@ -111,6 +111,7 @@ def test_native_matches_python_differential():
 
 # -- engine numerics ---------------------------------------------------------
 
+@pytest.mark.slow
 def test_generate_matches_full_forward(tiny):
     params, cfg = tiny
     engine = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16))
@@ -301,6 +302,7 @@ def test_release_drops_request_state(tiny):
     assert m["ttft_p50_s"] >= 0.0 and m["completed"] == 1
 
 
+@pytest.mark.slow
 def test_sharded_engine_matches_unsharded(tiny):
     """Tensor-parallel serving (mesh tensor=2) produces exactly the greedy
     tokens of the single-device engine — GSPMD shards params/KV-cache, the
@@ -707,6 +709,7 @@ def test_nonfinite_temperature_rejected(tiny, completion_server):
     assert resp.status == 400 and "finite" in out["error"]
 
 
+@pytest.mark.slow
 def test_chunked_prefill_long_prompt_matches_ref(tiny):
     """Prompts longer than the largest bucket chain through continuation
     programs (chunked prefill) — previously a hard PromptTooLong."""
@@ -746,6 +749,7 @@ def test_chunked_reject_counts_in_scheduler_stats(tiny):
     assert engine.scheduler.stats().rejected == before + 1
 
 
+@pytest.mark.slow
 def test_chunked_prefill_hits_prefix_store(tiny):
     """A long shared prefix (system prompt) banks on the first chunked
     request and skips the big-bucket prefill on the second."""

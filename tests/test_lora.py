@@ -43,6 +43,7 @@ def test_merged_equals_base_at_init():
                                np.asarray(lora_logits), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_trainer_freezes_base_and_trains_adapters():
     cfg = TrainerConfig(
         model="llama_lora",

@@ -82,6 +82,7 @@ def _fixed_batch(batch=4, seq=32):
     return {"tokens": tokens}
 
 
+@pytest.mark.slow
 def test_mixtral_trains(devices8):
     from kubeflow_tpu.training import data as data_lib
 

@@ -84,6 +84,7 @@ def test_nas_cnn_trains_via_trainer():
     assert accs[-1] > accs[0]
 
 
+@pytest.mark.slow
 def test_darts_supernet_learns_alphas():
     """Joint weight+alpha training on the supernet: loss drops and the
     architecture distribution moves away from uniform; derive() reads a
@@ -113,6 +114,7 @@ def test_darts_supernet_learns_alphas():
     assert len(arch) == 2 and all(op in nas_cnn.OP_NAMES for op in arch)
 
 
+@pytest.mark.slow
 def test_darts_matches_fixed_arch_at_onehot():
     """A supernet with one-hot alpha must equal the fixed-arch model with
     the same op params (the derive step's correctness contract)."""

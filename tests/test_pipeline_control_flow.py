@@ -24,6 +24,11 @@ def double(n: int) -> int:
 
 
 @dsl.component
+def add(a: int, b: int) -> int:
+    return a + b
+
+
+@dsl.component
 def make_list(n: int) -> list:
     return list(range(n))
 
@@ -164,15 +169,91 @@ def test_loop_output_escape_rejected():
         kfp.compile_pipeline(bad)
 
 
-def test_nested_parallel_for_rejected():
-    with pytest.raises(dsl.DSLError, match="nested ParallelFor"):
-        @dsl.pipeline
-        def nested():
-            with dsl.ParallelFor([1]) as a:
-                with dsl.ParallelFor([2]) as b:
-                    double(n=b)
+def test_nested_parallel_for_composes_instance_keys(pipe_cluster):
+    """Loop-in-loop (kfp v2 parity): instance keys compose as t[i][j] and
+    the inner body may read BOTH levels' items."""
+    cluster, ctrl = pipe_cluster
 
-        kfp.compile_pipeline(nested)
+    @dsl.pipeline
+    def nested():
+        with dsl.ParallelFor([10, 20]) as a:
+            with dsl.ParallelFor([1, 2, 3]) as b:
+                add(a=a, b=b)
+
+    run = run_pipeline(cluster, nested, "nest", timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    tasks = run["status"]["tasks"]
+    keys = sorted(k for k in tasks if k.startswith("add"))
+    assert keys == [f"add[{i}][{j}]" for i in range(2) for j in range(3)]
+    got = {k: ctrl.task_output("nest", k) for k in keys}
+    assert got == {f"add[{i}][{j}]": a + b
+                   for i, a in enumerate([10, 20])
+                   for j, b in enumerate([1, 2, 3])}
+
+
+def test_nested_loop_over_outer_item(pipe_cluster):
+    """ParallelFor over the OUTER loop's item: a list-of-lists fans out
+    once per inner element, per outer row."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def rows():
+        with dsl.ParallelFor([[1, 2], [3]]) as row:
+            with dsl.ParallelFor(row) as cell:
+                double(n=cell)
+
+    run = run_pipeline(cluster, rows, "rows", timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    tasks = run["status"]["tasks"]
+    keys = sorted(k for k in tasks if k.startswith("double"))
+    assert keys == ["double[0][0]", "double[0][1]", "double[1][0]"]
+    assert [ctrl.task_output("rows", k) for k in keys] == [2, 4, 6]
+
+
+def test_nested_loop_chain_stays_per_instance(pipe_cluster):
+    """A chain inside the inner loop resolves per (i, j) instance, and a
+    looped producer's output feeds an inner-loop consumer via the prefix
+    rule."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def chain():
+        with dsl.ParallelFor([1, 2]) as a:
+            d = double(n=a)           # groups [L1]
+            with dsl.ParallelFor([10, 100]) as m:
+                add(a=d.output, b=m)  # groups [L1, L2]: reads d[i]
+
+    run = run_pipeline(cluster, chain, "chain", timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    tasks = run["status"]["tasks"]
+    got = {k: ctrl.task_output("chain", k)
+           for k in tasks if k.startswith("add")}
+    assert got == {"add[0][0]": 12, "add[0][1]": 102,
+                   "add[1][0]": 14, "add[1][1]": 104}
+
+
+def test_nested_dynamic_inner_items_from_looped_task(pipe_cluster):
+    """Inner-loop items produced by an outer-loop task: each outer
+    instance fans out over ITS OWN produced list."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def dyn():
+        with dsl.ParallelFor([1, 2]) as n:
+            lst = make_list(n=n)          # [0], then [0, 1]
+            with dsl.ParallelFor(lst.output) as j:
+                double(n=j)
+
+    run = run_pipeline(cluster, dyn, "dyn", timeout=90)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    tasks = run["status"]["tasks"]
+    keys = sorted(k for k in tasks if k.startswith("double"))
+    assert keys == ["double[0][0]", "double[1][0]", "double[1][1]"]
+    assert [ctrl.task_output("dyn", k) for k in keys] == [0, 0, 2]
 
 
 # -- dsl.ExitHandler ----------------------------------------------------------
@@ -461,3 +542,74 @@ def test_importer_resolves_ktpu_uri(pipe_cluster):
     assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
         run["status"]
     assert ctrl.task_output("impk", "read_file") == '"lineage payload"'
+
+
+# -- pipeline-as-component (sub-DAG inlining) ---------------------------------
+
+@dsl.pipeline
+def double_twice(n: int = 1):
+    """A reusable sub-pipeline: returns the tail task for caller wiring."""
+    d = double(n=n)
+    return double(n=d.output)
+
+
+def test_pipeline_in_pipeline_inlines_subdag(pipe_cluster):
+    """Calling a Pipeline inside a pipeline trace inlines its tasks
+    (kfp v2 pipeline-as-component): the sub-DAG's outputs wire into the
+    outer graph and names de-collide with the standard suffixing."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def outer():
+        quad = double_twice(n=3)
+        add(a=quad.output, b=1)
+
+    spec = kfp.compile_pipeline(outer)
+    assert set(spec["root"]["dag"]["tasks"]) == {"double", "double-2", "add"}
+    run = run_pipeline(cluster, outer, "pip", timeout=60)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    assert ctrl.task_output("pip", "add") == 13
+
+
+def test_pipeline_in_pipeline_under_loop_and_caching(pipe_cluster):
+    """A sub-pipeline called inside ParallelFor fans out whole, and step
+    caching stays intact across runs (component digests unchanged by
+    inlining)."""
+    cluster, ctrl = pipe_cluster
+
+    @dsl.pipeline
+    def outer_loop():
+        with dsl.ParallelFor([1, 2]) as n:
+            double_twice(n=n)
+
+    run = run_pipeline(cluster, outer_loop, "pl1", timeout=60)
+    assert has_condition(run["status"], JobConditionType.SUCCEEDED), \
+        run["status"]
+    for i, n in enumerate([1, 2]):
+        assert ctrl.task_output("pl1", f"double-2[{i}]") == 4 * n
+    # second run: every instance served from the digest cache
+    run2 = run_pipeline(cluster, outer_loop, "pl2", timeout=60)
+    assert has_condition(run2["status"], JobConditionType.SUCCEEDED)
+    states = {k: t["state"] for k, t in run2["status"]["tasks"].items()}
+    assert states and all(s == "Cached" for s in states.values()), states
+
+
+def test_pipeline_in_pipeline_validates_inputs():
+    with pytest.raises(dsl.DSLError, match="unknown inputs"):
+        @dsl.pipeline
+        def bad_kwargs():
+            double_twice(m=3)
+
+        kfp.compile_pipeline(bad_kwargs)
+
+    @dsl.pipeline
+    def no_default(n: int):
+        double(n=n)
+
+    with pytest.raises(dsl.DSLError, match="missing inputs"):
+        @dsl.pipeline
+        def bad_missing():
+            no_default()
+
+        kfp.compile_pipeline(bad_missing)

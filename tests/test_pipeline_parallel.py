@@ -73,6 +73,7 @@ def _two_step_losses(trainer):
     return float(m1["loss"]), float(m2["loss"])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("microbatches", [0, 4])
 def test_pipeline_train_step_parity(devices8, microbatches):
     ref = _two_step_losses(
@@ -83,6 +84,7 @@ def test_pipeline_train_step_parity(devices8, microbatches):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_data(devices8):
     ref = _two_step_losses(
         _make_trainer(MeshConfig(data=1), devices8[:1]))

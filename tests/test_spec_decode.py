@@ -143,6 +143,7 @@ def test_spec_greedy_exactness_random_model(params_cfg):
         assert spec.generate(p, 24) == plain.generate(p, 24)
 
 
+@pytest.mark.slow
 def test_spec_greedy_exactness_and_acceptance_trained(trained_params_cfg):
     params, cfg, pattern = trained_params_cfg
     plain = build(params, cfg, spec=None)

@@ -9,6 +9,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from kubeflow_tpu import serving
 from kubeflow_tpu.control import Cluster, new_resource
@@ -17,6 +18,7 @@ from kubeflow_tpu.training import Trainer, TrainerConfig, OptimizerConfig
 from kubeflow_tpu.training import data as data_lib
 
 
+@pytest.mark.slow
 def test_train_checkpoint_serve_round_trip(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     overrides = dict(n_classes=4, c1=8, c2=8, hidden=32)

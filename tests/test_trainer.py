@@ -53,6 +53,7 @@ def test_bf16_first_moment_halves_mu_state():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_llama_tiny_train_dp_tp(devices8):
     tr = make_trainer(
         model="llama", mesh=MeshConfig(data=2, fsdp=2, tensor=2),
