@@ -253,7 +253,8 @@ def aot_serving_report(
                 params, ad_cache, lengths, last, samp, key, active, lora)
         if speculative:
             # the live engine dispatches spec AND adapters in ONE program
-            # (_do_spec_decode passes the adapter stack into _spec_decode);
+            # (_do_decode's spec branch passes the adapter stack into
+            # _spec_decode);
             # the combined member carries the spec+1 query rows, the hist
             # buffer, and the gathered rank-r bypass simultaneously — it,
             # not either variant alone, is the true worst of this menu

@@ -84,7 +84,8 @@ class LLMEngine:
                  spec_ngram: int = 3,
                  adapters: dict[str, dict[str, Any]] | None = None,
                  logprobs_topk: int = 0,
-                 sample_k_max: int = 64):
+                 sample_k_max: int = 64,
+                 pipeline_decode: bool = True):
         if max(buckets) >= max_len:
             raise ValueError("largest bucket must leave room to decode")
         if quantize not in (None, "int8"):
@@ -190,6 +191,12 @@ class LLMEngine:
         self._req_stop: dict[int, list[list[int]]] = {}
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
+        # -- decode pipelining: one dispatched-but-unfetched chunk may be
+        # in flight; _inflight tracks its planned KV rows per slot so the
+        # next chunk's headroom/span see through the lag
+        self.pipeline_decode = pipeline_decode
+        self._pending: tuple | None = None
+        self._inflight = np.zeros((n_slots,), np.int64)
         self._max_new: dict[int, int] = {}
         self._finish_reasons: dict[int, str] = {}
 
@@ -958,10 +965,17 @@ class LLMEngine:
         with self._submit_lock:
             action = self.scheduler.next()
         if action is None:
+            if self._pending is not None:
+                self._drain_pending()   # the final chunk's tokens
+                return True
             return False
         if isinstance(action, DecodeAction):
             self._do_decode()
             return True
+        # prefill path: the in-flight chunk must land FIRST — its replay
+        # frees slots/completes requests, and the device-side prefill that
+        # follows overwrites any junk the chunk wrote into reused slots
+        self._drain_pending()
         actions = [action]
         while len(actions) < self.n_slots:
             with self._submit_lock:
@@ -1182,6 +1196,8 @@ class LLMEngine:
         self.last_tokens = self._put(np.zeros((self.n_slots,), np.int32))
         self.samp = self._put(np.zeros((self.n_slots, 3), np.float32))
         self._host_lengths[:] = 0
+        self._pending = None
+        self._inflight[:] = 0
 
     def is_done(self, req_id: int) -> bool:
         return req_id in self._done
@@ -1408,103 +1424,135 @@ class LLMEngine:
         dwarfs the per-token compute, so K-in-one-program is the
         difference between RTT-per-token and RTT-per-chunk.
 
+        PIPELINED (pipeline_decode=True): the next chunk is DISPATCHED
+        before the previous chunk's tokens are fetched, so the host-side
+        fetch RTT + replay overlaps the device's execution of the new
+        chunk — per-chunk wall time becomes max(device, host) instead of
+        their sum (~106ms RTT measured against an 8B chunk). The cost: a
+        slot that finishes mid-chunk burns at most ONE extra chunk of
+        junk compute before the host notices, and planning uses lengths
+        that lag the device by the in-flight chunk (tracked via
+        _inflight).
+
         K = largest power of two <= decode_chunk that fits cache headroom
         (chunk writes KV rows L..L+K-1 for the fullest slot, which must
         stay < max_len). Slots may finish (EOS / max_new) mid-chunk: their
         surplus tokens are dropped host-side, and new arrivals wait at
         most one chunk for their prefill — decode_chunk bounds scheduling
         latency."""
-        if self.spec:
-            self._do_spec_decode()
-            return
-        slot_req = [self.scheduler.slot_request(s) for s in range(self.n_slots)]
-        active = np.array([r >= 0 for r in slot_req], bool)
-        remaining = max(self._max_new[r] - len(self._results[r])
-                        for r in slot_req if r >= 0)
-        headroom = self.max_len - int(
-            max(self._host_lengths[s] for s in range(self.n_slots)
-                if active[s]))
-        k = 1
-        while (k * 2 <= self.decode_chunk and k * 2 <= headroom
-               and k < remaining):
-            k *= 2
-        # length-aware span: the chunk's last write lands at max_len-1 at
-        # most; attend over the smallest power-of-two window covering every
-        # active length through the chunk's end
-        longest = int(max((self._host_lengths[s]
-                           for s in range(self.n_slots) if active[s]),
-                          default=0))
-        span = self._pick_span(longest + k)
-
-        (self.cache, self.lengths, self.last_tokens, self.samp,
-         self.rng_key, out) = self._decode_fn(k, span)(
-            self.params, self.cache, self.lengths, self.last_tokens,
-            self.samp, self.rng_key, self._put(active), *self._extra())
-        out_np = np.asarray(out)  # [k, n_slots, out_cols] — one fetch
-        done_slots: set[int] = set()
-        for row in out_np:
-            for slot, req in enumerate(slot_req):
-                if req < 0 or slot in done_slots:
-                    continue
-                self._host_lengths[slot] += 1
-                tok, lp, top = self._unpack_out(row[slot])
-                if self._record_token(req, slot, tok, lp, top):
-                    # finished mid-chunk: later tokens are garbage for this
-                    # slot; drop them (its cache is reset by the next
-                    # prefill into the slot). The local return value — not
-                    # the shared _done set — decides, so a concurrent
-                    # release() from a server thread can't unfinish it.
-                    done_slots.add(slot)
-
-    def _do_spec_decode(self) -> None:
-        """Speculative twin of _do_decode: dispatch one scanned program of
-        verify rounds, then replay the emitted (count, tokens) rows in
-        order. `steps` rounds advance a slot by 1..spec+1 tokens each, so
-        the round count is bounded by cache headroom at the worst case
-        (every draft accepted) — surplus tokens past EOS/budget are dropped
-        host-side exactly like mid-chunk decode finishes."""
+        if self._pending is not None:
+            # if the in-flight chunk's GUARANTEED deliveries (steps tokens
+            # per continuing slot; spec rounds deliver at least one each)
+            # already satisfy every active budget, another dispatch would
+            # be pure junk compute — drain instead (this is what makes the
+            # final chunk of a drain free under pipelining)
+            psr, psteps, _, _ = self._pending
+            if all(self._max_new[r] - len(self._results[r]) <= psteps
+                   for r in psr if r >= 0 and r in self._max_new):
+                self._drain_pending()
+                return
         slot_req = [self.scheduler.slot_request(s)
                     for s in range(self.n_slots)]
         active = np.array([r >= 0 for r in slot_req], bool)
         remaining = max(self._max_new[r] - len(self._results[r])
                         for r in slot_req if r >= 0)
-        kp1 = self.spec + 1
+        # planned-position accounting: rows already written by the
+        # in-flight (unfetched) chunk count toward headroom and span
+        planned = self._host_lengths + self._inflight
+        per_tok = (self.spec + 1) if self.spec else 1
         headroom = self.max_len - int(
-            max(self._host_lengths[s] for s in range(self.n_slots)
-                if active[s]))
-        steps = 1
-        while (steps * 2 <= self.decode_chunk
-               and steps * 2 * kp1 <= headroom and steps < remaining):
-            steps *= 2
-        longest = int(max((self._host_lengths[s]
-                           for s in range(self.n_slots) if active[s]),
-                          default=0))
-        span = self._pick_span(min(longest + steps * kp1, self.max_len))
+            max(planned[s] for s in range(self.n_slots) if active[s]))
+        k = 1
+        # doubling guard: the NEXT candidate (k*2 steps) must fit — a
+        # spec round writes up to per_tok rows, plain decode exactly one
+        while (k * 2 <= self.decode_chunk
+               and k * 2 * per_tok <= headroom
+               and k < remaining):
+            k *= 2
+        # length-aware span: the chunk's last write lands at max_len-1 at
+        # most; attend over the smallest power-of-two window covering every
+        # active length through the chunk's end
+        longest = int(max((planned[s] for s in range(self.n_slots)
+                           if active[s]), default=0))
+        span = self._pick_span(min(longest + k * per_tok, self.max_len))
+        fn = self._spec_fn if self.spec else self._decode_fn
         (self.cache, self.lengths, self.last_tokens, self.samp,
-         self.rng_key, out) = self._spec_fn(steps, span)(
+         self.rng_key, out) = fn(k, span)(
             self.params, self.cache, self.lengths, self.last_tokens,
             self.samp, self.rng_key, self._put(active), *self._extra())
-        # [steps, n_slots, 1 + (spec+1)*out_cols]; one fetch
-        out_np = np.asarray(out)
-        oc = self._out_cols
+        rows_added = np.where(active, k * per_tok, 0)
+        self._inflight += rows_added
+        prev = self._pending
+        self._pending = (slot_req, k, out, rows_added)
+        if not self.pipeline_decode:
+            self._drain_pending()
+        elif prev is not None:
+            self._replay(prev)
+
+    def _drain_pending(self) -> None:
+        """Fetch + replay the in-flight decode chunk, if any. Must run
+        before any prefill dispatch or idle return: replay frees slots and
+        completes requests, and the host bookkeeping must be current
+        before slot assignments change."""
+        p = self._pending
+        if p is not None:
+            self._pending = None
+            self._replay(p)
+
+    def _replay(self, pending) -> None:
+        """Fetch one dispatched chunk's packed rows and replay them into
+        per-request results. `slot_req` is the slot->request map AT
+        DISPATCH time; a slot freed since (cancellation applied at a chunk
+        boundary while this chunk was in flight) no longer maps to its
+        captured request and is skipped — its rows are junk by contract,
+        exactly like post-EOS surplus."""
+        slot_req, steps, out, rows_added = pending
+        out_np = np.asarray(out)   # one fetch per chunk
+        # in-flight rows for THIS chunk are now accounted by the replay's
+        # own host_lengths advancement (junk/surplus rows stay counted in
+        # neither — the next prefill into the slot resets both)
+        alive = [self.scheduler.slot_request(s) == slot_req[s]
+                 for s in range(self.n_slots)]
         done_slots: set[int] = set()
-        for s in range(steps):
-            for slot, req in enumerate(slot_req):
-                if req < 0 or slot in done_slots:
-                    continue
-                cnt = int(out_np[s, slot, 0])
-                emits = out_np[s, slot, 1:].reshape(kp1, oc)
-                self._spec_verifies += 1
-                for j in range(cnt):
+        if self.spec:
+            kp1 = self.spec + 1
+            oc = self._out_cols
+            for s in range(steps):
+                for slot, req in enumerate(slot_req):
+                    if req < 0 or slot in done_slots or not alive[slot]:
+                        continue
+                    cnt = int(out_np[s, slot, 0])
+                    emits = out_np[s, slot, 1:].reshape(kp1, oc)
+                    self._spec_verifies += 1
+                    for j in range(cnt):
+                        self._host_lengths[slot] += 1
+                        # count DELIVERED tokens, not the round's emit
+                        # count: a mid-round finish drops the surplus, and
+                        # the tokens-per-round metric must not claim them
+                        self._spec_tokens += 1
+                        tok, lp, top = self._unpack_out(emits[j])
+                        if self._record_token(req, slot, tok, lp, top):
+                            done_slots.add(slot)
+                            break
+        else:
+            for row in out_np:   # [steps, n_slots, out_cols]
+                for slot, req in enumerate(slot_req):
+                    if req < 0 or slot in done_slots or not alive[slot]:
+                        continue
                     self._host_lengths[slot] += 1
-                    # count DELIVERED tokens, not the round's emit count:
-                    # a mid-round finish drops the surplus, and the
-                    # tokens-per-round metric must not claim them
-                    self._spec_tokens += 1
-                    tok, lp, top = self._unpack_out(emits[j])
+                    tok, lp, top = self._unpack_out(row[slot])
                     if self._record_token(req, slot, tok, lp, top):
+                        # finished mid-chunk: later tokens are garbage for
+                        # this slot; drop them (its cache is reset by the
+                        # next prefill into the slot). The local return
+                        # value — not the shared _done set — decides, so a
+                        # concurrent release() from a server thread can't
+                        # unfinish it.
                         done_slots.add(slot)
-                        break
+        # remove THIS chunk's planned rows: delivered ones re-entered via
+        # host_lengths above; junk rows belong to freed slots whose state
+        # the next prefill resets anyway
+        self._inflight = np.maximum(self._inflight - rows_added, 0)
 
     def _record_token(self, req_id: int, slot: int, token: int,
                       lp: float = 0.0, top: dict[int, float] | None = None,

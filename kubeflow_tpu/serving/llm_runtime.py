@@ -50,6 +50,7 @@ class LLMModel(Model):
                  adapters: dict[str, Any] | None = None,
                  logprobs_topk: int = 0,
                  sample_k_max: int = 64,
+                 pipeline_decode: bool = True,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -82,6 +83,7 @@ class LLMModel(Model):
         self._adapters_cfg = dict(adapters) if adapters else None
         self._logprobs_topk = logprobs_topk
         self._sample_k_max = sample_k_max
+        self._pipeline_decode = pipeline_decode
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -142,7 +144,8 @@ class LLMModel(Model):
                                  spec_ngram=self._spec_ngram,
                                  adapters=self._load_adapters(cfg),
                                  logprobs_topk=self._logprobs_topk,
-                                 sample_k_max=self._sample_k_max)
+                                 sample_k_max=self._sample_k_max,
+                                 pipeline_decode=self._pipeline_decode)
         # compile the whole program menu at load (the Knative cold-start
         # analog): no live request ever waits on XLA
         self._engine.warmup()

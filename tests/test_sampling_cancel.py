@@ -374,3 +374,88 @@ def test_dropped_stream_client_releases_slot(tiny):
     finally:
         server.stop()
         m.unload()
+
+
+# -- decode pipelining (dispatch-ahead / fetch-behind overlap) ---------------
+
+def test_pipelined_decode_matches_unpipelined(tiny):
+    """pipeline_decode overlaps the fetch of chunk N with the dispatch of
+    chunk N+1; outputs (tokens, logprobs, finish reasons) must be
+    byte-identical to the serial engine, including mid-chunk finishes
+    (staggered budgets) and sampled slots."""
+    params, cfg = tiny
+    prompts = [[3, 17, 42], [5, 9, 2, 44]]
+    budgets = [9, 5]   # staggered: one slot finishes mid-chunk
+    outs = []
+    for pipelined in (False, True):
+        eng = _engine(params, cfg, decode_chunk=4, sample_seed=5,
+                      pipeline_decode=pipelined)
+        rids = [eng.submit(p, b, temperature=t)
+                for p, b, t in zip(prompts, budgets, (0.0, 1.1))]
+        eng.run_until_idle()
+        assert all(eng.is_done(r) for r in rids)
+        outs.append([(eng.result(r), eng.result_logprobs(r),
+                      eng.finish_reason(r)) for r in rids])
+    assert outs[0] == outs[1]
+
+
+def test_pipelined_decode_refills_and_continues(tiny):
+    """With n_slots=1 and a queued request, the pending chunk drains
+    before the freed slot's prefill, and the second request decodes
+    correctly after the handoff."""
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1, decode_chunk=4,
+                  pipeline_decode=True)
+    r1 = eng.submit([3, 17, 42], 6)
+    r2 = eng.submit([5, 9, 2], 6)
+    eng.run_until_idle()
+    assert eng.result(r1) == _ref_generate(params, cfg, [3, 17, 42], 6)
+    assert eng.result(r2) == _ref_generate(params, cfg, [5, 9, 2], 6)
+
+
+def test_pipelined_spec_decode_exactness(tiny):
+    """Speculative mode pipelines the scanned verify chunks the same way;
+    greedy output must still be byte-identical to plain decode."""
+    params, cfg = tiny
+    prompt = [3, 17, 42, 9, 55]
+    greedy = _ref_generate(params, cfg, prompt, 10)
+    eng = _engine(params, cfg, speculative=3, spec_ngram=2,
+                  decode_chunk=4, pipeline_decode=True)
+    rid = eng.submit(prompt, 10)
+    eng.run_until_idle()
+    assert eng.result(rid) == greedy
+
+
+def test_cancel_while_chunk_in_flight(tiny):
+    """Cancellation applied while a chunk is dispatched-but-unfetched:
+    the replay must skip the freed slot and the engine keeps serving."""
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1, decode_chunk=2,
+                  pipeline_decode=True)
+    r1 = eng.submit([3, 17, 42], 40)
+    r2 = eng.submit([5, 9, 2], 4)
+    assert eng.step()          # prefill r1
+    assert eng.step()          # dispatch chunk 1 (pending, unfetched)
+    assert eng.cancel(r1)
+    eng.run_until_idle()
+    assert eng.is_done(r1) and eng.finish_reason(r1) == "cancelled"
+    assert eng.is_done(r2)
+    assert eng.result(r2) == _ref_generate(params, cfg, [5, 9, 2], 4)
+    assert eng.metrics()["cancelled"] == 1
+
+
+def test_cache_room_respected_with_inflight_chunk(tiny):
+    """Headroom planning must count the in-flight chunk's rows: a request
+    decoding to the cache edge finishes with reason "length" and never
+    writes past max_len."""
+    params, cfg = tiny
+    eng = _engine(params, cfg, n_slots=1, max_len=24, buckets=(8,),
+                  decode_chunk=8, pipeline_decode=True)
+    rid = eng.submit([1, 2, 3, 4, 5], 500)
+    eng.run_until_idle()
+    assert eng.is_done(rid)
+    assert eng.finish_reason(rid) == "length"
+    # 5 prompt rows + a KV row per generated token EXCEPT the final one
+    # (an emitted token's row is only written by the step that consumes
+    # it) must stop at the cache edge — same count as the serial engine
+    assert 5 + len(eng.result(rid)) - 1 <= 24
