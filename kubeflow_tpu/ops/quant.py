@@ -33,12 +33,52 @@ def is_quantized(wt: Any) -> bool:
     return isinstance(wt, dict) and "q" in wt and "s" in wt
 
 
+# Fused Pallas dequant-matmul for decode-shaped int8 matmuls (few
+# activation rows against a whole 2D weight) — EXPERIMENTAL, default
+# OFF. Measured on v5e at 8B geometry: +7% on a single-step decode
+# program (the convert+dot lowering's staging recovered), but -17% on
+# the engine's scan-of-steps chunk programs — inside the step scan the
+# custom call defeats XLA's cross-iteration weight prefetch, which is
+# worth more than the staging it saves. Kept opt-in
+# (USE_PALLAS_DEQUANT=True) with interpreter-mode numerics tests; the
+# production decode path stays on the XLA lowering.
+USE_PALLAS_DEQUANT: bool = False
+
+
+def _pallas_dequant_wanted(x, q) -> bool:
+    from kubeflow_tpu.ops import quant_matmul
+
+    if not (USE_PALLAS_DEQUANT or quant_matmul.FORCE_INTERPRET):
+        return False
+    if q.ndim != 2:
+        return False
+    m = 1
+    for v in x.shape[:-1]:
+        m *= v
+    if not quant_matmul.kernel_applicable(m, *q.shape):
+        return False
+    if quant_matmul.FORCE_INTERPRET:
+        return True
+    try:   # opted-in on a non-TPU backend: compiled Mosaic can't lower —
+        # fall back silently rather than crash every quantized matmul
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def matmul(x: jax.Array, wt: Any, dtype) -> jax.Array:
     """x @ W for a raw or quantized weight leaf (x: [..., in]). The scale
     is applied in f32 and the PRODUCT cast to dtype — casting s itself to
     bf16 first would add a systematic per-channel bias on top of the
-    quantization error (s is tiny; this costs nothing)."""
+    quantization error (s is tiny; this costs nothing). Decode-shaped
+    quantized matmuls can OPT IN to the fused Pallas kernel
+    (USE_PALLAS_DEQUANT; ops/quant_matmul.py) — the production default
+    stays on this XLA lowering, per the A/B above."""
     if is_quantized(wt):
+        if _pallas_dequant_wanted(x, wt["q"]):
+            from kubeflow_tpu.ops import quant_matmul
+
+            return quant_matmul.dequant_matmul(x, wt["q"], wt["s"], dtype)
         return ((x @ wt["q"].astype(dtype)).astype(jnp.float32)
                 * wt["s"]).astype(dtype)
     return x @ wt.astype(dtype)
@@ -47,6 +87,11 @@ def matmul(x: jax.Array, wt: Any, dtype) -> jax.Array:
 def matmul_f32_out(x: jax.Array, wt: Any, dtype) -> jax.Array:
     """Like matmul but accumulating to f32 (the lm-head contract)."""
     if is_quantized(wt):
+        if _pallas_dequant_wanted(x, wt["q"]):
+            from kubeflow_tpu.ops import quant_matmul
+
+            return quant_matmul.dequant_matmul(x, wt["q"], wt["s"],
+                                               jnp.float32)
         out = jnp.einsum("...d,dv->...v", x, wt["q"].astype(dtype),
                          preferred_element_type=jnp.float32)
         return out * wt["s"]

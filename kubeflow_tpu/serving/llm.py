@@ -1443,12 +1443,18 @@ class LLMEngine:
         if self._pending is not None:
             # if the in-flight chunk's GUARANTEED deliveries (steps tokens
             # per continuing slot; spec rounds deliver at least one each)
-            # already satisfy every active budget, another dispatch would
-            # be pure junk compute — drain instead (this is what makes the
-            # final chunk of a drain free under pipelining)
+            # already satisfy every active budget, OR the cache has no
+            # room for even one more row past the in-flight writes (the
+            # out_of_room finish will land at replay), another dispatch
+            # would be pure junk compute — drain instead (this is what
+            # makes the final chunk of a drain free under pipelining)
             psr, psteps, _, _ = self._pending
-            if all(self._max_new[r] - len(self._results[r]) <= psteps
-                   for r in psr if r >= 0 and r in self._max_new):
+            full = max((int(self._host_lengths[s] + self._inflight[s])
+                        for s in range(self.n_slots) if psr[s] >= 0),
+                       default=0) >= self.max_len
+            if full or all(
+                    self._max_new[r] - len(self._results[r]) <= psteps
+                    for r in psr if r >= 0 and r in self._max_new):
                 self._drain_pending()
                 return
         slot_req = [self.scheduler.slot_request(s)

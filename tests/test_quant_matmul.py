@@ -1,0 +1,63 @@
+"""Numerics for the experimental fused int8-dequant Pallas kernel
+(ops/quant_matmul.py), exercised via the interpreter on the CPU mesh —
+the same FORCE_INTERPRET pattern as the flash kernels."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops import quant, quant_matmul
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    quant_matmul.FORCE_INTERPRET = True
+    yield
+    quant_matmul.FORCE_INTERPRET = False
+
+
+@pytest.mark.parametrize("m,d,o", [
+    (4, 512, 384),     # decode batch, lm-head-style 384-block o
+    (1, 256, 128),     # single slot, smallest blocks
+    (56, 1024, 512),   # spec-verify flattened rows
+])
+def test_kernel_matches_xla_dequant_path(m, d, o):
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(d, o)).astype(np.float32) / d ** 0.5
+    wt = quant.quantize_int8(jnp.asarray(w))
+    x = jnp.asarray(rng.normal(size=(m, d)), jnp.bfloat16)
+    ref = ((x @ wt["q"].astype(jnp.bfloat16)).astype(jnp.float32)
+           * wt["s"]).astype(jnp.bfloat16)
+    got = quant_matmul.dequant_matmul(x, wt["q"], wt["s"], jnp.bfloat16)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    scale = float(jnp.max(jnp.abs(ref.astype(jnp.float32)))) or 1.0
+    assert err / scale < 0.02, (m, d, o, err, scale)
+
+
+def test_quant_matmul_routes_through_kernel_under_force_interpret():
+    """quant.matmul's gate sends decode-shaped quantized matmuls through
+    the kernel when FORCE_INTERPRET is on (the CI stand-in for the TPU
+    opt-in), including the leading-batch reshape and f32 lm-head path."""
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(256, 384)).astype(np.float32) / 16.0
+    wt = quant.quantize_int8(jnp.asarray(w))
+    x = jnp.asarray(rng.normal(size=(2, 3, 256)), jnp.bfloat16)
+    ref = ((x @ wt["q"].astype(jnp.bfloat16)).astype(jnp.float32)
+           * wt["s"]).astype(jnp.bfloat16)
+    got = quant.matmul(x, wt, jnp.bfloat16)
+    assert got.shape == ref.shape
+    assert float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                 - ref.astype(jnp.float32)))) < 0.05
+    ref32 = jnp.einsum("...d,dv->...v", x, wt["q"].astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32) * wt["s"]
+    got32 = quant.matmul_f32_out(x, wt, jnp.bfloat16)
+    assert got32.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(got32 - ref32))) < 0.05
+
+
+def test_kernel_gate_declines_unsupported_shapes():
+    assert not quant_matmul.kernel_applicable(256, 4096, 14336)  # big m
+    assert not quant_matmul.kernel_applicable(4, 100, 384)       # ragged d
+    assert not quant_matmul.kernel_applicable(4, 512, 100)       # ragged o
+    assert quant_matmul.kernel_applicable(4, 4096, 128256)       # lm head
