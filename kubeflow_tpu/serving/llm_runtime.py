@@ -42,6 +42,7 @@ class LLMModel(Model):
                  mesh: dict[str, int] | None = None,
                  tokenizer: str | None = None,
                  prefix_cache: bool = False, max_prefixes: int = 4,
+                 decode_chunk: int = 8,
                  quantize: str | None = None,
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
@@ -69,6 +70,7 @@ class LLMModel(Model):
         self._checkpoint = checkpoint or uri
         self._prefix_cache = prefix_cache
         self._max_prefixes = max_prefixes
+        self._decode_chunk = decode_chunk
         self._quantize = quantize
         self._kv_quantize = kv_quantize
         self._speculative = speculative
@@ -165,6 +167,7 @@ class LLMModel(Model):
                                  max_len=self._max_len,
                                  buckets=self._buckets, eos_id=self._eos_id,
                                  mesh=mesh,
+                                 decode_chunk=self._decode_chunk,
                                  prefix_cache=self._prefix_cache,
                                  max_prefixes=self._max_prefixes,
                                  quantize=self._quantize,

@@ -459,3 +459,120 @@ def test_cache_room_respected_with_inflight_chunk(tiny):
     # (an emitted token's row is only written by the step that consumes
     # it) must stop at the cache edge — same count as the serial engine
     assert 5 + len(eng.result(rid)) - 1 <= 24
+
+
+# -- OpenAI HTTP surface for the sampling fields -----------------------------
+
+@pytest.fixture(scope="module")
+def sampling_server(tiny):
+    """One server whose engine has top-N logprobs enabled (module scope:
+    load+warmup is the expensive part)."""
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.server import ModelServer
+
+    _, cfg = tiny
+    m = LLMModel("llm", model={k: getattr(cfg, k) for k in
+                               ("vocab_size", "d_model", "n_layers",
+                                "n_heads", "n_kv_heads", "d_ff",
+                                "max_seq_len", "attention_impl", "remat")},
+                 n_slots=2, max_len=64, buckets=(8, 16), seed=0,
+                 logprobs_topk=3)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    yield server
+    server.stop()
+    m.unload()
+
+
+def _post(server, body):
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+    conn.request("POST", "/openai/v1/completions", body=_json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = _json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def test_openai_sampling_fields_roundtrip(sampling_server):
+    """top_k/top_p/logprobs through the HTTP dataplane: top_k=1 forces
+    greedy, and logprobs=N returns per-token logprobs + top-N dicts whose
+    best entry is the chosen token."""
+    code, greedy = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 4})
+    assert code == 200
+    code, out = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 4,
+        "temperature": 1.7, "top_k": 1, "top_p": 0.9, "logprobs": 3})
+    assert code == 200
+    choice = out["choices"][0]
+    assert choice["token_ids"] == greedy["choices"][0]["token_ids"]
+    lp = choice["logprobs"]
+    assert len(lp["token_logprobs"]) == 4
+    assert all(v <= 0 for v in lp["token_logprobs"])
+    for tok, top in zip(choice["token_ids"], lp["top_logprobs"]):
+        assert len(top) == 3
+        assert max(top, key=top.get) == str(tok)
+
+
+def test_openai_sampling_field_validation(sampling_server):
+    bad = [
+        {"top_k": -1}, {"top_k": 10_000}, {"top_k": "many"},
+        {"top_p": 0}, {"top_p": 1.5}, {"top_p": "most"},
+        {"logprobs": 4},            # engine built with logprobs_topk=3
+        {"stop": ["a"] * 9},        # too many sequences
+        {"stop": 7}, {"timeout": 0},
+    ]
+    for extra in bad:
+        code, out = _post(sampling_server, {
+            "model": "llm", "prompt": "Hi", "max_tokens": 2, **extra})
+        assert code == 400, (extra, out)
+    # logprobs=true (no top-N) is fine even at engine cap 0..3
+    code, out = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 2, "logprobs": True})
+    assert code == 200
+    assert "top_logprobs" not in out["choices"][0]["logprobs"]
+
+
+def test_openai_stop_string_over_http(sampling_server, tiny):
+    """A stop STRING is tokenizer-encoded and trimmed from the output
+    (byte tokenizer: exact token-aligned matching)."""
+    params, cfg = tiny
+    prompt_ids = [ord(c) for c in "Hi"]
+    greedy = _ref_generate(params, cfg, prompt_ids, 8)
+    stop_text = "".join(chr(t) for t in greedy[2:4])
+    code, out = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 8,
+        "stop": stop_text})
+    assert code == 200
+    choice = out["choices"][0]
+    assert choice["token_ids"] == greedy[:2]
+    assert choice["finish_reason"] == "stop"
+
+
+def test_8b_serving_example_config_surface():
+    """examples/llama-8b-serving-isvc.yaml: every config key is a real
+    LLMModel knob (a typo'd example would silently fall into **_ignored),
+    and the documented values construct an LLMModel cleanly (__init__ is
+    jax-free; nothing loads)."""
+    import inspect
+    import pathlib
+
+    import yaml
+
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "examples" / "llama-8b-serving-isvc.yaml")
+    spec = yaml.safe_load(path.read_text())
+    config = spec["spec"]["predictor"]["model"]["config"]
+    params = inspect.signature(LLMModel.__init__).parameters
+    unknown = set(config) - set(params)
+    assert not unknown, f"example uses unknown config keys: {unknown}"
+    m = LLMModel("example", **config)
+    assert m._n_slots == 16 and m._decode_chunk == 8
