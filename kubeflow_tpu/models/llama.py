@@ -515,64 +515,91 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
     rows = jnp.arange(b)
     positions = lengths[:, None] + jnp.arange(s_v)[None]  # [B, S_v]
     k_pos = jnp.arange(span)
-    # query i (position lengths+i) attends keys at k_pos <= lengths+i
-    mask = (k_pos[None, None, :] <= positions[:, :, None])[:, None]  # [B,1,Sv,span]
+    # query i (position lengths+i) attends keys at k_pos <= lengths+i;
+    # extra leading axes broadcast over (kv-head, group)
+    mask = (k_pos[None, None, None, :]
+            <= positions[:, None, :, None])  # [B, 1, Sv, span]
     # drop mode: inactive slots can carry lengths near max_len — their junk
     # writes must vanish, not clamp onto the last live row
     idx = (rows[:, None], positions)
+    nh, nkv = cfg.n_heads, cfg.n_kv_heads
+    g = nh // nkv
 
+    # The KV cache rides the scan as CARRY (not xs/ys): a per-layer
+    # dynamic-update-slice on the carried buffer updates S_v rows in
+    # place (XLA aliases while-loop carries), where stacked ys would
+    # re-write the ENTIRE cache every decode step — at 8B dims that is
+    # ~2 GiB of junk HBM write+read per step on the serving hot path.
     def body(carry, inp):
-        x = carry
+        x, cache_c = carry
         ll = None
         if lora is not None:
-            *inp, ll = inp
-        if quantized:
-            layer, ck, cv, cks, cvs = inp
+            layer, li, ll = inp
         else:
-            layer, ck, cv = inp  # ck/cv: [B, max_len, kv, hd]
+            layer, li = inp
         q, k_new, v_new = _project_qkv(cfg, layer, x, positions, ll, ids)
         if quantized:
             kq, ksc = quantize_kv(k_new)
             vq, vsc = quantize_kv(v_new)
-            ck = ck.at[idx].set(kq, mode="drop")
-            cv = cv.at[idx].set(vq, mode="drop")
-            cks = cks.at[idx].set(ksc, mode="drop")
-            cvs = cvs.at[idx].set(vsc, mode="drop")
-            k_att = dequantize_kv(
-                jax.lax.slice_in_dim(ck, 0, span, axis=1),
-                jax.lax.slice_in_dim(cks, 0, span, axis=1), cfg.dtype)
-            v_att = dequantize_kv(
-                jax.lax.slice_in_dim(cv, 0, span, axis=1),
-                jax.lax.slice_in_dim(cvs, 0, span, axis=1), cfg.dtype)
+            writes = {"k": kq, "v": vq, "k_s": ksc, "v_s": vsc}
         else:
-            ck = ck.at[idx].set(k_new.astype(ck.dtype), mode="drop")
-            cv = cv.at[idx].set(v_new.astype(cv.dtype), mode="drop")
-            k_att = jax.lax.slice_in_dim(ck, 0, span, axis=1)
-            v_att = jax.lax.slice_in_dim(cv, 0, span, axis=1)
-        nh, nkv = cfg.n_heads, cfg.n_kv_heads
-        kf = repeat_kv(k_att, nh // nkv)
-        vf = repeat_kv(v_att, nh // nkv)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf,
-                            preferred_element_type=jnp.float32)
-        logits *= 1.0 / (cfg.head_dim ** 0.5)
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+            writes = {"k": k_new.astype(cache_c["k"].dtype),
+                      "v": v_new.astype(cache_c["v"].dtype)}
+        cache_c = {
+            name: buf.at[(li,) + idx].set(writes[name], mode="drop")
+            for name, buf in cache_c.items()}
+        def layer_span(name):
+            # index the layer FIRST, then slice the span: the other order
+            # would stage an [L, B, span, ...] temp of the whole cache
+            return jax.lax.slice_in_dim(
+                jax.lax.dynamic_index_in_dim(cache_c[name], li, axis=0,
+                                             keepdims=False),
+                0, span, axis=1)
+
+        ck = layer_span("k")
+        cv = layer_span("v")
+        # grouped-query attention WITHOUT repeat_kv: q regroups to
+        # [B, kv, g, Sv, hd] and both einsums contract against the
+        # [B, span, kv, hd] cache directly — materializing the 4x
+        # head-expanded K/V (and, when quantized, a dequantized copy)
+        # would add GiB-scale HBM traffic per step at 8B dims. The int8
+        # cache dequant stays INSIDE the einsum operand (convert + scale
+        # fuse into the dot read); scales apply to the score/output
+        # instead of the payload where the algebra allows.
+        qg = jnp.moveaxis(q.reshape(b, s_v, nkv, g, cfg.head_dim), 1, 3)
+        if quantized:
+            att = jnp.einsum("bhgqd,bkhd->bhgqk", qg,
+                             ck.astype(cfg.dtype),
+                             preferred_element_type=jnp.float32)
+            cks = layer_span("k_s")   # [B, span, kv] f32
+            att = att * jnp.moveaxis(cks, -1, 1)[:, :, None, None, :]
+        else:
+            att = jnp.einsum("bhgqd,bkhd->bhgqk", qg, ck,
+                             preferred_element_type=jnp.float32)
+        att = att * (1.0 / (cfg.head_dim ** 0.5))
+        att = jnp.where(mask[:, :, None], att,
+                        jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        if quantized:
+            cvs = layer_span("v_s")
+            # v = vq * vs[..., None]: fold vs into probs' k axis so the
+            # int8 payload feeds the dot un-materialized
+            probs_s = probs * jnp.moveaxis(cvs, -1, 1)[
+                :, :, None, None, :].astype(probs.dtype)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs_s,
+                             cv.astype(cfg.dtype))
+        else:
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
         x = x + _wo(cfg, out.reshape(b, s_v, -1), layer, ll, ids)
         x = _serving_mlp(cfg, x, layer, ll, ids)
-        return x, ((ck, cv, cks, cvs) if quantized else (ck, cv))
+        return (x, cache_c), None
 
-    xs = ((params["layers"], cache["k"], cache["v"], cache["k_s"],
-           cache["v_s"]) if quantized
-          else (params["layers"], cache["k"], cache["v"]))
-    if lora is not None:
-        xs = xs + (lora,)
-    if quantized:
-        x, (ks, vs, kss, vss) = jax.lax.scan(body, x, xs)
-        new_cache = {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
-    else:
-        x, (ks, vs) = jax.lax.scan(body, x, xs)
-        new_cache = {"k": ks, "v": vs}
+    cache_keys = (("k", "v", "k_s", "v_s") if quantized else ("k", "v"))
+    cache_in = {name: cache[name] for name in cache_keys}
+    layer_idx = jnp.arange(cfg.n_layers)
+    xs = ((params["layers"], layer_idx, lora) if lora is not None
+          else (params["layers"], layer_idx))
+    (x, new_cache), _ = jax.lax.scan(body, (x, cache_in), xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits, new_cache

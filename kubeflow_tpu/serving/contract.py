@@ -55,11 +55,13 @@ class _AbstractEngine:
     _choose = LLMEngine._choose
     _pack_out = LLMEngine._pack_out
     _out_cols = LLMEngine._out_cols
+    _constrain_cnt = LLMEngine._constrain_cnt
 
     def __init__(self, cfg: llama.LlamaConfig, kv_quantize: str | None = None,
                  *, n_slots: int = 0, max_len: int = 0,
                  speculative: int | None = None, adapters: bool = False):
         self.cfg = cfg
+        self.mesh = None
         self.kv_quantize = kv_quantize
         # spec mode swaps the decode program for _spec_decode and adapters
         # add a rank-r gathered bypass to every matmul; both variants are
@@ -70,7 +72,7 @@ class _AbstractEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.adapters = True if adapters else None
-        self._row_extra = 6 if adapters else 5
+        self._row_extra = 9 if adapters else 8
         # production sampler defaults (serving/llm.py __init__)
         self.sample_k_max = 64
         self.logprobs_topk = 0
@@ -163,14 +165,23 @@ def aot_serving_report(
         for name, sds in jax.eval_shape(
             lambda: llama.init_cache(cfg, n_slots, max_len,
                                      kv_quantize=kv_quantize)).items()}
+    # per-slot penalty counts ride the cache vocab-sharded over `tensor`,
+    # exactly the live engine's layout (_shard_over: _cnt_sh); the
+    # abstract engines get the same mesh + constraint so the lowered
+    # programs match production
+    cnt_sh = NamedSharding(mesh, P(None, "tensor"))
+    cache["cnt"] = jax.ShapeDtypeStruct((n_slots, cfg.vocab_size),
+                                        jnp.int32, sharding=cnt_sh)
+    eng.mesh, eng._cnt_sh = mesh, cnt_sh
     i32 = functools.partial(jax.ShapeDtypeStruct, dtype=jnp.int32,
                             sharding=repl)
     lengths, last = i32((n_slots,)), i32((n_slots,))
-    # per-slot sampling state [temperature, top_k, top_p]
-    samp = jax.ShapeDtypeStruct((n_slots, 3), jnp.float32, sharding=repl)
+    # per-slot sampling state [temperature, top_k, top_p, presence,
+    # frequency, seed]
+    samp = jax.ShapeDtypeStruct((n_slots, 6), jnp.float32, sharding=repl)
     key_sds = jax.eval_shape(lambda: jax.random.key(0))
     key = jax.ShapeDtypeStruct(key_sds.shape, key_sds.dtype, sharding=repl)
-    wave = i32((width, bucket + 5))
+    wave = i32((width, bucket + 8))
     active = jax.ShapeDtypeStruct((n_slots,), jnp.bool_, sharding=repl)
 
     prefill_lowered = jax.jit(
@@ -186,7 +197,7 @@ def aot_serving_report(
     # prefix-cache hit shape) and the LARGEST possible boundary
     # (p = max_len - bucket — the worst-peak program of the longest
     # admissible prompt), plus the extract feeding it.
-    cont_wave = i32((1, bucket + 5))
+    cont_wave = i32((1, bucket + 8))
 
     def cont_lower(p):
         kv_prefix = jax.ShapeDtypeStruct(
@@ -212,6 +223,7 @@ def aot_serving_report(
         spec_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
                                    n_slots=n_slots, max_len=max_len,
                                    speculative=speculative)
+        spec_eng.mesh, spec_eng._cnt_sh = mesh, cnt_sh
         spec_cache = dict(cache)
         spec_cache["hist"] = jax.ShapeDtypeStruct(
             (n_slots, max_len), jnp.int32, sharding=repl)
@@ -229,6 +241,7 @@ def aot_serving_report(
         ad_eng = _AbstractEngine(cfg, kv_quantize=kv_quantize,
                                  n_slots=n_slots, max_len=max_len,
                                  adapters=True)
+        ad_eng.mesh, ad_eng._cnt_sh = mesh, cnt_sh
         base_sds = init_sds
         lora = {}
         for t in ("wq", "wk", "wv", "wo"):
@@ -242,7 +255,7 @@ def aot_serving_report(
         ad_cache = dict(cache)
         ad_cache["aids"] = jax.ShapeDtypeStruct(
             (n_slots,), jnp.int32, sharding=repl)
-        ad_wave = i32((width, bucket + 6))
+        ad_wave = i32((width, bucket + 9))
         extra_lowered[f"adapter_prefill_a{n_adapters}_r{adapter_rank}"] = \
             jax.jit(ad_eng._prefill, donate_argnums=(1, 2, 3, 4, 5)).lower(
                 params, ad_cache, lengths, last, samp, key, ad_wave, lora)
@@ -262,6 +275,7 @@ def aot_serving_report(
                                        n_slots=n_slots, max_len=max_len,
                                        speculative=speculative,
                                        adapters=True)
+            both_eng.mesh, both_eng._cnt_sh = mesh, cnt_sh
             both_cache = dict(ad_cache)
             both_cache["hist"] = jax.ShapeDtypeStruct(
                 (n_slots, max_len), jnp.int32, sharding=repl)
@@ -274,7 +288,10 @@ def aot_serving_report(
                 params, both_cache, lengths, last, samp, key, active, lora)
 
     weight_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(params))
-    cache_bytes = sum(_leaf_device_bytes(l) for l in jax.tree.leaves(cache))
+    # KV bytes proper; the penalty-count buffer is auxiliary slot state,
+    # itemized separately so the KV accounting stays exact
+    cache_bytes = sum(_leaf_device_bytes(v) for n, v in cache.items()
+                      if n != "cnt")
     if speculative or n_adapters:
         # the worst-peak member of the BASE menu is the largest-boundary
         # continuation (cont_p_max); its spec/adapter variant — extra
@@ -285,6 +302,7 @@ def aot_serving_report(
                                     n_slots=n_slots, max_len=max_len,
                                     speculative=speculative,
                                     adapters=bool(n_adapters))
+        worst_eng.mesh, worst_eng._cnt_sh = mesh, cnt_sh
         worst_cache = dict(cache)
         if speculative:
             worst_cache["hist"] = jax.ShapeDtypeStruct(
@@ -292,7 +310,7 @@ def aot_serving_report(
         if n_adapters:
             worst_cache["aids"] = jax.ShapeDtypeStruct(
                 (n_slots,), jnp.int32, sharding=repl)
-        ex = 6 if n_adapters else 5
+        ex = 9 if n_adapters else 8
         worst_wave = i32((1, bucket + (p_max if speculative else 0) + ex))
         worst_prefix = jax.ShapeDtypeStruct(
             (cfg.n_layers, 1, p_max, cfg.n_kv_heads, cfg.head_dim),
@@ -327,6 +345,7 @@ def aot_serving_report(
         "n_adapters": n_adapters,
         "weight_bytes_per_device": weight_bytes,
         "kv_cache_bytes_per_device": cache_bytes,
+        "aux_state_bytes_per_device": _leaf_device_bytes(cache["cnt"]),
         "lowered": True,
     }
     if do_compile:

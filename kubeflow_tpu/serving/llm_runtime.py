@@ -321,6 +321,8 @@ class LLMModel(Model):
         byte/char tokenizer this is exact, for BPE a stop string spanning
         merge boundaries may not match token-aligned output (documented —
         the buffered path additionally truncates decoded TEXT)."""
+        from kubeflow_tpu.serving.protocol import ProtocolError
+
         if stop is None:
             return []
         if isinstance(stop, str):
@@ -337,6 +339,13 @@ class LLMModel(Model):
                 out.append([int(t) for t in s])
             else:
                 raise ValueError("stop entries must be strings or id lists")
+        for seq in out:
+            if len(seq) > 64:
+                # client-controllable input: the engine's own 1..64 bound
+                # raises a bare ValueError that the HTTP layer deliberately
+                # maps to 500; surface it as a 400 here instead
+                raise ProtocolError(
+                    "each stop sequence must encode to at most 64 tokens")
         return out
 
     def _submit(self, payload: Any) -> int:
@@ -355,10 +364,14 @@ class LLMModel(Model):
         # nondeterministically come back 200/"cancelled" instead
         deadline = float(payload.get("deadline_s")
                          or (self._timeout_s + 10.0))
+        seed = payload.get("seed")
         rid = self._engine.submit(
             prompt, max_new, temperature, adapter=adapter,
             top_k=int(payload.get("top_k", 0)),
             top_p=float(payload.get("top_p", 1.0)),
+            presence_penalty=float(payload.get("presence_penalty", 0.0)),
+            frequency_penalty=float(payload.get("frequency_penalty", 0.0)),
+            seed=None if seed is None else int(seed),
             stop=self._encode_stops(payload.get("stop")),
             deadline_s=deadline)
         self._wake.set()
@@ -434,6 +447,27 @@ class LLMModel(Model):
         built with logprobs_topk > 0, "top_logprobs"}."""
         rid = self._submit(payload)
         return self._wait(rid, full=True)
+
+    def complete_many(self, payloads: list) -> list[dict[str, Any]]:
+        """Buffered generation for a burst (the OpenAI n/best_of fan-out):
+        ALL requests submit before any wait, so the clones share prefill
+        waves and decode steps instead of serializing."""
+        rids: list[int] = []
+        out: list[dict[str, Any]] = []
+        try:
+            for p in payloads:
+                rids.append(self._submit(p))
+            for rid in rids:
+                out.append(self._wait(rid, full=True))
+        except BaseException:
+            # a failed submit or wait: cancel + abandon everything not yet
+            # drained (a _wait failure abandons its own rid; re-adding to
+            # the set is harmless)
+            for rid in rids[len(out):]:
+                self._engine.cancel(rid)
+                self._abandoned.add(rid)
+            raise
+        return out
 
     def _wait(self, rid: int, full: bool = False):
         deadline = time.monotonic() + self._timeout_s

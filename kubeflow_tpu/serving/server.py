@@ -274,6 +274,41 @@ class ModelServer:
         payload["logprobs_n"] = lp_n
         payload["top_k"] = top_k
         payload["top_p"] = top_p
+        # -- OpenAI long tail (⊘ kserve huggingfaceserver): penalties are
+        # logit edits INSIDE the compiled programs; seed makes sampling
+        # reproducible; n/best_of fan one request across decode slots;
+        # echo prepends the prompt to the completion
+        for fname in ("presence_penalty", "frequency_penalty"):
+            try:
+                v = float(body.get(fname, 0.0))
+            except (TypeError, ValueError):
+                raise ProtocolError(f"{fname} must be a number") from None
+            if not (math.isfinite(v) and -2 <= v <= 2):
+                raise ProtocolError(f"{fname} must be in [-2, 2]")
+            payload[fname] = v
+        seed = body.get("seed")
+        if seed is not None:
+            if not isinstance(seed, int) or isinstance(seed, bool) \
+                    or seed < 0:
+                raise ProtocolError("seed must be a non-negative integer")
+            payload["seed"] = seed
+        try:
+            n = int(body.get("n", 1))
+            best_of = int(body.get("best_of", n))
+        except (TypeError, ValueError):
+            raise ProtocolError("n/best_of must be integers") from None
+        if not 1 <= n <= 8:
+            raise ProtocolError("n must be 1..8")
+        if not n <= best_of <= 8:
+            raise ProtocolError("best_of must be n..8")
+        payload["n"] = n
+        payload["best_of"] = best_of
+        echo = body.get("echo", False)
+        if not isinstance(echo, bool):
+            raise ProtocolError("echo must be a boolean")
+        if echo and chat:
+            raise ProtocolError("echo is not supported for chat")
+        payload["echo"] = echo
         if body.get("timeout") is not None:
             try:
                 payload["deadline_s"] = float(body["timeout"])
@@ -301,26 +336,29 @@ class ModelServer:
         return (ProtocolError, ModelError, NotReadyError, PromptTooLong,
                 QueueFull)
 
-    def _completion(self, body: dict[str, Any], chat: bool = False
-                    ) -> tuple[int, dict[str, Any]]:
-        t0 = time.perf_counter()
-        try:
-            m, payload = self._completion_request(body, chat)
-            result = m.complete(payload)
-        except self._completion_exceptions() as e:
-            return self._completion_error(e)
-        self._observe(m.name, "completions", time.perf_counter() - t0)
+    def _build_choice(self, m, payload: dict[str, Any],
+                      result: dict[str, Any], index: int,
+                      chat: bool) -> dict[str, Any]:
+        """One OpenAI choice object from an engine result. With echo the
+        prompt tokens prepend the completion (prompt positions carry null
+        logprobs — prompt scoring is not computed; the static program
+        menu emits sampled-position logprobs only, documented)."""
         tokens, reason = result["token_ids"], result["finish_reason"]
-        text = m.tokenizer.decode(tokens)
-        choice: dict[str, Any] = {"index": 0, "token_ids": tokens,
+        prompt_ids = list(payload["prompt_tokens"])
+        echo = bool(payload.get("echo"))
+        out_tokens = (prompt_ids + tokens) if echo else tokens
+        text = m.tokenizer.decode(out_tokens)
+        choice: dict[str, Any] = {"index": index, "token_ids": out_tokens,
                                   "finish_reason": reason}
         if payload.get("want_logprobs"):
-            lp: dict[str, Any] = {"token_ids": tokens,
-                                  "token_logprobs": result["logprobs"]}
+            pad: list[Any] = [None] * len(prompt_ids) if echo else []
+            lp: dict[str, Any] = {
+                "token_ids": out_tokens,
+                "token_logprobs": pad + result["logprobs"]}
             n = payload.get("logprobs_n", 0)
             if n:
                 # JSON object keys are strings; ids stay exact as strings
-                lp["top_logprobs"] = [
+                lp["top_logprobs"] = pad + [
                     {str(t): v for t, v in sorted(
                         d.items(), key=lambda kv: -kv[1])[:n]}
                     for d in result["top_logprobs"]]
@@ -329,11 +367,47 @@ class ModelServer:
             choice["message"] = {"role": "assistant", "content": text}
         else:
             choice["text"] = text
+        return choice
+
+    def _completion(self, body: dict[str, Any], chat: bool = False
+                    ) -> tuple[int, dict[str, Any]]:
+        t0 = time.perf_counter()
+        try:
+            m, payload = self._completion_request(body, chat)
+            best_of = payload.get("best_of", 1)
+            if best_of <= 1:
+                results = [m.complete(payload)]
+            else:
+                # fan the request across decode slots: best_of clones
+                # share the continuous batch (seeded requests salt the
+                # seed per clone so the samples differ reproducibly)
+                seed = payload.get("seed")
+                clones = [dict(payload) if seed is None
+                          else dict(payload, seed=seed + i)
+                          for i in range(best_of)]
+                results = m.complete_many(clones)
+        except self._completion_exceptions() as e:
+            return self._completion_error(e)
+        self._observe(m.name, "completions", time.perf_counter() - t0)
+        n_choices = payload.get("n", 1)
+        if len(results) > 1:
+            # OpenAI best_of: return the n best by per-token logprob
+            def score(r):
+                lps = r["logprobs"]
+                return sum(lps) / max(1, len(lps))
+
+            results = sorted(results, key=score, reverse=True)
+        gen_tokens = sum(len(r["token_ids"]) for r in results)
+        choices = [self._build_choice(m, payload, r, i, chat)
+                   for i, r in enumerate(results[:n_choices])]
         return 200, {
             "object": "chat.completion" if chat else "text_completion",
-            "model": m.name, "choices": [choice],
+            "model": m.name, "choices": choices,
+            # completion_tokens counts EVERY generated token (including
+            # best_of candidates that were not returned) — the tokens the
+            # accelerator actually produced
             "usage": {"prompt_tokens": len(payload["prompt_tokens"]),
-                      "completion_tokens": len(tokens)}}
+                      "completion_tokens": gen_tokens}}
 
     def _stream_completion(self, handler, body: dict[str, Any],
                            chat: bool = False) -> None:
@@ -348,6 +422,9 @@ class ModelServer:
         finish: list[str] = []
         try:
             m, payload = self._completion_request(body, chat)
+            if payload.get("best_of", 1) > 1 or payload.get("n", 1) > 1:
+                raise ProtocolError(
+                    "streaming supports n=1 / best_of=1 only")
             # m.stream submits eagerly: PromptTooLong/QueueFull raise HERE,
             # before the 200 + SSE headers are committed
             token_iter = m.stream(payload, on_finish=finish.append)
@@ -385,6 +462,11 @@ class ModelServer:
 
         try:   # everything after the headers: a disconnect anywhere here
                # must not fall back to do_POST's JSON 500 on this socket
+            if payload.get("echo"):
+                # echo streams the prompt text as the first chunk
+                handler.wfile.write(chunk_of(
+                    m.tokenizer.decode(list(payload["prompt_tokens"]))))
+                handler.wfile.flush()
             try:
                 for tok, lp in token_iter:
                     handler.wfile.write(chunk_of(

