@@ -63,6 +63,11 @@ class LlamaConfig:
     # models this removes the scan's residual-stacking dynamic-update-slice
     # traffic (profiled at ~20% of the train step at L8/d2048: +3 MFU pts)
     scan_layers: bool = True
+    # >0: sequence-chunked cross-entropy — lm_head + log-softmax run per
+    # ce_chunk tokens under jax.checkpoint so the full [B, S, vocab] f32
+    # logits never materialize (the seq-32k single-chip memory wall);
+    # 0 = whole-sequence CE (faster at short seq, same numbers)
+    ce_chunk: int = 0
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
@@ -237,7 +242,7 @@ def _layer_body(cfg: LlamaConfig, carry, layer, positions, segment_ids):
     return x, None
 
 
-def apply(
+def apply_hidden(
     params: Params,
     tokens: jax.Array,
     cfg: LlamaConfig,
@@ -245,7 +250,10 @@ def apply(
     positions: jax.Array | None = None,
     segment_ids: jax.Array | None = None,
 ) -> jax.Array:
-    """Forward pass: [B, S] int tokens -> [B, S, vocab] fp32 logits."""
+    """Forward pass up to (and including) the final norm: [B, S] int
+    tokens -> [B, S, d_model] activations, no lm_head projection. The
+    chunked-CE loss path projects per sequence chunk so the [B, S, vocab]
+    f32 logits never materialize whole (the 32k-context memory wall)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.arange(s)
@@ -266,7 +274,20 @@ def apply(
             layer = jax.tree.map(lambda p: p[i], params["layers"])
             x, _ = body(x, layer)
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def apply(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Forward pass: [B, S] int tokens -> [B, S, vocab] fp32 logits."""
+    x = apply_hidden(params, tokens, cfg, positions=positions,
+                     segment_ids=segment_ids)
     logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
     return logits
 
@@ -286,6 +307,8 @@ def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
         return pipelined_llama_loss(params, batch, cfg, mesh,
                                     cfg.pipeline_microbatches or None)
     tokens = batch["tokens"]
+    if cfg.ce_chunk:
+        return _chunked_ce_loss(params, batch, cfg)
     # Forward on the FULL sequence, shift logits afterwards: S-1 wouldn't
     # divide a `sequence` mesh axis, and the slice lives in GSPMD-land where
     # resharding is legal (the shard_map attention islands only ever see S).
@@ -300,6 +323,52 @@ def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: LlamaConfig):
     total = jnp.sum(token_loss * mask)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     return total / denom, {"loss": total / denom, "tokens": jnp.sum(mask)}
+
+
+def _chunked_ce_loss(params: Params, batch: dict[str, jax.Array],
+                     cfg: LlamaConfig):
+    """Sequence-chunked cross-entropy (cfg.ce_chunk > 0): the lm_head
+    projection + log-softmax run per ce_chunk-token slice under
+    jax.checkpoint, so only ONE [B, C, vocab] f32 logits block is ever
+    live (fwd AND bwd) instead of the whole [B, S, vocab] — at seq 32768
+    x vocab 32000 the whole-sequence block is ~4 GiB x several copies,
+    the single-chip long-context memory wall. Numerically the same loss
+    as the plain path (parity-tested); requires S % ce_chunk == 0."""
+    tokens = batch["tokens"]
+    b, s = tokens.shape
+    c = cfg.ce_chunk
+    if s % c:
+        raise ValueError(f"seq_len {s} must divide by ce_chunk {c}")
+    h = apply_hidden(params, tokens, cfg,
+                     positions=jnp.arange(s),
+                     segment_ids=batch.get("segment_ids"))
+    # targets roll left; the final position is masked off (no target)
+    targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    valid = jnp.ones((b, s), jnp.float32).at[:, -1].set(0.0)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        # plain path indexes loss_mask by TARGET position (mask[:, 1:])
+        valid = valid * jnp.concatenate(
+            [mask[:, 1:].astype(jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+    n_chunks = s // c
+    xs = (jnp.moveaxis(h.reshape(b, n_chunks, c, -1), 1, 0),
+          jnp.moveaxis(targets.reshape(b, n_chunks, c), 1, 0),
+          jnp.moveaxis(valid.reshape(b, n_chunks, c), 1, 0))
+
+    @jax.checkpoint
+    def chunk(carry, inp):
+        hc, tc, vc = inp
+        logits = quant.matmul_f32_out(hc, params["lm_head"], cfg.dtype)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tl = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        total, denom = carry
+        return (total + jnp.sum(tl * vc), denom + jnp.sum(vc)), None
+
+    (total, denom), _ = jax.lax.scan(chunk, (jnp.float32(0.0),
+                                             jnp.float32(0.0)), xs)
+    denom = jnp.maximum(denom, 1.0)
+    return total / denom, {"loss": total / denom, "tokens": denom}
 
 
 # ---------------------------------------------------------------------------

@@ -27,6 +27,11 @@ class ResNetConfig:
     groups: int = 32
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # synthetic-data pipeline knob (training/data.for_model): convs are
+    # size-agnostic, so this only picks the image resolution jobs train
+    # on — 64 keeps tests/toy sweeps fast, 224 is the true-geometry
+    # ResNet-50 setting (scripts/baseline_sweep.py --resnet50)
+    image_size: int = 64
 
     @staticmethod
     def resnet50(n_classes: int = 1000) -> "ResNetConfig":

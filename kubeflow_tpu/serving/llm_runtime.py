@@ -294,26 +294,31 @@ class LLMModel(Model):
 
     def predict(self, payload: Any) -> Any:
         if isinstance(payload, list):
-            # submit the whole batch first so requests share decode steps
-            rids: list[int] = []
-            out: list[dict[str, Any]] = []
-            try:
-                for p in payload:
-                    rids.append(self._submit(p))
-                for rid in rids:
-                    out.append({"output_tokens": self._wait(rid)})
-            except BaseException:
-                if len(rids) == len(payload):
-                    # a _wait failed: it abandoned its own rid; abandon the
-                    # not-yet-waited rest
-                    self._abandoned.update(rids[len(out) + 1:])
-                else:
-                    # a submit failed: nothing was waited on — abandon every
-                    # rid that did get into the engine
-                    self._abandoned.update(rids)
-                raise
-            return out
+            return [{"output_tokens": r["token_ids"]}
+                    for r in self._submit_wait_all(payload)]
         return {"output_tokens": self._wait(self._submit(payload))}
+
+    def _submit_wait_all(self, payloads: list) -> list[dict[str, Any]]:
+        """Burst primitive shared by predict() and complete_many(): ALL
+        requests submit before any wait, so they share prefill waves and
+        decode steps instead of serializing. On any failure, everything
+        not yet drained is cancelled (frees its decode slot at the next
+        chunk boundary) and abandoned (the engine loop releases it)."""
+        rids: list[int] = []
+        out: list[dict[str, Any]] = []
+        try:
+            for p in payloads:
+                rids.append(self._submit(p))
+            for rid in rids:
+                out.append(self._wait(rid, full=True))
+        except BaseException:
+            # a failed _wait abandons its own rid too; cancelling it again
+            # is a no-op and re-adding to the set is harmless
+            for rid in rids[len(out):]:
+                self._engine.cancel(rid)
+                self._abandoned.add(rid)
+            raise
+        return out
 
     def _encode_stops(self, stop: Any) -> list[list[int]]:
         """OpenAI `stop` (a string, a list of strings, or token-id lists)
@@ -328,7 +333,7 @@ class LLMModel(Model):
         if isinstance(stop, str):
             stop = [stop]
         if not isinstance(stop, list):
-            raise ValueError("stop must be a string or a list")
+            raise ProtocolError("stop must be a string or a list")
         out: list[list[int]] = []
         for s in stop:
             if isinstance(s, str):
@@ -338,14 +343,17 @@ class LLMModel(Model):
             elif isinstance(s, list):
                 out.append([int(t) for t in s])
             else:
-                raise ValueError("stop entries must be strings or id lists")
-        for seq in out:
-            if len(seq) > 64:
-                # client-controllable input: the engine's own 1..64 bound
-                # raises a bare ValueError that the HTTP layer deliberately
-                # maps to 500; surface it as a 400 here instead
                 raise ProtocolError(
-                    "each stop sequence must encode to at most 64 tokens")
+                    "stop entries must be strings or id lists")
+        # client-controllable input: the engine's own bounds raise bare
+        # ValueErrors that the HTTP layer deliberately maps to 500;
+        # surface every violation as a 400 here instead
+        if len(out) > 8:
+            raise ProtocolError("at most 8 stop sequences per request")
+        for seq in out:
+            if not 1 <= len(seq) <= 64:
+                raise ProtocolError(
+                    "each stop sequence must encode to 1..64 tokens")
         return out
 
     def _submit(self, payload: Any) -> int:
@@ -449,25 +457,9 @@ class LLMModel(Model):
         return self._wait(rid, full=True)
 
     def complete_many(self, payloads: list) -> list[dict[str, Any]]:
-        """Buffered generation for a burst (the OpenAI n/best_of fan-out):
-        ALL requests submit before any wait, so the clones share prefill
-        waves and decode steps instead of serializing."""
-        rids: list[int] = []
-        out: list[dict[str, Any]] = []
-        try:
-            for p in payloads:
-                rids.append(self._submit(p))
-            for rid in rids:
-                out.append(self._wait(rid, full=True))
-        except BaseException:
-            # a failed submit or wait: cancel + abandon everything not yet
-            # drained (a _wait failure abandons its own rid; re-adding to
-            # the set is harmless)
-            for rid in rids[len(out):]:
-                self._engine.cancel(rid)
-                self._abandoned.add(rid)
-            raise
-        return out
+        """Buffered generation for a burst (the OpenAI n/best_of
+        fan-out); see _submit_wait_all."""
+        return self._submit_wait_all(payloads)
 
     def _wait(self, rid: int, full: bool = False):
         deadline = time.monotonic() + self._timeout_s

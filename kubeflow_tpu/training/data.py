@@ -115,7 +115,8 @@ def for_model(model: str, model_cfg, batch_size: int, seq_len: int = 128,
     if model == "mnist_cnn":
         return synthetic_images(batch_size, 28, 1, model_cfg.n_classes, seed)
     if model == "resnet":
-        return synthetic_images(batch_size, 64, 3, model_cfg.n_classes, seed)
+        return synthetic_images(batch_size, model_cfg.image_size, 3,
+                                model_cfg.n_classes, seed)
     if model in ("nas_cnn", "darts_supernet", "vit"):
         return synthetic_images(batch_size, model_cfg.image_size,
                                 model_cfg.in_channels, model_cfg.n_classes,

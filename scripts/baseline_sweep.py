@@ -10,10 +10,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import tempfile
 import time
 
-from kubeflow_tpu import hpo
+# runnable as `python scripts/baseline_sweep.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu import hpo  # noqa: E402
 from kubeflow_tpu.control import Cluster, JAXJobController, new_resource
 from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
                                              is_finished)
@@ -24,15 +29,21 @@ def main() -> int:
     ap.add_argument("--trials", type=int, default=32)
     ap.add_argument("--parallel", type=int, default=4)
     ap.add_argument("--steps", type=int, default=25)
+    ap.add_argument("--resnet50", action="store_true",
+                    help="true ResNet-50 geometry ([3,4,6,3] x width-64, "
+                         "synthetic 224x224 batches) instead of the "
+                         "width-8 toy (VERDICT r4 ask #10)")
     args = ap.parse_args()
 
+    overrides = ('{"n_classes": 10, "image_size": 224}' if args.resnet50
+                 else '{"n_classes": 10, "stage_sizes": [1, 1], '
+                      '"width": 8, "groups": 4}')
     trainer_cfg = (
         '{"model": "resnet", '
-        '"model_overrides": {"n_classes": 10, "stage_sizes": [1, 1], '
-        '"width": 8, "groups": 4}, '
+        '"model_overrides": %s, '
         '"batch_size": 16, "num_steps": %d, "log_every": 5, '
         '"optimizer": {"learning_rate": ${trialParameters.lr}, '
-        '"weight_decay": ${trialParameters.wd}}}' % args.steps)
+        '"weight_decay": ${trialParameters.wd}}}' % (overrides, args.steps))
 
     exp = new_resource("Experiment", "resnet-sweep", spec={
         "objective": {"type": "minimize", "objectiveMetricName": "loss"},
@@ -75,7 +86,11 @@ def main() -> int:
           and done["status"].get("trials", {}).get("succeeded", 0) > 0
           and opt.get("objectiveValue") is not None)
     print(json.dumps({
-        "metric": f"katib_sweep_{args.trials}_trials",
+        "metric": (f"katib_sweep_resnet50_{args.trials}_trials"
+                   if args.resnet50
+                   else f"katib_sweep_{args.trials}_trials"),
+        "geometry": ("resnet50 [3,4,6,3] width-64 @224x224"
+                     if args.resnet50 else "toy [1,1] width-8 @64x64"),
         "value": round(dt, 1),
         "unit": "seconds",
         "succeeded": ok,

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Remat-policy x batch x depth sweep for the CONTRACT-geometry train MFU
+point (bench.mfu_8b_layer_bench): one (and a 2-layer scanned variant)
+true-dims Llama-3-8B layer (d4096/ff14336, GQA 32:8) at seq 8192 with the
+Pallas flash kernel, fwd+bwd+SGD on-chip. The winning config is hardcoded
+into bench.py with the sweep numbers in its comments (the same workflow
+scripts/mfu_sweep.py used for the 0.6B proxy headline)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time, jax, jax.numpy as jnp
+import numpy as np
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.training.mfu import mfu as mfu_fn
+
+seq = 8192
+def attempt(policy, batch, n_layers=1, scan_layers=False):
+    kw = dict(vocab_size=256, d_model=4096, n_layers=n_layers, n_heads=32,
+              n_kv_heads=8, d_ff=14336, max_seq_len=seq,
+              attention_impl="flash", scan_layers=scan_layers)
+    if policy == "none":
+        kw["remat"] = False
+    else:
+        kw["remat"] = True; kw["remat_policy"] = policy
+    cfg = llama.LlamaConfig(**kw)
+    params = llama.init(jax.random.key(0), cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16)
+                          if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, 256, jnp.int32)
+    @jax.jit
+    def step(p, toks):
+        def loss(pp):
+            return llama.loss_fn(pp, {"tokens": toks}, cfg)[0]
+        l, g = jax.value_and_grad(loss)(p)
+        return jax.tree.map(lambda w, gw: w - 1e-4*gw.astype(w.dtype), p, g), l
+    for _ in range(2):
+        params, l = step(params, tokens)
+    float(l)
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, l = step(params, tokens)
+    assert float(l) == float(l)
+    dt = (time.perf_counter()-t0)/n
+    flops = llama.flops_per_token(cfg, seq) * batch * seq
+    return mfu_fn(flops, dt, 1), dt
+
+for nl, scan in ((1, False), (2, True)):
+    for policy in ("none", "minimal", "full"):
+        for batch in ((8, 4, 2) if nl == 1 else (4, 2, 1)):
+            try:
+                m, dt = attempt(policy, batch, nl, scan)
+                print(f"L{nl} scan={scan} remat={policy} b{batch}: mfu={m:.4f} dt={dt:.3f}", flush=True)
+                break  # largest fitting batch per policy
+            except Exception as e:
+                print(f"L{nl} remat={policy} b{batch}: OOM/{type(e).__name__}", flush=True)

@@ -77,12 +77,16 @@ def test_scheduler_refills_freed_slot(cls):
 
 
 def test_native_matches_python_differential():
-    """Same random workload through both schedulers -> identical traces."""
+    """Same random workload through both schedulers -> identical traces.
+    The op mix includes cancel() on queued, active, finished, AND unknown
+    request ids (r4 advisor: the native cbs_cancel path must be exercised
+    against the Python oracle, not just asserted to exist)."""
     rng = np.random.default_rng(0)
     n = NativeScheduler(3, (8, 16, 32))
     p = PyScheduler(3, (8, 16, 32))
-    for _ in range(200):
-        op = rng.integers(0, 3)
+    rids: list[int] = []
+    for _ in range(400):
+        op = rng.integers(0, 4)
         if op == 0:
             plen = int(rng.integers(1, 40))
             mx = int(rng.integers(1, 4))
@@ -96,9 +100,18 @@ def test_native_matches_python_differential():
             except Exception as e:
                 rp = type(e).__name__
             assert rn == rp
+            if isinstance(rn, int):
+                rids.append(rn)
         elif op == 1:
             an, ap = n.next(), p.next()
             assert an == ap
+        elif op == 2:
+            # cancel a random known id (may be queued, active, or already
+            # finished/cancelled) or a never-issued one — return values
+            # and all subsequent next()/stats() behavior must match
+            rid = (int(rng.choice(rids)) if rids and rng.random() < 0.8
+                   else 999_999)
+            assert n.cancel(rid) == p.cancel(rid)
         else:
             st_n, st_p = n.stats(), p.stats()
             assert st_n == st_p

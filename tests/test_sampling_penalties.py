@@ -269,8 +269,12 @@ def test_http_long_tail_validation(server):
         {"presence_penalty": "x"}, {"seed": -4}, {"seed": "abc"},
         {"n": 0}, {"n": 9}, {"best_of": 9}, {"n": 3, "best_of": 2},
         {"echo": "yes"},
-        # stop string that tokenizes to > 64 tokens must be a 400, not 500
-        {"stop": "a" * 80},
+        # stop validations are client-controllable input: every violation
+        # must be a 400, never a 500 (the engine's bare ValueErrors are
+        # deliberately 500s)
+        {"stop": "a" * 80},          # encodes to > 64 tokens
+        {"stop": [[]]},              # empty token-id list
+        {"stop": [[1, 2], 5]},       # non-string/list entry
     ]
     for extra in bad:
         code, out = _post(server, {
