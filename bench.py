@@ -45,6 +45,21 @@ MEASURE = 10
 
 
 def main() -> None:
+    # serving_8b runs FIRST, in a fresh subprocess, BEFORE this process
+    # initializes its own JAX backend: the 32-slot engine peaks at
+    # ~13-14 GiB of the 16 GiB HBM, the chip is shared, and even a
+    # merely-ATTACHED second client costs enough reserved HBM to tip the
+    # child into RESOURCE_EXHAUSTED (measured: the child fits alone,
+    # fails with an idle parent attached). The child probes the platform
+    # itself and reports not_tpu when this is a CPU box.
+    serving_8b: dict | None = None
+    serving_8b_err: str | None = None
+    try:
+        serving_8b = _serving_8b_subprocess()
+        if serving_8b.get("not_tpu"):
+            serving_8b = None
+    except Exception as e:
+        serving_8b_err = f"{type(e).__name__}: {e}"
     n_dev = jax.local_device_count()
     on_tpu = "tpu" in str(jax.devices()[0].device_kind).lower()
     # Shape picked by scripts/mfu_sweep.py on TPU v5 lite: larger d_model
@@ -173,10 +188,16 @@ def main() -> None:
         extras["mfu_8b_layer"] = mfu_8b_layer_bench(on_tpu)
     except Exception as e:
         extras["mfu_8b_layer_error"] = f"{type(e).__name__}: {e}"
-    try:
-        extras["serving_8b"] = serving_8b_bench(on_tpu)
-    except Exception as e:
-        extras["serving_8b_error"] = f"{type(e).__name__}: {e}"
+    if on_tpu:
+        if serving_8b is not None:
+            extras["serving_8b"] = serving_8b
+        else:
+            extras["serving_8b_error"] = serving_8b_err
+    else:
+        try:
+            extras["serving_8b"] = serving_8b_bench(on_tpu)
+        except Exception as e:
+            extras["serving_8b_error"] = f"{type(e).__name__}: {e}"
     headline = {
         "metric": "llama_train_mfu",
         "value": round(achieved_mfu, 4),
@@ -221,8 +242,10 @@ PERF_FLOORS = {
     # standalone run (r3 2247, r4 regressed to 1571 — the junk-chunk bug
     # this floor exists to catch)
     "serving_saturation_tok_per_s": 275.0,   # r4: 285.8
-    "serving_8b_decode_tok_per_s": 700.0,    # r5: 778 plain (r4: 392.8)
-    "serving_8b_spec_tok_per_s": 1000.0,     # r5: 1162 at acceptance 1.95
+    "serving_8b_decode_tok_per_s": 950.0,    # r5: 1029 plain at 32 slots
+    # (r4: 392.8 at 16; the grouped-attention rewrite + 32-slot cache)
+    "serving_8b_spec_tok_per_s": 1400.0,     # r5: 1570 at 32 slots,
+    # 3 drafts, acceptance 1.95 (r4-era path: 254)
 }
 
 
@@ -772,6 +795,63 @@ def _init_llama_int8_serving(cfg, seed: int = 0):
 HBM_GBPS = 819.0
 
 
+def _serving_8b_subprocess() -> dict:
+    """Run serving_8b_bench in a FRESH process: at 32 slots the engine
+    needs ~13 GiB of the 16 GiB HBM, and the earlier bench sections'
+    compiled executables + allocator fragmentation in this process are
+    enough to tip it into RESOURCE_EXHAUSTED (observed). A clean process
+    reproduces the production condition — a serving engine owns its
+    chip."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import json, jax, bench\n"
+         "on = 'tpu' in str(jax.devices()[0].device_kind).lower()\n"
+         "out = bench.serving_8b_bench(True) if on else {'not_tpu': True}\n"
+         "print('RESULT ' + json.dumps(out))"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=1200)
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(
+        f"serving_8b subprocess rc={proc.returncode}: "
+        f"{proc.stderr[-500:]}")
+
+
+
+def _is_oom(e: Exception) -> bool:
+    """True for HBM exhaustion (walk-down-able); everything else — shape
+    bugs, compile failures — must surface with its original traceback."""
+    msg = f"{type(e).__name__}: {e}"
+    return ("RESOURCE_EXHAUSTED" in msg or "ResourceExhausted" in msg
+            or "Ran out of memory" in msg)
+
+
+def _build_engine_walkdown(params, cfg, slots_start: int, min_slots: int,
+                           **engine_kw):
+    """Build + warm an LLMEngine, halving n_slots on HBM exhaustion (a
+    fresh chip fits slots_start; a shared or fragmented one may not).
+    Returns (engine, n_slots). Non-OOM failures re-raise immediately."""
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    n_slots = slots_start
+    while True:
+        engine = None
+        try:
+            engine = LLMEngine(params, cfg, n_slots=n_slots, **engine_kw)
+            engine.warmup()
+            return engine, n_slots
+        except Exception as e:
+            if engine is not None:
+                engine.close()
+            if not _is_oom(e) or n_slots <= min_slots:
+                raise
+            n_slots //= 2
+
+
 def serving_8b_bench(on_tpu: bool) -> dict:
     """BASELINE config #5 at TRUE dims, LIVE on the chip (VERDICT r3 ask
     #1, r4 ask #1): Llama-3-8B geometry (d4096/L32/ff14336, GQA 32/8,
@@ -804,13 +884,17 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         gaps = (0.1, 0.05, 0.02)
     else:
         cfg = llama.LlamaConfig.llama3_8b()
-        # 16 slots: decode's 8.6 GiB weight read amortizes over 16
-        # concurrent sequences (cache 2.4 GiB int8 still fits beside the
-        # weights; 24+ slots fail to compile within HBM) — measured 202
-        # (4 slots) -> 307 (8) -> 397 tok/s (16)
-        n_slots, max_len, bucket = 16, 2048, 128
-        prompt_len, new_tokens, n_req = 100, 64, 24
-        # offered 2/4/8 req/s vs ~3 req/s service capacity at 64-token
+        # 32 slots: decode's ~7 GiB weight read amortizes over 32
+        # concurrent sequences. r4's ceiling was 16 (24+ failed to
+        # compile); the r5 grouped-attention + cache-carry rewrite freed
+        # the head-expanded/dequantized temps AND the whole-cache rewrite,
+        # so 32 x 2048 int8 KV (~4.1 GiB) now fits beside the weights
+        # (40+ still OOMs). Measured (live sustain): 775 tok/s at 16
+        # slots -> 1029 at 32; spec decode 1186 (16 slots, 6 drafts) ->
+        # 1570 (32 slots, 3 drafts) -> 1630 (2 drafts).
+        n_slots, max_len, bucket = 32, 2048, 128  # walk-down on OOM below
+        prompt_len, new_tokens, n_req = 100, 64, 32
+        # offered 2/4/8 req/s vs service capacity at 64-token
         # generations: the sweep brackets saturation from both sides
         gaps = (0.5, 0.25, 0.125)
     from kubeflow_tpu.serving.llm import LLMEngine
@@ -826,17 +910,17 @@ def serving_8b_bench(on_tpu: bool) -> dict:
     prompt = rng.integers(1, cfg.vocab_size,
                           size=(prompt_len,)).astype(int).tolist()
 
-    def sustain(engine) -> tuple[float, float]:
+    def sustain(engine, slots: int) -> tuple[float, float]:
         """All slots busy with long generations; returns (tok/s, s)."""
         rids = [engine.submit(prompt, new_tokens * 2)
-                for _ in range(n_slots)]
+                for _ in range(slots)]
         t0 = time.perf_counter()
         engine.run_until_idle()
         dt = time.perf_counter() - t0
         assert all(engine.is_done(r) for r in rids)
         for r in rids:
             engine.release(r)
-        return n_slots * new_tokens * 2 / dt, dt
+        return slots * new_tokens * 2 / dt, dt
 
     t0 = time.perf_counter()
     # Pipelined decode (the engine default): the next chunk dispatches
@@ -847,13 +931,12 @@ def serving_8b_bench(on_tpu: bool) -> dict:
     # 8: throughput is flat in chunk size once pipelined (8/16/32 all
     # ~200-204), and the shorter chunk halves the prefill's
     # drain-the-inflight-chunk wait, keeping TTFT low.
-    engine = LLMEngine(params, cfg, n_slots=n_slots, max_len=max_len,
-                       buckets=(bucket,), decode_chunk=8,
-                       kv_quantize="int8")
+    engine, n_slots = _build_engine_walkdown(
+        params, cfg, n_slots, 8, max_len=max_len, buckets=(bucket,),
+        decode_chunk=8, kv_quantize="int8")
     cache_bytes = sum(l.nbytes for l in jax.tree.leaves(engine.cache))
-    engine.warmup()
     warmup_s = time.perf_counter() - t0
-    decode_tps, _ = sustain(engine)
+    decode_tps, _ = sustain(engine, n_slots)
     # plain decode: one weight read per step, n_slots tokens per step
     steps_per_s = decode_tps / n_slots
     plain_roofline = steps_per_s * read_bytes / (HBM_GBPS * 1e9)
@@ -862,23 +945,35 @@ def serving_8b_bench(on_tpu: bool) -> dict:
     sweep = [_poisson_run(engine, prompt, new_tokens, n_req, g)
              for g in gaps]
     load = sweep[0]
+    engine.close()   # eager HBM release (the engine is cyclic; see close)
     del engine
 
     # speculative decode at 8B: same weights, same slots, verify-mode
-    # programs (spec+1 positions per weight read)
+    # programs (spec+1 positions per weight read). Draft count 3: the
+    # random-init model's measured acceptance is ~1.95/round at EVERY
+    # k in 2..6 (all acceptance is the bonus + ~1 draft), so small k
+    # wins — the verify forward carries k+1 query positions whose
+    # FLOPs/scatter costs grow with k (measured at 32 slots: k=2 1630,
+    # k=3 1570, k=4 1483, k=6 1259 tok/s). k=3 is the bench point: within
+    # 4% of k=2 here, with headroom if the served text is more
+    # predictable than random-weight cyclic decode. k is a per-engine
+    # knob (`speculative=`); acceptance is reported so the operating
+    # point stays honest.
     t0 = time.perf_counter()
-    spec_engine = LLMEngine(params, cfg, n_slots=n_slots, max_len=max_len,
-                            buckets=(bucket,), decode_chunk=8,
-                            kv_quantize="int8", speculative=6,
-                            spec_ngram=3)
-    spec_engine.warmup()
+    plain_slots = n_slots
+    # verify-program temps sit above plain decode's: the spec engine gets
+    # its own HBM walk-down
+    spec_engine, spec_slots = _build_engine_walkdown(
+        params, cfg, n_slots, 8, max_len=max_len, buckets=(bucket,),
+        decode_chunk=8, kv_quantize="int8", speculative=3, spec_ngram=3)
     spec_warmup_s = time.perf_counter() - t0
-    spec_tps, _ = sustain(spec_engine)
+    spec_tps, _ = sustain(spec_engine, spec_slots)
     m = spec_engine.metrics()
     acc = m.get("spec_tokens_per_round", 0.0)
     # spec roofline: one weight read per verify round, `acc` tokens/round
-    spec_rounds_per_s = spec_tps / (n_slots * max(acc, 1e-9))
+    spec_rounds_per_s = spec_tps / (spec_slots * max(acc, 1e-9))
     spec_roofline = spec_rounds_per_s * read_bytes / (HBM_GBPS * 1e9)
+    spec_engine.close()
     del spec_engine
 
     out = {
@@ -888,7 +983,8 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         "weight_gib": round(weight_bytes / 1024**3, 3),
         "weight_read_gib_per_step": round(read_bytes / 1024**3, 3),
         "kv_cache_gib": round(cache_bytes / 1024**3, 3),
-        "n_slots": n_slots, "max_len": max_len, "prefill_bucket": bucket,
+        "n_slots": plain_slots, "max_len": max_len,
+        "prefill_bucket": bucket,
         "warmup_s": round(warmup_s, 1),
         "decode_tok_per_s": round(decode_tps, 1),
         "roofline_frac": round(plain_roofline, 3),
@@ -901,7 +997,8 @@ def serving_8b_bench(on_tpu: bool) -> dict:
             "decode_tok_per_s": round(spec_tps, 1),
             "speedup_vs_plain": round(spec_tps / decode_tps, 2),
             "spec_tokens_per_round": acc,
-            "drafts_per_round": 6,
+            "n_slots": spec_slots,
+            "drafts_per_round": 3,
             "roofline_frac": round(spec_roofline, 3),
             "warmup_s": round(spec_warmup_s, 1),
         },

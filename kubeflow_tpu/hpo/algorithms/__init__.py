@@ -1,7 +1,7 @@
 """Suggestion algorithms (Katib suggestion-service analog, SURVEY.md §2.3).
 
 Importing this package registers: random, grid, sobol/quasirandom, hyperband,
-tpe, bayesianoptimization (alias: bayesian), cmaes, pbt.
+tpe, bayesianoptimization (alias: bayesian), cmaes, pbt, enas.
 """
 
 from kubeflow_tpu.hpo.algorithms.base import (Algorithm, TrialResult,
@@ -12,6 +12,7 @@ from kubeflow_tpu.hpo.algorithms import tpe as _tpe              # noqa: F401
 from kubeflow_tpu.hpo.algorithms import bayesian as _bayesian    # noqa: F401
 from kubeflow_tpu.hpo.algorithms import cmaes as _cmaes          # noqa: F401
 from kubeflow_tpu.hpo.algorithms import pbt as _pbt              # noqa: F401
+from kubeflow_tpu.hpo.algorithms import enas as _enas            # noqa: F401
 
 __all__ = ["Algorithm", "TrialResult", "algorithm_names", "make_algorithm",
            "register"]

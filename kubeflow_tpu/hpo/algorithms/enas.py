@@ -1,0 +1,126 @@
+"""ENAS-style reinforcement-learned architecture search (SURVEY.md §2.3,
+⊘ katib pkg/suggestion/v1beta1/nas ENAS suggestion service).
+
+Katib's ENAS keeps an LSTM controller in the suggestion pod: it samples one
+operation per layer, trials train a SHARED supernet with the sampled ops
+and report a reward, and the controller updates by REINFORCE. The analog
+here:
+
+  - **Controller**: a factorized per-parameter categorical policy — one
+    logits vector per (categorical) search parameter — updated by
+    REINFORCE with an exponential-moving-average baseline. Over the
+    layerwise `nasConfig` spaces Katib feeds ENAS, the factorized policy
+    expresses the same per-layer distributions the LSTM emits; the LSTM's
+    extra sequence coupling is dropped deliberately (it is the part of
+    ENAS that rarely changes the argmax architecture, and the policy
+    gradient is identical). The policy state is reconstructed from trial
+    history alone, so experiment resume (`resumePolicy: FromVolume`)
+    replays the updates deterministically.
+  - **Weight sharing**: trials are ordinary training jobs; pointing the
+    trial template's checkpoint directory at a SHARED location makes
+    every trial warm-start from the latest supernet weights through the
+    ordinary checkpoint/resume machinery (training/checkpoint.py) — the
+    job-based twin of ENAS's shared-supernet trick. The controller itself
+    is agnostic to whether trials share weights.
+
+Algorithms MINIMIZE (base.py convention), so the REINFORCE reward is the
+negated objective.
+
+    algorithm:
+      algorithmName: enas
+      algorithmSettings:
+        learning_rate: "0.25"      # policy-gradient step on the logits
+        baseline_decay: "0.7"      # EMA reward baseline
+        temperature: "1.0"         # sampling temperature on the logits
+        random_state: "0"
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.hpo.algorithms.base import (Algorithm, TrialResult,
+                                              register)
+from kubeflow_tpu.hpo.space import SpaceError
+
+
+@register("enas")
+class EnasAlgorithm(Algorithm):
+    """REINFORCE over a factorized categorical policy."""
+
+    def __init__(self, space, settings=None, seed: int = 0):
+        super().__init__(space, settings, seed)
+        self._cat = [p for p in space.parameters
+                     if p.type in ("categorical", "discrete")]
+        if not self._cat:
+            raise SpaceError(
+                "enas needs at least one categorical/discrete parameter "
+                "(expand a nasConfig, or use a numeric algorithm)")
+        # non-categorical co-parameters (e.g. a learning rate riding the
+        # same experiment) are sampled uniformly — the controller only
+        # learns the architecture choices
+        self._rest = [p for p in space.parameters if p not in self._cat]
+        self.lr = self._setting("learning_rate", 0.25)
+        self.baseline_decay = self._setting("baseline_decay", 0.7)
+        self.temperature = max(self._setting("temperature", 1.0), 1e-3)
+
+    # -- policy state, rebuilt from history every call ----------------------
+
+    def _fit(self, history: Sequence[TrialResult]):
+        """Replay REINFORCE over finished trials in order. Stateless
+        across calls by design: the policy is a pure function of history,
+        so controller state survives suggestion-service restarts without
+        any persisted volume."""
+        logits = {p.name: np.zeros(len(p.values)) for p in self._cat}
+        baseline = None
+        for t in self._finished(history):
+            reward = -t.value  # minimize -> reward is the negated loss
+            if baseline is None:
+                baseline = reward
+            adv = reward - baseline
+            baseline = (self.baseline_decay * baseline
+                        + (1 - self.baseline_decay) * reward)
+            for p in self._cat:
+                if p.name not in t.params:
+                    continue
+                try:
+                    idx = list(p.values).index(t.params[p.name])
+                except ValueError:
+                    continue  # param values edited mid-experiment
+                lg = logits[p.name]
+                probs = _softmax(lg / self.temperature)
+                # d/d_logits log pi(idx) = onehot(idx) - probs
+                grad = -probs
+                grad[idx] += 1.0
+                lg += self.lr * adv * grad
+        return logits
+
+    def suggest(self, count: int,
+                history: Sequence[TrialResult]) -> list[dict[str, Any]]:
+        logits = self._fit(history)
+        out = []
+        for _ in range(count):
+            params: dict[str, Any] = {}
+            for p in self._cat:
+                probs = _softmax(logits[p.name] / self.temperature)
+                params[p.name] = p.values[int(self.rng.choice(
+                    len(p.values), p=probs))]
+            for p in self._rest:
+                params[p.name] = p.sample(self.rng)
+            out.append(params)
+        return out
+
+    def best_architecture(self, history: Sequence[TrialResult]
+                          ) -> dict[str, Any]:
+        """The policy's argmax choice per parameter — ENAS's final derived
+        architecture (Katib surfaces it when the experiment completes)."""
+        logits = self._fit(history)
+        return {p.name: p.values[int(np.argmax(logits[p.name]))]
+                for p in self._cat}
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - np.max(x))
+    return e / e.sum()

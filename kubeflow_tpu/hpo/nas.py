@@ -12,6 +12,11 @@ model with the sampled ops.
 The differentiable path (DARTS supernet, models/nas_cnn.py) needs no
 experiment at all: one training job learns the op mixture directly.
 
+The reinforcement path (⊘ katib ENAS) is the `enas` suggestion algorithm
+(hpo/algorithms/enas.py): a REINFORCE-trained categorical policy samples
+architectures per trial; point the trial template's checkpoint dir at a
+shared location and trials warm-start from the shared supernet weights.
+
     spec:
       nasConfig:
         numLayers: 4

@@ -463,34 +463,47 @@ class LLMEngine:
                   - pres[:, None] * (counts > 0).astype(jnp.float32)
                   - freq[:, None] * counts.astype(jnp.float32))
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        # ONE categorical serves both modes: the filters reduce to a
-        # per-row probability THRESHOLD (the smallest admitted candidate's
-        # mass, from the sorted top-sample_k_max prefix), and rows with
-        # filters off get threshold 0 — the mask is then all-pass and the
-        # draw is BIT-IDENTICAL to an unfiltered categorical, so the
-        # "top_p=1/top_k=0 matches plain sampling" contract holds by
-        # construction, not by a second code path.
-        kmax = min(self.sample_k_max, logits.shape[-1])
-        probs = jax.nn.softmax(scaled, axis=-1)
-        top_vals, _ = jax.lax.top_k(probs, kmax)         # sorted desc
-        cum = jnp.cumsum(top_vals, axis=-1)
-        # admit candidate j while the mass BEFORE j is < p (p off => 2.0
-        # admits all) and j < top_k (off => kmax)
-        keep_p = (cum - top_vals) < jnp.where(
-            (topps > 0) & (topps < 1), topps, 2.0)[:, None]
-        kk = jnp.where(topks > 0, jnp.minimum(topks, kmax), kmax)
-        keep = keep_p & (jnp.arange(kmax)[None] < kk[:, None])
-        n_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
-        thr = jnp.take_along_axis(top_vals, n_keep[:, None] - 1,
-                                  axis=1)[:, 0]
-        use_filter = (topks > 0) | ((topps > 0) & (topps < 1))
-        thr = jnp.where(use_filter, thr, 0.0)
-        masked = jnp.where(probs >= thr[:, None], scaled, -jnp.inf)
-        sampled = jax.vmap(
-            lambda rk, row: jax.random.categorical(rk, row))(
-            row_keys, masked).astype(jnp.int32)
-        return key, jnp.where(temps > 0, sampled, greedy)
+
+        # The whole sampling pipeline (softmax + top_k window +
+        # categorical over the vocab) is gated behind lax.cond on "any
+        # row sampling": an all-greedy batch — the common serving case —
+        # skips it entirely, which at 8B vocab is a measurable slice of
+        # every decode step. The key chain advances BEFORE the cond
+        # (split above), so seeded determinism is branch-independent.
+        def sample_branch(logits):
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            # ONE categorical serves both modes: the filters reduce to a
+            # per-row probability THRESHOLD (the smallest admitted
+            # candidate's mass, from the sorted top-sample_k_max
+            # prefix), and rows with filters off get threshold 0 — the
+            # mask is then all-pass and the draw is BIT-IDENTICAL to an
+            # unfiltered categorical, so the "top_p=1/top_k=0 matches
+            # plain sampling" contract holds by construction, not by a
+            # second code path.
+            kmax = min(self.sample_k_max, logits.shape[-1])
+            probs = jax.nn.softmax(scaled, axis=-1)
+            top_vals, _ = jax.lax.top_k(probs, kmax)     # sorted desc
+            cum = jnp.cumsum(top_vals, axis=-1)
+            # admit candidate j while the mass BEFORE j is < p (p off =>
+            # 2.0 admits all) and j < top_k (off => kmax)
+            keep_p = (cum - top_vals) < jnp.where(
+                (topps > 0) & (topps < 1), topps, 2.0)[:, None]
+            kk = jnp.where(topks > 0, jnp.minimum(topks, kmax), kmax)
+            keep = keep_p & (jnp.arange(kmax)[None] < kk[:, None])
+            n_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+            thr = jnp.take_along_axis(top_vals, n_keep[:, None] - 1,
+                                      axis=1)[:, 0]
+            use_filter = (topks > 0) | ((topps > 0) & (topps < 1))
+            thr = jnp.where(use_filter, thr, 0.0)
+            masked = jnp.where(probs >= thr[:, None], scaled, -jnp.inf)
+            sampled = jax.vmap(
+                lambda rk, row: jax.random.categorical(rk, row))(
+                row_keys, masked).astype(jnp.int32)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        toks = jax.lax.cond(jnp.any(temps > 0), sample_branch,
+                            lambda _: greedy, logits)
+        return key, toks
 
     def _pack_out(self, toks, logits):
         """Program output row per sampled token: [tok, logprob(, top-N ids,
@@ -1335,6 +1348,24 @@ class LLMEngine:
         self._pending = None
         self._inflight[:] = 0
         self._warmed = True
+
+    def close(self) -> None:
+        """Release device state NOW. The engine is cyclic (compiled-
+        program dicts hold jit(partial(self._...)) objects that reference
+        the engine), so `del engine` alone leaves the KV cache + params
+        refs alive until a full gc pass — on a 16 GiB chip that is the
+        difference between the next engine fitting or not. close()
+        breaks the cycles and drops the big buffers eagerly."""
+        import gc
+
+        for d in (self._prefill_fns, self._decode_fns, self._spec_fns,
+                  self._cont_fns, self._extract_fns):
+            d.clear()
+        self._prefix_store.clear()
+        self._pending = None
+        self.cache = None
+        self.params = None
+        gc.collect()
 
     def is_done(self, req_id: int) -> bool:
         return req_id in self._done
