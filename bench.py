@@ -57,7 +57,12 @@ def main() -> None:
     try:
         serving_8b = _serving_8b_subprocess()
         if serving_8b.get("not_tpu"):
+            # on a TPU box this means the child could not see the chip
+            # (held by another process at child start) — say so rather
+            # than recording a bare null
             serving_8b = None
+            serving_8b_err = ("child saw no TPU (chip busy/unavailable "
+                              "at subprocess start, or a CPU box)")
     except Exception as e:
         serving_8b_err = f"{type(e).__name__}: {e}"
     n_dev = jax.local_device_count()
@@ -880,8 +885,8 @@ def serving_8b_bench(on_tpu: bool) -> dict:
             vocab_size=512, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
             d_ff=128, max_seq_len=256)
         n_slots, max_len, bucket = 2, 128, 16
-        prompt_len, new_tokens, n_req = 8, 8, 4
-        gaps = (0.1, 0.05, 0.02)
+        prompt_len, new_tokens = 8, 8
+        gaps = ((0.1, 4), (0.05, 4), (0.02, 4))
     else:
         cfg = llama.LlamaConfig.llama3_8b()
         # 32 slots: decode's ~7 GiB weight read amortizes over 32
@@ -893,14 +898,19 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         # slots -> 1029 at 32; spec decode 1186 (16 slots, 6 drafts) ->
         # 1570 (32 slots, 3 drafts) -> 1630 (2 drafts).
         n_slots, max_len, bucket = 32, 2048, 128  # walk-down on OOM below
-        prompt_len, new_tokens, n_req = 100, 64, 32
-        # offered 2/4/8 req/s vs service capacity at 64-token
-        # generations: the sweep brackets saturation from both sides
-        gaps = (0.5, 0.25, 0.125)
+        prompt_len, new_tokens = 100, 64
+        # offered 2 / 8 / 32 req/s (128 / 512 / 2048 tok/s of demand)
+        # vs ~1060 tok/s sustained decode capacity: the light point
+        # measures unloaded TTFT, the heavy point drives the engine past
+        # saturation so the sweep's top throughput IS the serving
+        # capacity under mixed prefill+decode (more requests at the
+        # heavier points so the measurement reaches steady state)
+        gaps = ((0.5, 24), (0.125, 32), (0.03125, 64))
     from kubeflow_tpu.serving.llm import LLMEngine
 
     import numpy as np
 
+    slots_start = n_slots
     params = _init_llama_int8_serving(cfg)
     weight_bytes = sum(l.nbytes for l in jax.tree.leaves(params))
     # decode re-reads every weight byte per step EXCEPT the embed table
@@ -942,8 +952,8 @@ def serving_8b_bench(on_tpu: bool) -> dict:
     plain_roofline = steps_per_s * read_bytes / (HBM_GBPS * 1e9)
     # open-loop Poisson saturation sweep (r4 weak #4: the flagship had a
     # single light-load point)
-    sweep = [_poisson_run(engine, prompt, new_tokens, n_req, g)
-             for g in gaps]
+    sweep = [_poisson_run(engine, prompt, new_tokens, nr, g)
+             for g, nr in gaps]
     load = sweep[0]
     engine.close()   # eager HBM release (the engine is cyclic; see close)
     del engine
@@ -960,7 +970,6 @@ def serving_8b_bench(on_tpu: bool) -> dict:
     # knob (`speculative=`); acceptance is reported so the operating
     # point stays honest.
     t0 = time.perf_counter()
-    plain_slots = n_slots
     # verify-program temps sit above plain decode's: the spec engine gets
     # its own HBM walk-down
     spec_engine, spec_slots = _build_engine_walkdown(
@@ -983,7 +992,13 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         "weight_gib": round(weight_bytes / 1024**3, 3),
         "weight_read_gib_per_step": round(read_bytes / 1024**3, 3),
         "kv_cache_gib": round(cache_bytes / 1024**3, 3),
-        "n_slots": plain_slots, "max_len": max_len,
+        "n_slots": n_slots, "max_len": max_len,
+        # True when the engines could not fit the configured operating
+        # point the floors assume (shared/fragmented chip): the record is
+        # still the authoritative latest hardware run, and the floor gate
+        # failing on it is the honest outcome — this flag says WHY
+        "walked_down": bool(n_slots < slots_start
+                            or spec_slots < slots_start),
         "prefill_bucket": bucket,
         "warmup_s": round(warmup_s, 1),
         "decode_tok_per_s": round(decode_tps, 1),
