@@ -43,28 +43,83 @@ BATCH = 6   # largest per-chip batch that fits HBM with unrolled layers +
 WARMUP = 3
 MEASURE = 10
 
+# -- bench self-defense (ROADMAP r6 item #1) ---------------------------------
+# BENCH_r05 and MULTICHIP_r05 both died rc=124: bench.py had no overall
+# time budget and the 8B child subprocess could outlive a killed parent on
+# the 1-core box, starving it. The budget is a hard wall-clock allowance
+# for the WHOLE bench run: each best-effort section checks it first and
+# records itself in extras["skipped_for_budget"] instead of running past
+# it, and the serving_8b child gets (a) its own timeout computed from the
+# REMAINING budget, (b) start_new_session so the parent can kill its whole
+# process group, and (c) an in-child watchdog that exits when the deadline
+# passes or the parent dies — an orphaned 8B child can never starve the
+# box again. The compact headline is ALWAYS the last stdout line.
+BUDGET_ENV = "KTPU_BENCH_BUDGET_S"
+DEFAULT_BUDGET_S = 2400.0
+#: wall-clock reserved for the headline train run + post-child extras when
+#: sizing the serving_8b child's timeout
+RESERVE_AFTER_CHILD_S = 900.0
+
+
+class Budget:
+    """Monotonic wall-clock budget; total from KTPU_BENCH_BUDGET_S unless
+    given explicitly."""
+
+    def __init__(self, total_s: float | None = None):
+        if total_s is None:
+            total_s = float(os.environ.get(BUDGET_ENV, DEFAULT_BUDGET_S))
+        self.total_s = total_s
+        self.t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining(self) -> float:
+        return self.total_s - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+def _budget_gate(extras: dict, budget: Budget, name: str) -> bool:
+    """True when `name` may still run; False records the skip so the
+    committed record says WHY a section is absent (a silently missing
+    section reads as a floor failure, which is the honest default — this
+    marker distinguishes 'out of time' from 'crashed')."""
+    if not budget.expired():
+        return True
+    extras.setdefault("skipped_for_budget", []).append(name)
+    return False
+
 
 def main() -> None:
+    budget = Budget()
     # serving_8b runs FIRST, in a fresh subprocess, BEFORE this process
     # initializes its own JAX backend: the 32-slot engine peaks at
     # ~13-14 GiB of the 16 GiB HBM, the chip is shared, and even a
     # merely-ATTACHED second client costs enough reserved HBM to tip the
     # child into RESOURCE_EXHAUSTED (measured: the child fits alone,
     # fails with an idle parent attached). The child probes the platform
-    # itself and reports not_tpu when this is a CPU box.
+    # itself and reports not_tpu when this is a CPU box. Its timeout
+    # comes from the REMAINING budget, leaving room for the headline run.
     serving_8b: dict | None = None
     serving_8b_err: str | None = None
-    try:
-        serving_8b = _serving_8b_subprocess()
-        if serving_8b.get("not_tpu"):
-            # on a TPU box this means the child could not see the chip
-            # (held by another process at child start) — say so rather
-            # than recording a bare null
-            serving_8b = None
-            serving_8b_err = ("child saw no TPU (chip busy/unavailable "
-                              "at subprocess start, or a CPU box)")
-    except Exception as e:
-        serving_8b_err = f"{type(e).__name__}: {e}"
+    child_timeout = min(1200.0, budget.remaining() - RESERVE_AFTER_CHILD_S)
+    if child_timeout < 60.0:
+        serving_8b_err = (f"skipped_for_budget: {budget.remaining():.0f}s "
+                          "remaining leaves no room for the 8B child")
+    else:
+        try:
+            serving_8b = _serving_8b_subprocess(child_timeout)
+            if serving_8b.get("not_tpu"):
+                # on a TPU box this means the child could not see the chip
+                # (held by another process at child start) — say so rather
+                # than recording a bare null
+                serving_8b = None
+                serving_8b_err = ("child saw no TPU (chip busy/unavailable "
+                                  "at subprocess start, or a CPU box)")
+        except Exception as e:
+            serving_8b_err = f"{type(e).__name__}: {e}"
     n_dev = jax.local_device_count()
     on_tpu = "tpu" in str(jax.devices()[0].device_kind).lower()
     # Shape picked by scripts/mfu_sweep.py on TPU v5 lite: larger d_model
@@ -173,42 +228,56 @@ def main() -> None:
     # sections each build their own models (observed: keeping these alive
     # RESOURCE_EXHAUSTs every extra)
     del state, batch0, batches, step_fn, trainer, metrics
-    try:
-        extras["longctx"] = longctx_bench(on_tpu)
-    except Exception as e:  # long-context point is a best-effort extra
-        extras["longctx_error"] = f"{type(e).__name__}: {e}"
-    try:
-        extras.update(serving_bench(on_tpu))
-    except Exception as e:  # serving metrics are best-effort extras
-        extras["serving_error"] = f"{type(e).__name__}: {e}"
-    try:
-        extras["decode_2k"] = decode_span_bench(on_tpu)
-    except Exception as e:
-        extras["decode_2k_error"] = f"{type(e).__name__}: {e}"
-    try:
-        extras["spec_decode"] = spec_decode_bench(on_tpu)
-    except Exception as e:
-        extras["spec_decode_error"] = f"{type(e).__name__}: {e}"
-    try:
-        extras["mfu_8b_layer"] = mfu_8b_layer_bench(on_tpu)
-    except Exception as e:
-        extras["mfu_8b_layer_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "longctx"):
+        try:
+            extras["longctx"] = longctx_bench(on_tpu)
+        except Exception as e:  # long-context point is a best-effort extra
+            extras["longctx_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "serving"):
+        try:
+            extras.update(serving_bench(on_tpu))
+        except Exception as e:  # serving metrics are best-effort extras
+            extras["serving_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "decode_2k"):
+        try:
+            extras["decode_2k"] = decode_span_bench(on_tpu)
+        except Exception as e:
+            extras["decode_2k_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "spec_decode"):
+        try:
+            extras["spec_decode"] = spec_decode_bench(on_tpu)
+        except Exception as e:
+            extras["spec_decode_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "mfu_8b_layer"):
+        try:
+            extras["mfu_8b_layer"] = mfu_8b_layer_bench(on_tpu)
+        except Exception as e:
+            extras["mfu_8b_layer_error"] = f"{type(e).__name__}: {e}"
     if on_tpu:
         if serving_8b is not None:
             extras["serving_8b"] = serving_8b
         else:
             extras["serving_8b_error"] = serving_8b_err
-    else:
+    elif _budget_gate(extras, budget, "serving_8b"):
         try:
             extras["serving_8b"] = serving_8b_bench(on_tpu)
         except Exception as e:
             extras["serving_8b_error"] = f"{type(e).__name__}: {e}"
+    extras["budget"] = {"total_s": budget.total_s,
+                        "used_s": round(budget.elapsed(), 1),
+                        "env": BUDGET_ENV}
     headline = {
         "metric": "llama_train_mfu",
         "value": round(achieved_mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(achieved_mfu / 0.40, 4),
     }
+    # the decode-step attribution rides the headline so the driver's
+    # last-2000-bytes stdout capture carries the per-bucket breakdown
+    bd = (extras.get("serving_8b") or {}).get("decode_breakdown") or {}
+    if bd.get("buckets_ms"):
+        headline["decode_breakdown_ms"] = {
+            k: v for k, v in bd["buckets_ms"].items() if v is not None}
     # Full record -> committed file; stdout gets a compact headline ONLY,
     # as the LAST line (driver keeps the last ~2000 bytes of stdout).
     # Off-TPU smoke runs write a temp path instead: toy-CPU numbers must
@@ -221,6 +290,14 @@ def main() -> None:
         json.dump({"headline": headline, "extras": extras}, f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
+    _print_tail(headline, extras_path, on_tpu, failures)
+
+
+def _print_tail(headline: dict, extras_path: str, on_tpu: bool,
+                failures: list[str]) -> None:
+    """The bench's stdout contract: optional floor-failure line, then the
+    compact headline as the LAST line — in that order, always (the driver
+    records only the tail of stdout)."""
     if failures:
         print(json.dumps({"floor_failures": failures}))
     print(json.dumps(dict(headline,
@@ -800,30 +877,90 @@ def _init_llama_int8_serving(cfg, seed: int = 0):
 HBM_GBPS = 819.0
 
 
-def _serving_8b_subprocess() -> dict:
+#: the serving_8b child's -c program. A watchdog thread inside the child
+#: makes it self-terminating: it exits when its deadline passes OR when
+#: its parent dies (reparent detected via getppid change) — so even a
+#: SIGKILLed bench parent cannot leave an 8B child starving the box
+#: (BENCH_r05/MULTICHIP_r05 both died rc=124 to exactly that).
+_SERVING_8B_CHILD_SRC = """\
+import json, os, sys, threading, time
+deadline = time.monotonic() + float(sys.argv[1])
+ppid0 = os.getppid()
+def _watchdog():
+    while True:
+        if time.monotonic() > deadline or os.getppid() != ppid0:
+            os._exit(3)
+        time.sleep(2.0)
+threading.Thread(target=_watchdog, daemon=True).start()
+import jax, bench
+on = 'tpu' in str(jax.devices()[0].device_kind).lower()
+out = bench.serving_8b_bench(True) if on else {'not_tpu': True}
+print('RESULT ' + json.dumps(out))
+"""
+
+
+def _kill_process_group(proc, grace_s: float = 10.0) -> None:
+    """SIGTERM the child's whole session, escalate to SIGKILL after a
+    grace period (the child was started with start_new_session, so the
+    group id is its pid)."""
+    import signal
+    import subprocess
+
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            proc.wait(timeout=grace_s)
+            return
+        except subprocess.TimeoutExpired:
+            continue
+
+
+def _run_watchdogged(cmd: list[str], timeout_s: float, *,
+                     cwd: str | None = None, extra_argv=()) -> tuple:
+    """Run `cmd` in its own session with a hard parent-side deadline;
+    returns (rc, stdout, stderr). On timeout the child's entire process
+    group is killed (TERM, then KILL) and RuntimeError raises — no
+    orphan survives either parent path."""
+    import subprocess
+
+    proc = subprocess.Popen(list(cmd) + [str(x) for x in extra_argv],
+                            cwd=cwd, start_new_session=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _kill_process_group(proc)
+        raise RuntimeError(
+            f"child exceeded its {timeout_s:.0f}s budget (process group "
+            "killed)")
+    return proc.returncode, out, err
+
+
+def _serving_8b_subprocess(timeout_s: float = 1200.0) -> dict:
     """Run serving_8b_bench in a FRESH process: at 32 slots the engine
     needs ~13 GiB of the 16 GiB HBM, and the earlier bench sections'
     compiled executables + allocator fragmentation in this process are
     enough to tip it into RESOURCE_EXHAUSTED (observed). A clean process
     reproduces the production condition — a serving engine owns its
-    chip."""
-    import subprocess
+    chip. `timeout_s` (computed by main() from the remaining bench
+    budget) bounds the child from BOTH sides: the parent kills the
+    child's process group past it, and the child's own watchdog thread
+    exits at the same deadline even if the parent is gone."""
     import sys
 
-    proc = subprocess.run(
-        [sys.executable, "-c",
-         "import json, jax, bench\n"
-         "on = 'tpu' in str(jax.devices()[0].device_kind).lower()\n"
-         "out = bench.serving_8b_bench(True) if on else {'not_tpu': True}\n"
-         "print('RESULT ' + json.dumps(out))"],
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        capture_output=True, text=True, timeout=1200)
-    for line in proc.stdout.splitlines():
+    rc, out, err = _run_watchdogged(
+        [sys.executable, "-c", _SERVING_8B_CHILD_SRC],
+        timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)),
+        extra_argv=[timeout_s])
+    for line in out.splitlines():
         if line.startswith("RESULT "):
             return json.loads(line[len("RESULT "):])
     raise RuntimeError(
-        f"serving_8b subprocess rc={proc.returncode}: "
-        f"{proc.stderr[-500:]}")
+        f"serving_8b subprocess rc={rc}: {err[-500:]}")
 
 
 
@@ -952,10 +1089,23 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         decode_chunk=8, kv_quantize="int8")
     cache_bytes = sum(l.nbytes for l in jax.tree.leaves(engine.cache))
     warmup_s = time.perf_counter() - t0
+    engine.perf_counters(reset=True)   # clean host-side attribution
     decode_tps, _ = sustain(engine, n_slots)
     # plain decode: one weight read per step, n_slots tokens per step
     steps_per_s = decode_tps / n_slots
     plain_roofline = steps_per_s * read_bytes / (HBM_GBPS * 1e9)
+    # decode-step attribution (tentpole r6, ROADMAP #2): split the step
+    # into weight read / attention+KV update / sampling+penalties /
+    # dispatch RTT / host fetch+replay — the five buckets that decide
+    # whether the remaining roofline gap is addressable. The live-sustain
+    # host counters (populated above) fill the host buckets.
+    from kubeflow_tpu.training.profiling import serving_decode_breakdown
+
+    try:
+        breakdown = serving_decode_breakdown(
+            engine, iters=5, hbm_gbps=HBM_GBPS if on_tpu else None)
+    except Exception as e:
+        breakdown = {"error": f"{type(e).__name__}: {e}"}
     # open-loop Poisson saturation sweep (r4 weak #4: the flagship had a
     # single light-load point)
     sweep = [_poisson_run(engine, prompt, new_tokens, nr, g)
@@ -982,9 +1132,26 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         params, cfg, n_slots, 8, max_len=max_len, buckets=(bucket,),
         decode_chunk=8, kv_quantize="int8", speculative=3, spec_ngram=3)
     spec_warmup_s = time.perf_counter() - t0
+    # static-k baseline FIRST on the same warmed engine (detaching the
+    # policy dispatches k_max every round — the pre-r6 behavior; both
+    # program menus are warm, so this is one extra sustain, not a second
+    # engine build), then the adaptive-k point the floors track.
+    adapt_policy = spec_engine._spec_adapt
+    spec_engine._spec_adapt = None
+    static_tps, _ = sustain(spec_engine, spec_slots)
+    m_static = spec_engine.metrics()
+    spec_engine._spec_adapt = adapt_policy
     spec_tps, _ = sustain(spec_engine, spec_slots)
     m = spec_engine.metrics()
-    acc = m.get("spec_tokens_per_round", 0.0)
+    # the engine counters are cumulative across both sustains: the
+    # adaptive point's acceptance must come from ITS rounds only (the
+    # static run's rounds would otherwise skew both acc and the roofline)
+    d_tok = (m.get("spec_tokens_emitted", 0)
+             - m_static.get("spec_tokens_emitted", 0))
+    d_rounds = (m.get("spec_verify_rounds", 0)
+                - m_static.get("spec_verify_rounds", 0))
+    acc = round(d_tok / max(1, d_rounds), 3)
+    static_acc = m_static.get("spec_tokens_per_round", 0.0)
     # spec roofline: one weight read per verify round, `acc` tokens/round
     spec_rounds_per_s = spec_tps / (spec_slots * max(acc, 1e-9))
     spec_roofline = spec_rounds_per_s * read_bytes / (HBM_GBPS * 1e9)
@@ -1009,6 +1176,7 @@ def serving_8b_bench(on_tpu: bool) -> dict:
         "warmup_s": round(warmup_s, 1),
         "decode_tok_per_s": round(decode_tps, 1),
         "roofline_frac": round(plain_roofline, 3),
+        "decode_breakdown": breakdown,
         "ttft_p50_ms": load["ttft_p50_ms"],
         "ttft_p99_ms": load["ttft_p99_ms"],
         "poisson_sweep": sweep,
@@ -1020,6 +1188,13 @@ def serving_8b_bench(on_tpu: bool) -> dict:
             "spec_tokens_per_round": acc,
             "n_slots": spec_slots,
             "drafts_per_round": 3,
+            "adaptive_k": True,
+            "draft_k_last": m.get("spec_draft_k_last"),
+            "accept_ema": m.get("spec_accept_ema"),
+            # same warmed engine, policy detached → static k=3 each round
+            "static_k3_tok_per_s": round(static_tps, 1),
+            "static_k3_tokens_per_round": static_acc,
+            "speedup_vs_static_k3": round(spec_tps / static_tps, 2),
             "roofline_frac": round(spec_roofline, 3),
             "warmup_s": round(spec_warmup_s, 1),
         },

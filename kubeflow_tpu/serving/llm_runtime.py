@@ -47,6 +47,7 @@ class LLMModel(Model):
                  kv_quantize: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
+                 spec_adaptive: bool = True,
                  lora: dict[str, Any] | None = None,
                  adapters: dict[str, Any] | None = None,
                  logprobs_topk: int = 0,
@@ -75,6 +76,10 @@ class LLMModel(Model):
         self._kv_quantize = kv_quantize
         self._speculative = speculative
         self._spec_ngram = spec_ngram
+        # config.spec_adaptive (default on): per-slot EMA acceptance
+        # adapts the draft length k per verify round (serving/llm.py
+        # AdaptiveDraftLen); off = static k, the pre-r6 behavior
+        self._spec_adaptive = spec_adaptive
         # config.lora {rank, alpha, targets?}: the checkpoint is a
         # llama_lora fine-tune ({"base","lora"} tree); restore it and serve
         # the MERGED model — zero serving-path overhead, the engine never
@@ -174,6 +179,7 @@ class LLMModel(Model):
                                  kv_quantize=self._kv_quantize,
                                  speculative=self._speculative,
                                  spec_ngram=self._spec_ngram,
+                                 spec_adaptive=self._spec_adaptive,
                                  adapters=self._load_adapters(cfg),
                                  logprobs_topk=self._logprobs_topk,
                                  sample_k_max=self._sample_k_max,

@@ -275,8 +275,14 @@ class ModelServer:
         payload["top_k"] = top_k
         payload["top_p"] = top_p
         # -- OpenAI long tail (⊘ kserve huggingfaceserver): penalties are
-        # logit edits INSIDE the compiled programs; seed makes sampling
-        # reproducible; n/best_of fan one request across decode slots;
+        # logit edits INSIDE the compiled programs (nonzero values are
+        # quantized to milli units with a ±1 milli floor — |v| < 0.0005
+        # stays a minimal penalty rather than silently turning off);
+        # seed makes sampling reproducible — the engine folds it onto 24
+        # bits via a splitmix64 mixing hash, so any two distinct seeds
+        # collide with probability ~2^-24 but colliding pairs are not
+        # predictable from the values, and a given seed always replays
+        # the same stream; n/best_of fan one request across decode slots;
         # echo prepends the prompt to the completion
         for fname in ("presence_penalty", "frequency_penalty"):
             try:
@@ -405,9 +411,12 @@ class ModelServer:
             "model": m.name, "choices": choices,
             # completion_tokens counts EVERY generated token (including
             # best_of candidates that were not returned) — the tokens the
-            # accelerator actually produced
+            # accelerator actually produced; total_tokens is their sum
+            # (the field OpenAI clients read for billing/limits)
             "usage": {"prompt_tokens": len(payload["prompt_tokens"]),
-                      "completion_tokens": gen_tokens}}
+                      "completion_tokens": gen_tokens,
+                      "total_tokens":
+                          len(payload["prompt_tokens"]) + gen_tokens}}
 
     def _stream_completion(self, handler, body: dict[str, Any],
                            chat: bool = False) -> None:

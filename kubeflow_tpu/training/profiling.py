@@ -22,6 +22,8 @@ import contextlib
 import os
 from typing import Any, Callable
 
+import numpy as np
+
 
 @contextlib.contextmanager
 def trace(logdir: str):
@@ -81,3 +83,222 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self.active = False
+
+
+# -- serving-side decode-step attribution ------------------------------------
+#
+# The 8B roofline gap (ROADMAP #2): plain decode measured ~30 ms/step
+# against a 9.2 ms weight-read floor, with nothing attributing the other
+# ~21 ms. serving_decode_breakdown() closes the attribution hole: it
+# drives the live engine's OWN compiled decode programs (plus two probe
+# programs) and splits one decode step's wall time into the five buckets
+# a serving step is made of. Differential timing, not trace parsing —
+# the buckets come from executing program VARIANTS that differ by
+# exactly one stage, so no profiler-proto tooling is needed at runtime;
+# a jax.profiler trace of the full step is captured alongside as the
+# registered artifact when trace_dir is given.
+
+
+def _median_time(run, iters: int):
+    import time
+
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def serving_decode_breakdown(engine, *, steps: int | None = None,
+                             fill_len: int | None = None, iters: int = 5,
+                             trace_dir: str | None = None,
+                             hbm_gbps: float | None = None) -> dict:
+    """Attribute one batched decode step of a (warmed, idle) LLMEngine.
+
+    Returns a machine-readable dict whose `buckets_ms` splits a decode
+    step into:
+
+      weight_read          — measured: a jitted reduction that reads every
+                             non-embed weight byte once and nothing else
+                             (the HBM floor decode cannot beat);
+      attention_kv_update  — the rest of the sampling-stripped forward:
+                             attention over the KV span, cache update,
+                             norms/activations (nosample-variant time
+                             minus the weight read);
+      sampling_penalties   — full program minus the sampling-stripped
+                             variant (_decode(sample=False));
+      dispatch_rtt         — a trivial-program host->device->host round
+                             trip, amortized per step over the chunk;
+      host_fetch_replay    — the engine's live perf counters (fetch +
+                             Python replay wall per step), None until the
+                             engine has served decode traffic.
+
+    The engine's slot state is junk during the run and reset after
+    (exactly like warmup) — call only while idle. `fill_len` positions
+    the synthetic slots mid-generation so the attention span is
+    realistic; `hbm_gbps` adds the analytic weight-read floor next to
+    the measured one."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    n_slots = engine.n_slots
+    if steps is None:
+        steps = 1
+        while steps * 2 <= engine.decode_chunk:
+            steps *= 2
+    # every (untimed + timed) run's KV writes must fit max_len so no
+    # state reset is needed INSIDE a timed window (a reset is host
+    # transfers — RTTs — that would pollute the chunk timing). Small
+    # caches clamp steps, then iters, rather than silently profiling a
+    # degenerate everything-clamped-at-max_len program state.
+    def rows_needed(s, it):
+        return (2 * it + 4) * s + 2
+    while steps > 1 and rows_needed(steps, iters) > engine.max_len:
+        steps //= 2
+    while iters > 1 and rows_needed(steps, iters) > engine.max_len:
+        iters -= 1
+    if rows_needed(steps, iters) > engine.max_len:
+        raise ValueError(
+            f"max_len {engine.max_len} cannot hold one profiled chunk "
+            f"(steps={steps}, iters={iters})")
+    if fill_len is None:
+        fill_len = max(1, min(engine.max_len // 2,
+                              engine.max_len - rows_needed(steps, iters)))
+    span = engine._pick_span(min(fill_len + steps, engine.max_len))
+
+    def reset_state():
+        engine.lengths = engine._put(
+            np.full((n_slots,), fill_len, np.int32))
+        engine.last_tokens = engine._put(np.ones((n_slots,), np.int32))
+        engine.samp = engine._put(engine._samp_reset())
+
+    active = engine._put(np.ones((n_slots,), bool))
+
+    def run_decode(fn):
+        def go():
+            (engine.cache, engine.lengths, engine.last_tokens,
+             engine.samp, engine.rng_key, out) = fn(
+                engine.params, engine.cache, engine.lengths,
+                engine.last_tokens, engine.samp, engine.rng_key, active,
+                *engine._extra())
+            float(np.asarray(out).flat[0])   # value fetch = the only
+            # reliable sync on the tunneled platform (see StepProfiler)
+        return go
+
+    fn_full = engine._decode_fn(steps, span)
+    fn_nosample = jax.jit(
+        functools.partial(engine._decode, steps=steps, span=span,
+                          sample=False),
+        donate_argnums=(1, 2, 3, 4, 5))
+
+    # pure weight read: reduce every non-embed leaf to one scalar — reads
+    # each byte exactly once, FLOPs are negligible, so its wall time IS
+    # the achievable weight-read time of this chip (embed is excluded
+    # because decode gathers a handful of its rows, never the table)
+    params = engine.params
+    read_tree = ({k: v for k, v in params.items() if k != "embed"}
+                 if isinstance(params, dict) else params)
+    read_bytes = int(sum(l.nbytes for l in jax.tree.leaves(read_tree)))
+
+    @jax.jit
+    def read_all(p):
+        tot = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(p):
+            tot = tot + jnp.sum(leaf).astype(jnp.float32)
+        return tot
+
+    def run_read():
+        float(np.asarray(read_all(read_tree)))
+
+    # trivial round trip: dispatch + scalar fetch of a one-add program —
+    # the per-dispatch host<->device overhead every chunk pays once
+    tiny = engine._put(np.zeros((), np.float32))
+    tiny_fn = jax.jit(lambda x: x + 1.0)
+
+    def run_rtt():
+        float(np.asarray(tiny_fn(tiny)))
+
+    # one untimed call per program: compiles (nosample/read/rtt are not
+    # in the warmup menu) and faults pages before the timed iterations.
+    # State is reset ONCE up front; fill_len left enough KV headroom for
+    # every run's writes, so no host transfer lands inside a timed window
+    reset_state()
+    for warm in (run_decode(fn_full), run_decode(fn_nosample), run_read,
+                 run_rtt):
+        warm()
+
+    t_rtt = _median_time(run_rtt, iters)
+    t_full = _median_time(run_decode(fn_full), iters)
+    t_nosample = _median_time(run_decode(fn_nosample), iters)
+    t_read = max(_median_time(run_read, iters) - t_rtt, 0.0)
+
+    per_step = 1e3 / steps
+    dev_full_ms = max(t_full - t_rtt, 0.0) * per_step
+    dev_nosample_ms = max(t_nosample - t_rtt, 0.0) * per_step
+    weight_read_ms = t_read * 1e3
+    sampling_ms = max(dev_full_ms - dev_nosample_ms, 0.0)
+    attn_kv_ms = max(dev_nosample_ms - weight_read_ms, 0.0)
+
+    perf = engine.perf_counters()
+    host_ms = None
+    dispatch_host_ms = None
+    if perf.get("decode_steps"):
+        host_ms = round(perf["fetch_replay_s"] * 1e3
+                        / perf["decode_steps"], 4)
+        dispatch_host_ms = round(perf["dispatch_s"] * 1e3
+                                 / perf["decode_steps"], 4)
+
+    out = {
+        "steps": steps, "span": span, "n_slots": n_slots,
+        "fill_len": fill_len, "iters": iters,
+        "chunk_wall_ms": round(t_full * 1e3, 4),
+        "device_step_ms": round(dev_full_ms, 4),
+        "dispatch_rtt_ms": round(t_rtt * 1e3, 4),
+        "weight_read_bytes": read_bytes,
+        "weight_read_gbps": round(read_bytes / max(t_read, 1e-9) / 1e9, 1),
+        "buckets_ms": {
+            "weight_read": round(weight_read_ms, 4),
+            "attention_kv_update": round(attn_kv_ms, 4),
+            "sampling_penalties": round(sampling_ms, 4),
+            "dispatch_rtt_per_step": round(t_rtt * per_step, 4),
+            "host_fetch_replay_per_step": host_ms,
+        },
+        # live engine counters for the host-side buckets (per-chunk wall
+        # the host spent dispatching vs fetching+replaying, amortized)
+        "host_dispatch_per_step_ms": dispatch_host_ms,
+        "perf_counters": perf,
+    }
+    if hbm_gbps:
+        floor_ms = read_bytes / (hbm_gbps * 1e9) * 1e3
+        out["weight_read_floor_ms"] = round(floor_ms, 4)
+        out["weight_read_frac_of_peak"] = round(
+            floor_ms / max(weight_read_ms, 1e-9), 4)
+    if trace_dir:
+        # the trace artifact: one full chunk under jax.profiler (the
+        # breakdown above is what bench records; the trace is for humans
+        # in tensorboard-plugin-profile, registered like any other dir)
+        try:
+            reset_state()
+            with trace(trace_dir):
+                run_decode(fn_full)()
+            with open(os.path.join(trace_dir, "PROFILE_DONE"), "w") as f:
+                f.write(f"decode chunk steps={steps} span={span}\n")
+            out["trace_dir"] = trace_dir
+        except Exception as e:   # profiling must never kill the bench
+            out["trace_error"] = f"{type(e).__name__}: {e}"
+
+    # leave the engine exactly as warmup does: slot state reset, host
+    # mirrors zeroed (the junk cache rows are dead — the next prefill
+    # into a slot rewrites them)
+    engine.lengths = engine._put(np.zeros((n_slots,), np.int32))
+    engine.last_tokens = engine._put(np.zeros((n_slots,), np.int32))
+    engine.samp = engine._put(engine._samp_reset())
+    engine._host_lengths[:] = 0
+    engine._pending = None
+    engine._inflight[:] = 0
+    engine._active_host = None
+    engine._active_dev = None
+    return out

@@ -36,10 +36,21 @@ jax.config.update("jax_platforms", "cpu")
 # recompiles them. The disk cache dedupes those WITHIN one session and
 # warms repeat runs + subprocess-spawning tests. Keyed by HLO+flags, so
 # correctness is unaffected; override the location with KTPU_TEST_CACHE.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("KTPU_TEST_CACHE",
-                                 "/tmp/ktpu_test_compile_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+#
+# OPT-IN (r6): on this jaxlib/XLA:CPU combination the cache is NOT
+# numerics-safe — in a process that mixes freshly-compiled and
+# deserialized executables (any run after an HLO-changing edit, or a
+# cold cache being populated), engine programs return WRONG tokens:
+# seeded sampling loses engine-independence and penalized greedy
+# diverges from the host reference (reproduced on an unmodified tree:
+# cold-cache run fails 4 sampling tests, the warm rerun passes all 14).
+# A poisoned-at-population cache silently turns every HLO-touching PR's
+# test run red, so the default is OFF; set KTPU_TEST_CACHE to a cache
+# dir to opt in (pre-warmed CI loops where every process is fully warm).
+if os.environ.get("KTPU_TEST_CACHE"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["KTPU_TEST_CACHE"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import pytest  # noqa: E402
 

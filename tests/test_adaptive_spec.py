@@ -1,0 +1,191 @@
+"""Adaptive speculative draft length (r6 tentpole part c): a per-slot EMA
+of accepted drafts per verify round picks each round's draft length k from
+a small compiled-program menu, replacing static k. The policy is host-side
+and pure (AdaptiveDraftLen), so convergence is fast-lane testable on
+synthetic accept/reject streams; the engine integration rides the same
+greedy-exactness contract as static speculation (any k is exact — fewer
+drafts only shortcut fewer dispatches).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.llm import AdaptiveDraftLen, LLMEngine
+
+
+# -- policy: synthetic accept/reject streams --------------------------------
+
+def test_menu_shape_and_bounds():
+    pol = AdaptiveDraftLen(6, n_slots=2)
+    assert pol.menu == [1, 2, 4, 6]
+    assert AdaptiveDraftLen(3, 1).menu == [1, 2, 3]
+    assert AdaptiveDraftLen(1, 1).menu == [1]
+    with pytest.raises(ValueError):
+        AdaptiveDraftLen(0, 1)
+
+
+def test_converges_down_on_rejection_stream():
+    """All-reject stream → EMA → 0 → the policy stops paying for drafts
+    (k = smallest menu entry)."""
+    pol = AdaptiveDraftLen(6, n_slots=1)
+    assert pol.pick([0]) == 6            # optimistic before observations
+    for _ in range(40):
+        pol.observe(0, accepted=0, k_round=pol.pick([0]))
+    assert pol.ema[0] < 0.2
+    assert pol.pick([0]) == 1
+
+
+def test_converges_to_measured_acceptance_ema():
+    """A stream that steadily accepts `a` drafts per round converges the
+    EMA to ~a and the pick to the smallest menu k covering a*headroom —
+    the policy tracks the MEASURED acceptance, not the configured max."""
+    pol = AdaptiveDraftLen(8, n_slots=1)
+    for _ in range(60):
+        pol.observe(0, accepted=2, k_round=pol.pick([0]))
+    assert abs(pol.ema[0] - 2.0) < 0.15
+    # want = 2*1.25 = 2.5 → smallest menu k >= 2.5 is 4 (menu 1,2,4,8)
+    assert pol.pick([0]) == 4
+
+
+def test_never_exceeds_configured_max_k():
+    """Even a saturating (or bogus, over-reporting) accept stream can
+    never push the pick past k_max."""
+    pol = AdaptiveDraftLen(4, n_slots=1)
+    for _ in range(50):
+        pol.observe(0, accepted=100, k_round=4)   # over-reporting stream
+        assert pol.pick([0]) <= 4
+    assert pol.ema[0] <= 4.0
+    assert pol.pick([0]) == 4
+
+
+def test_recovers_after_low_acceptance_phase():
+    """Saturated rounds observe accepted+1, so the estimate climbs back
+    to k_max after a rejection phase instead of ratcheting down (a plain
+    accepted-only EMA can never exceed the current k and gets stuck)."""
+    pol = AdaptiveDraftLen(6, n_slots=1)
+    for _ in range(40):                       # hard text: converge down
+        pol.observe(0, 0, pol.pick([0]))
+    assert pol.pick([0]) == 1
+    for _ in range(60):                       # easy text: full acceptance
+        k = pol.pick([0])
+        pol.observe(0, accepted=k, k_round=k)
+    assert pol.pick([0]) == 6
+
+
+def test_pick_uses_most_optimistic_drafting_slot_and_reset():
+    pol = AdaptiveDraftLen(6, n_slots=2)
+    for _ in range(40):
+        pol.observe(0, 0, 6)                  # slot 0: nothing accepts
+    assert pol.pick([0]) == 1
+    assert pol.pick([0, 1]) == 6              # slot 1 still optimistic
+    assert pol.pick([]) == 1                  # no drafting slot → min k
+    pol.observe(1, 0, 6)
+    pol.reset_slot(1)                         # new occupant → optimistic
+    assert pol.ema[1] == 6.0
+
+
+# -- engine integration ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained():
+    """Tiny llama trained onto a repeating pattern (high acceptance) —
+    the regime where adaptive k must stay at k_max."""
+    import jax.numpy as jnp
+    import optax
+
+    cfg = llama.LlamaConfig.tiny()
+    pattern = np.array([3, 11, 7, 19, 2, 31, 5, 23], np.int32)
+    tokens = jnp.asarray(np.tile(pattern, 64)[: 4 * 64].reshape(4, 64))
+    params = llama.init(jax.random.key(1), cfg)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, _), grads = jax.value_and_grad(
+            llama.loss_fn, has_aux=True)(params, {"tokens": tokens}, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(150):
+        params, opt_state, _ = step(params, opt_state)
+    return params, cfg, list(np.tile(pattern, 3))[:20]
+
+
+def _engines(params, cfg, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("buckets", (32,))
+    kw.setdefault("decode_chunk", 4)
+    return kw
+
+
+def test_adaptive_matches_static_greedy(trained):
+    """Greedy output is byte-identical between the adaptive-k engine,
+    the static-k engine, and plain decode — adaptation only moves the
+    dispatch count, never the tokens."""
+    params, cfg, prompt = trained
+    kw = _engines(params, cfg)
+    outs = {}
+    for name, ekw in (("plain", {}),
+                      ("static", dict(speculative=3, spec_adaptive=False)),
+                      ("adaptive", dict(speculative=3))):
+        eng = LLMEngine(params, cfg, **kw, **ekw)
+        rids = [eng.submit(prompt, 24) for _ in range(2)]
+        eng.run_until_idle()
+        outs[name] = [eng.result(r) for r in rids]
+    assert outs["adaptive"] == outs["static"] == outs["plain"]
+
+
+def test_adaptive_k_stays_high_on_accepting_text(trained):
+    params, cfg, prompt = trained
+    eng = LLMEngine(params, cfg, **_engines(params, cfg), speculative=3)
+    assert eng.spec_adaptive and eng._spec_adapt is not None
+    rid = eng.submit(prompt, 32)
+    eng.run_until_idle()
+    m = eng.metrics()
+    assert m["spec_tokens_per_round"] > 2.0, m   # drafts actually land
+    assert m["spec_draft_k_last"] == 3, m        # policy stayed at k_max
+    assert eng.result(rid)  # sanity
+
+
+def test_all_sampled_batch_drops_to_min_k(trained):
+    """Sampled rows draft nothing, so a batch with no drafting slot
+    verifies at the smallest k — near plain-decode cost instead of k_max
+    dead verify positions."""
+    params, cfg, prompt = trained
+    eng = LLMEngine(params, cfg, **_engines(params, cfg), speculative=3)
+    rids = [eng.submit(prompt, 16, temperature=0.9, seed=i)
+            for i in range(2)]
+    eng.run_until_idle()
+    m = eng.metrics()
+    assert m["spec_draft_k_last"] == 1, m
+    for r in rids:
+        assert eng.result(r)
+
+
+def test_est_round_tokens_is_ema_not_lifetime_average(trained):
+    """ADVICE r5 #2: after a long high-acceptance history, a few
+    low-acceptance rounds must move the estimate materially (the old
+    lifetime average barely moved)."""
+    params, cfg, _ = trained
+    eng = LLMEngine(params, cfg, **_engines(params, cfg), speculative=3)
+    for _ in range(50):
+        eng._observe_round_tokens(4)          # long easy-text history
+    assert abs(eng._est_round_tokens() - 4.0) < 0.01
+    for _ in range(12):
+        eng._observe_round_tokens(1)          # workload shift
+    assert eng._est_round_tokens() < 1.4      # re-anchored in ~a chunk
+    # lifetime counters would give (50*4 + 12*1)/62 ≈ 3.42 — stale
+
+
+def test_spec_metrics_surface_adaptive_state(trained):
+    params, cfg, prompt = trained
+    eng = LLMEngine(params, cfg, **_engines(params, cfg), speculative=3)
+    eng.generate(prompt, 8)
+    m = eng.metrics()
+    for key in ("spec_draft_k_max", "spec_draft_k_last",
+                "spec_accept_ema", "spec_est_round_tokens"):
+        assert key in m, key

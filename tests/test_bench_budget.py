@@ -1,0 +1,88 @@
+"""Bench self-defense (ROADMAP r6 item #1): the wall-clock budget gate,
+the watchdogged child runner, and the stdout tail contract. BENCH_r05 /
+MULTICHIP_r05 both died rc=124 because bench.py had no overall budget and
+the 8B child could outlive a killed parent — these tests pin the
+machinery that prevents a recurrence, without touching hardware."""
+
+import json
+import sys
+
+import pytest
+
+import bench
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv(bench.BUDGET_ENV, "123.5")
+    b = bench.Budget()
+    assert b.total_s == 123.5
+    assert not b.expired()
+    assert 0 < b.remaining() <= 123.5
+
+
+def test_budget_default(monkeypatch):
+    monkeypatch.delenv(bench.BUDGET_ENV, raising=False)
+    assert bench.Budget().total_s == bench.DEFAULT_BUDGET_S
+
+
+def test_budget_gate_records_skip_and_blocks():
+    extras: dict = {}
+    spent = bench.Budget(total_s=0.0)           # already expired
+    assert not bench._budget_gate(extras, spent, "longctx")
+    assert not bench._budget_gate(extras, spent, "spec_decode")
+    assert extras["skipped_for_budget"] == ["longctx", "spec_decode"]
+    fresh = bench.Budget(total_s=3600.0)
+    extras2: dict = {}
+    assert bench._budget_gate(extras2, fresh, "longctx")
+    assert "skipped_for_budget" not in extras2
+
+
+def test_print_tail_headline_is_last_line(capsys):
+    """The driver records only the tail of stdout: the compact headline
+    must be the LAST line even when floor failures print — and when
+    sections were skipped for budget, the record still carries them
+    while the headline still lands."""
+    headline = {"metric": "llama_train_mfu", "value": 0.5,
+                "decode_breakdown_ms": {"weight_read": 9.2}}
+    bench._print_tail(headline, "/tmp/x/BENCH_EXTRAS.json", True,
+                      ["mfu: 0.5 < floor 0.6"])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(lines[0]) == {
+        "floor_failures": ["mfu: 0.5 < floor 0.6"]}
+    last = json.loads(lines[-1])
+    assert last["metric"] == "llama_train_mfu"
+    assert last["floors"] == "fail"
+    assert last["decode_breakdown_ms"] == {"weight_read": 9.2}
+
+
+def test_watchdog_kills_overrunning_child():
+    """An overrunning child's whole process group dies at the parent-side
+    deadline instead of outliving the bench (rc=124 root cause)."""
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="budget"):
+        bench._run_watchdogged(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            timeout_s=1.0)
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_watchdogged_child_returns_output():
+    rc, out, err = bench._run_watchdogged(
+        [sys.executable, "-c", "print('RESULT ok')"], timeout_s=60.0)
+    assert rc == 0
+    assert "RESULT ok" in out
+
+
+def test_child_src_self_terminates_on_deadline():
+    """The in-child watchdog (deadline argv) exits the child even when
+    the parent never enforces its own timeout — the orphaned-8B-child
+    defense. Uses the same watchdog preamble as the real child, with the
+    jax/bench workload swapped for a sleep."""
+    src = bench._SERVING_8B_CHILD_SRC.split("import jax, bench")[0]
+    src += "import time\ntime.sleep(60)\nprint('RESULT late')"
+    rc, out, _ = bench._run_watchdogged(
+        [sys.executable, "-c", src], timeout_s=30.0, extra_argv=[1.0])
+    assert rc == 3          # the CHILD's watchdog fired, not the parent's
+    assert "RESULT" not in out

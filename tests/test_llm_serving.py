@@ -440,7 +440,8 @@ def test_openai_completion_buffered(tiny, completion_server):
     choice = out["choices"][0]
     assert choice["token_ids"] == ref
     assert choice["finish_reason"] == "length"
-    assert out["usage"] == {"prompt_tokens": 2, "completion_tokens": 4}
+    assert out["usage"] == {"prompt_tokens": 2, "completion_tokens": 4,
+                            "total_tokens": 6}
     # byte-level decode of the generated ids
     assert choice["text"] == bytes(t for t in ref
                                    if 0 <= t < 256).decode("utf-8",

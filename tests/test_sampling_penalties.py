@@ -230,6 +230,37 @@ def test_http_n_returns_n_choices(server):
     assert code == 200
     assert [c["index"] for c in out["choices"]] == [0, 1, 2]
     assert out["usage"]["completion_tokens"] == 12
+    # total_tokens = prompt + ALL generated (OpenAI clients read it for
+    # billing/limits; ADVICE r5)
+    assert out["usage"]["total_tokens"] == (
+        out["usage"]["prompt_tokens"] + out["usage"]["completion_tokens"])
+
+
+def test_penalty_milli_floor():
+    """Nonzero penalties below the 0.0005 rounding threshold clamp to
+    ±1 milli instead of silently turning off (ADVICE r5; the penalties'
+    twin of the top_p sub-micro guard)."""
+    assert LLMEngine._pack_milli(0.0) == 0
+    assert LLMEngine._pack_milli(0.0004) == 1
+    assert LLMEngine._pack_milli(-0.0004) == -1
+    assert LLMEngine._pack_milli(0.5) == 500
+    assert LLMEngine._pack_milli(-1.3) == -1300
+
+
+def test_seed_fold_mixes_high_bits():
+    """The 24-bit seed fold is a mixing hash: seeds that differ only by
+    the OLD modulus (2^24 - 3) or only in bits above 24 must not alias
+    (they trivially did under plain `% (2^24 - 3)`), and the fold stays
+    deterministic and in the f32-exact range."""
+    from kubeflow_tpu.serving.llm import _fold_seed24
+
+    for a, b in ((1234, 1234 + (1 << 24) - 3), (7, 7 + (1 << 32)),
+                 (0, 1 << 40)):
+        assert _fold_seed24(a) != _fold_seed24(b), (a, b)
+    for s in (0, 1, 2**24, 2**63 - 1):
+        v = _fold_seed24(s)
+        assert 0 <= v < (1 << 24)
+        assert v == _fold_seed24(s)   # deterministic
 
 
 def test_http_best_of_ranks_by_logprob(server):
