@@ -1,0 +1,33 @@
+"""Trace-driven production-traffic scenario suite (ROADMAP #4).
+
+Turns "millions of users" from a north-star phrase into a measured,
+floor-gated artifact: a seeded deterministic trace generator
+(`loadgen.trace`), pure per-tenant SLO accounting (`loadgen.slo`), a
+scenario runner that replays a trace against the real continuous-batching
+engine through the ordinary submit path (`loadgen.runner`), an SLO-aware
+decode-chunk / admission control hook (`loadgen.control`), and 4-6 named
+committed scenarios (`loadgen.scenarios` + `loadgen/configs/*.json`).
+
+Grounding: "Evaluating Kubernetes Performance for GenAI Inference"
+(PAPERS.md) — the workload dimensions a serving platform must prove, not
+assert: heterogeneous prompt/output lengths (multi-bucket + chunked
+prefill), many-tenant adapter fleets with skewed popularity (S-LoRA),
+bursty diurnal arrivals (modulated Poisson), client cancellations and
+disconnects, and SLO attainment under all of it.
+"""
+
+from kubeflow_tpu.loadgen.control import SLOController, pick_decode_chunk
+from kubeflow_tpu.loadgen.runner import run_scenario, run_trace
+from kubeflow_tpu.loadgen.scenarios import (SCENARIOS, Scenario,
+                                            load_scenario, miniature)
+from kubeflow_tpu.loadgen.slo import RequestRecord, summarize
+from kubeflow_tpu.loadgen.trace import (Trace, TraceConfig, TraceRequest,
+                                        generate_trace, trace_bytes,
+                                        trace_sha256)
+
+__all__ = [
+    "Trace", "TraceConfig", "TraceRequest", "generate_trace",
+    "trace_bytes", "trace_sha256", "RequestRecord", "summarize",
+    "run_scenario", "run_trace", "SLOController", "pick_decode_chunk",
+    "SCENARIOS", "Scenario", "load_scenario", "miniature",
+]

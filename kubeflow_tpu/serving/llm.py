@@ -300,6 +300,9 @@ class LLMEngine:
         self._req_stop: dict[int, list[list[int]]] = {}
         self._host_lengths = np.zeros((n_slots,), np.int64)
         self.decode_chunk = max(1, decode_chunk)
+        # the chunk menu warmup compiles (powers of two up to this);
+        # set_decode_chunk clamps here post-warmup
+        self._decode_chunk_warm = self.decode_chunk
         # -- decode pipelining: one dispatched-but-unfetched chunk may be
         # in flight; _inflight tracks its planned KV rows per slot so the
         # next chunk's headroom/span see through the lag
@@ -340,6 +343,16 @@ class LLMEngine:
         self._cancelled_count = 0
         self._ttft_window: collections.deque[float] = collections.deque(
             maxlen=1024)
+        # -- multi-tenant accounting (loadgen subsystem, ROADMAP #4): a
+        # request may carry a tenant name; the scheduler sees a stable
+        # integer id (max-min fair queue pop + admission caps live THERE —
+        # the engine only maps names and surfaces per-request timing).
+        self._tenant_idx: dict[str, int] = {}
+        self._req_tenant: dict[int, str | None] = {}
+        # per-request finish wall time (with _submit_t/_first_token_t this
+        # is the TTFT/TPOT record the loadgen runner reads via
+        # request_timing() BEFORE release())
+        self._finish_t: dict[int, float] = {}
         # Guards submit vs. the engine-loop thread: held across
         # scheduler.submit + request-dict population so scheduler.next()
         # (also taken under it) can never hand out a prefill whose request
@@ -1116,7 +1129,8 @@ class LLMEngine:
                frequency_penalty: float = 0.0,
                seed: int | None = None,
                stop: Sequence[Sequence[int]] | None = None,
-               deadline_s: float | None = None) -> int:
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> int:
         """Queue one request. top_k (0 = off) / top_p (1.0 = off) filter
         the sampled distribution inside the compiled programs (only when
         temperature > 0 — greedy rows stay bit-exact argmax).
@@ -1141,7 +1155,10 @@ class LLMEngine:
         semantics; matching is host-side at chunk boundaries, so at most
         one decode chunk of surplus is computed). `deadline_s`:
         wall-clock budget; past it the request is cancelled at the next
-        chunk boundary (finish_reason "cancelled")."""
+        chunk boundary (finish_reason "cancelled"). `tenant`: optional
+        tenant name — requests of the same tenant share a scheduler queue
+        and the max-min fair pop / admission caps (set_tenant_limits)
+        apply per tenant; None rides the anonymous tenant-0 queue."""
         import math
 
         # a NaN/inf/huge value would blow up later INSIDE the engine loop
@@ -1184,6 +1201,12 @@ class LLMEngine:
                     f"unknown adapter {adapter!r}; "
                     f"loaded: {sorted(self._adapter_idx)}")
             aid = self._adapter_idx[adapter]
+        if tenant is not None and (not isinstance(tenant, str)
+                                   or not 1 <= len(tenant) <= 256):
+            # the length cap pairs with MAX_TENANTS: names persist in
+            # _tenant_idx for the engine's lifetime, so both the count
+            # AND the bytes must be bounded against adversarial clients
+            raise ValueError("tenant must be a string of 1..256 chars")
         sched_len = len(prompt)
         if sched_len > self.buckets[-1]:
             # chunked prefill: validate the chain now (fail at submit, not
@@ -1198,15 +1221,19 @@ class LLMEngine:
                 with self._submit_lock:
                     try:
                         self.scheduler.submit(sched_len, max_new_tokens,
-                                              time.monotonic())
+                                              time.monotonic(),
+                                              tenant=self._tenant_id(tenant))
                     except PromptTooLong:
                         pass
                 raise
             sched_len = self.buckets[-1]
         with self._submit_lock:
             req_id = self.scheduler.submit(sched_len, max_new_tokens,
-                                           time.monotonic())
+                                           time.monotonic(),
+                                           tenant=self._tenant_id(tenant))
             self._prompts[req_id] = list(prompt)
+            if tenant is not None:
+                self._req_tenant[req_id] = tenant
             self._results[req_id] = []
             self._logprobs[req_id] = []
             if self.logprobs_topk:
@@ -1223,6 +1250,30 @@ class LLMEngine:
                 self._req_aids[req_id] = aid
             self._submit_t[req_id] = time.monotonic()
         return req_id
+
+    #: bound on distinct tenant names one engine tracks: the OpenAI
+    #: `user` field is client-controlled, so an unbounded name->id map
+    #: would be a memory leak an adversarial client can drive. Past the
+    #: cap, new names share the anonymous tenant-0 queue — degraded
+    #: fairness for the overflow tail, never unbounded growth.
+    MAX_TENANTS = 65536
+
+    def _tenant_id(self, tenant: str | None) -> int:
+        """Tenant name -> stable scheduler id. MUST be called under
+        _submit_lock: the len()-based id assignment has to be atomic
+        with the insert, or two first-requests from distinct tenants
+        could mint the same id and permanently merge their fairness
+        queues and admission quotas."""
+        if tenant is None:
+            return 0
+        tid = self._tenant_idx.get(tenant)
+        if tid is not None:
+            return tid
+        if len(self._tenant_idx) >= self.MAX_TENANTS:
+            return 0
+        tid = len(self._tenant_idx) + 1
+        self._tenant_idx[tenant] = tid
+        return tid
 
     def cancel(self, req_id: int) -> bool:
         """Ask the engine to drop a request; takes effect at the NEXT
@@ -1251,6 +1302,7 @@ class LLMEngine:
                     continue
                 self.scheduler.cancel(rid)
                 self._finish_reasons[rid] = "cancelled"
+                self._finish_t[rid] = now
                 self._done.add(rid)
                 self._cancelled_count += 1
                 self._prompts.pop(rid, None)
@@ -1534,6 +1586,7 @@ class LLMEngine:
         self._inflight[:] = 0
         self._active_host = None
         self._active_dev = None
+        self._decode_chunk_warm = self.decode_chunk
         self._warmed = True
 
     def close(self) -> None:
@@ -1604,7 +1657,9 @@ class LLMEngine:
         self._toplogprobs.pop(req_id, None)
         self._submit_t.pop(req_id, None)
         self._first_token_t.pop(req_id, None)
+        self._finish_t.pop(req_id, None)
         self._finish_reasons.pop(req_id, None)
+        self._req_tenant.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
@@ -1623,18 +1678,66 @@ class LLMEngine:
             return None
         return self._first_token_t[req_id] - self._submit_t[req_id]
 
+    def request_timing(self, req_id: int) -> dict[str, Any]:
+        """Wall-clock record for one request (the loadgen runner's SLO
+        input): submit / first-token / finish instants (time.monotonic;
+        None until they happen), tenant, and tokens delivered so far.
+        Read BEFORE release() — release drops all of it."""
+        return {
+            "submit_s": self._submit_t.get(req_id),
+            "first_token_s": self._first_token_t.get(req_id),
+            "finish_s": self._finish_t.get(req_id),
+            "tenant": self._req_tenant.get(req_id),
+            "n_tokens": len(self._results.get(req_id, ())),
+        }
+
+    def set_tenant_limits(self, max_active_per_tenant: int = 0,
+                          max_queued_per_tenant: int = 0) -> None:
+        """Per-tenant fairness/admission knobs, forwarded to the scheduler
+        (both twins): a soft work-conserving share cap on decode slots and
+        a hard admission cap on queued requests (over it, submit raises
+        TenantOverQuota). 0 disables either."""
+        self.scheduler.set_fairness(max_active_per_tenant,
+                                    max_queued_per_tenant)
+
+    @property
+    def decode_chunk_max(self) -> int:
+        """Largest decode chunk the warmed program menu supports (the
+        set_decode_chunk clamp; the SLO controller's upper bound)."""
+        return self._decode_chunk_warm
+
+    def set_decode_chunk(self, chunk: int) -> int:
+        """Re-pick the decode chunk length at runtime (the SLO-aware
+        `ttft_target_ms` control surface — loadgen/control.py): a prefill
+        wave must drain the in-flight chunk first, so TTFT carries ~one
+        chunk of decode wall time, while throughput mildly prefers longer
+        chunks (measured at 8B/32 slots: chunk 8 = 1055 tok/s / p50
+        ~465 ms; chunk 4 = 990 tok/s / p50 ~217 ms). Applied at the next
+        chunk boundary — _do_decode reads self.decode_chunk per dispatch.
+        After warmup the value is clamped to the warmed menu (powers of
+        two up to the construction-time decode_chunk) so live traffic
+        never waits on the XLA compiler. Returns the applied value."""
+        chunk = max(1, int(chunk))
+        if self._warmed:
+            chunk = min(chunk, self._decode_chunk_warm)
+        self.decode_chunk = chunk
+        return chunk
+
     def metrics(self) -> dict[str, Any]:
         ttfts = list(self._ttft_window)  # survives release() of old requests
         s = self.scheduler.stats()
         out = {"queued": s.queued, "active": s.active,
                "completed": s.completed, "rejected": s.rejected,
-               "cancelled": self._cancelled_count}
+               "cancelled": self._cancelled_count,
+               "decode_chunk": self.decode_chunk}
         if self.prefix_cache_enabled:
             out["prefix_hits"] = self._prefix_hits
             out["prefix_misses"] = self._prefix_misses
             out["prefix_entries"] = len(self._prefix_store)
         if self.adapters is not None:
             out["adapters_loaded"] = sorted(self._adapter_idx)
+        if self._tenant_idx:
+            out["tenants_seen"] = len(self._tenant_idx)
         if self.spec:
             out["spec_verify_rounds"] = self._spec_verifies
             out["spec_tokens_emitted"] = self._spec_tokens
@@ -2121,6 +2224,7 @@ class LLMEngine:
             # truncation
             self._finish_reasons[req_id] = (
                 "stop" if (hit_eos or hit_stop) else "length")
+            self._finish_t[req_id] = time.monotonic()
             self._done.add(req_id)
             self._prompts.pop(req_id, None)
             self._max_new.pop(req_id, None)

@@ -387,7 +387,8 @@ class LLMModel(Model):
             frequency_penalty=float(payload.get("frequency_penalty", 0.0)),
             seed=None if seed is None else int(seed),
             stop=self._encode_stops(payload.get("stop")),
-            deadline_s=deadline)
+            deadline_s=deadline,
+            tenant=payload.get("tenant"))
         self._wake.set()
         return rid
 

@@ -5,6 +5,16 @@ ctypes; `PyScheduler` is the pure-Python fallback with identical semantics
 (used when no toolchain is available, and as the differential-testing oracle
 for the native one). Both expose the same small API the LLM engine loop
 consumes: submit / next / token_done / slot_request / stats.
+
+Multi-tenant fairness (loadgen subsystem, ROADMAP #4): `submit` takes an
+optional integer tenant id; the queue is per-tenant FIFO and the pop
+policy is max-min fair over decode slots — among tenants with queued work,
+prefer the one holding the FEWEST active slots (tie: oldest head request).
+`set_fairness(max_active_per_tenant, max_queued_per_tenant)` adds a soft
+share cap (over-cap tenants wait while an under-cap tenant is queued, but
+the policy stays work-conserving) and hard admission control (submits past
+the per-tenant queue cap raise `TenantOverQuota`). All-tenant-0 traffic
+reduces exactly to the old global FIFO.
 """
 
 from __future__ import annotations
@@ -44,6 +54,11 @@ class QueueFull(RuntimeError):
     pass
 
 
+class TenantOverQuota(QueueFull):
+    """Per-tenant admission cap exceeded (max_queued_per_tenant); a subtype
+    of QueueFull so existing 503 mappings catch it."""
+
+
 class PromptTooLong(ValueError):
     pass
 
@@ -61,9 +76,12 @@ class NativeScheduler:
             ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         self._lib.cbs_destroy.argtypes = [ctypes.c_void_p]
-        self._lib.cbs_submit.restype = ctypes.c_int64
-        self._lib.cbs_submit.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_double]
+        self._lib.cbs_submit_t.restype = ctypes.c_int64
+        self._lib.cbs_submit_t.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_int32]
+        self._lib.cbs_set_fairness.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         self._lib.cbs_next.restype = ctypes.c_int32
         self._lib.cbs_next.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
@@ -72,6 +90,9 @@ class NativeScheduler:
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         self._lib.cbs_slot_request.restype = ctypes.c_int64
         self._lib.cbs_slot_request.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        self._lib.cbs_tenant_active.restype = ctypes.c_int32
+        self._lib.cbs_tenant_active.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int32]
         self._lib.cbs_cancel.restype = ctypes.c_int32
         self._lib.cbs_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         self._lib.cbs_stats.argtypes = [ctypes.c_void_p] + \
@@ -89,13 +110,28 @@ class NativeScheduler:
             self._h = None
 
     def submit(self, prompt_len: int, max_new_tokens: int,
-               now: float = 0.0) -> int:
-        rid = self._lib.cbs_submit(self._h, prompt_len, max_new_tokens, now)
+               now: float = 0.0, tenant: int = 0) -> int:
+        rid = self._lib.cbs_submit_t(self._h, prompt_len, max_new_tokens,
+                                     now, tenant)
         if rid == -1:
             raise QueueFull("scheduler queue full")
         if rid == -2:
             raise PromptTooLong(f"prompt_len {prompt_len} exceeds buckets")
+        if rid == -3:
+            raise TenantOverQuota(
+                f"tenant {tenant} over its admission quota")
         return rid
+
+    def set_fairness(self, max_active_per_tenant: int = 0,
+                     max_queued_per_tenant: int = 0) -> None:
+        """Per-tenant share cap (soft, work-conserving) and admission cap
+        (hard); 0 disables either."""
+        self._lib.cbs_set_fairness(self._h, int(max_active_per_tenant),
+                                   int(max_queued_per_tenant))
+
+    def tenant_active(self, tenant: int) -> int:
+        """Active decode slots currently held by `tenant`."""
+        return int(self._lib.cbs_tenant_active(self._h, tenant))
 
     def next(self) -> PrefillAction | DecodeAction | None:
         out = (ctypes.c_int64 * 5)()
@@ -134,6 +170,7 @@ class _PySlot:
     req_id: int = -1
     generated: int = 0
     max_new: int = 0
+    tenant: int = 0
     active: bool = False
 
 
@@ -143,38 +180,86 @@ class PyScheduler:
     def __init__(self, max_slots: int, buckets: Sequence[int],
                  max_queue: int = 1024):
         self._buckets = sorted(buckets)
-        self._queue: deque = deque()
+        # per-tenant FIFO, iterated in sorted tenant order (the C++ twin's
+        # std::map order) so both twins break ties identically
+        self._queues: dict[int, deque] = {}
+        self._total_queued = 0
         self._slots = [_PySlot() for _ in range(max_slots)]
         self._max_queue = max_queue
+        self._max_active_per_tenant = 0
+        self._max_queued_per_tenant = 0
         self._next_id = 1
         self._completed = 0
         self._rejected = 0
         self._mu = threading.Lock()
 
     def submit(self, prompt_len: int, max_new_tokens: int,
-               now: float = 0.0) -> int:
+               now: float = 0.0, tenant: int = 0) -> int:
         with self._mu:
+            tenant = max(0, int(tenant))
             if prompt_len <= 0 or prompt_len > self._buckets[-1]:
                 self._rejected += 1
                 raise PromptTooLong(
                     f"prompt_len {prompt_len} exceeds buckets")
-            if len(self._queue) >= self._max_queue:
+            if self._total_queued >= self._max_queue:
                 self._rejected += 1
                 raise QueueFull("scheduler queue full")
+            q = self._queues.setdefault(tenant, deque())
+            if (self._max_queued_per_tenant > 0
+                    and len(q) >= self._max_queued_per_tenant):
+                self._rejected += 1
+                raise TenantOverQuota(
+                    f"tenant {tenant} over its admission quota")
             rid = self._next_id
             self._next_id += 1
-            self._queue.append((rid, prompt_len, max_new_tokens))
+            q.append((rid, prompt_len, max_new_tokens))
+            self._total_queued += 1
             return rid
+
+    def set_fairness(self, max_active_per_tenant: int = 0,
+                     max_queued_per_tenant: int = 0) -> None:
+        with self._mu:
+            self._max_active_per_tenant = max(0, int(max_active_per_tenant))
+            self._max_queued_per_tenant = max(0, int(max_queued_per_tenant))
+
+    def _tenant_active(self, tenant: int) -> int:
+        return sum(1 for s in self._slots
+                   if s.active and s.tenant == tenant)
+
+    def tenant_active(self, tenant: int) -> int:
+        with self._mu:
+            return self._tenant_active(tenant)
 
     def next(self) -> PrefillAction | DecodeAction | None:
         with self._mu:
             free = next((i for i, s in enumerate(self._slots)
                          if not s.active), -1)
-            if free >= 0 and self._queue:
-                rid, plen, max_new = self._queue.popleft()
+            if free >= 0 and self._total_queued:
+                # max-min fair tenant choice: prefer under-cap tenants,
+                # then fewest active slots, then oldest head request —
+                # byte-identical to cbs_next's loop over std::map order
+                best = None  # (tenant, active, head_id, under)
+                for tenant in sorted(self._queues):
+                    q = self._queues[tenant]
+                    if not q:
+                        continue
+                    a = self._tenant_active(tenant)
+                    under = (self._max_active_per_tenant <= 0
+                             or a < self._max_active_per_tenant)
+                    if (best is None or (under and not best[3])
+                            or (under == best[3]
+                                and (a, q[0][0]) < (best[1], best[2]))):
+                        best = (tenant, a, q[0][0], under)
+                tenant = best[0]
+                rid, plen, max_new = self._queues[tenant].popleft()
+                if not self._queues[tenant]:
+                    # drop drained queues: pop cost and memory stay
+                    # bounded by LIVE tenants, not tenants ever seen
+                    del self._queues[tenant]
+                self._total_queued -= 1
                 sl = self._slots[free]
-                sl.req_id, sl.generated, sl.max_new, sl.active = \
-                    rid, 0, max_new, True
+                sl.req_id, sl.generated, sl.max_new = rid, 0, max_new
+                sl.tenant, sl.active = tenant, True
                 bucket = next((b for b in self._buckets if b >= plen),
                               self._buckets[-1])
                 return PrefillAction(rid, free, bucket, plen, max_new)
@@ -206,10 +291,14 @@ class PyScheduler:
         oracle): "queued" | "active" | None. Cancelled requests count
         neither as completed nor rejected — the engine keeps the metric."""
         with self._mu:
-            for i, (rid, _plen, _mx) in enumerate(self._queue):
-                if rid == req_id:
-                    del self._queue[i]
-                    return "queued"
+            for tenant, q in list(self._queues.items()):
+                for i, (rid, _plen, _mx) in enumerate(q):
+                    if rid == req_id:
+                        del q[i]
+                        if not q:
+                            del self._queues[tenant]
+                        self._total_queued -= 1
+                        return "queued"
             for sl in self._slots:
                 if sl.active and sl.req_id == req_id:
                     sl.active = False
@@ -219,7 +308,7 @@ class PyScheduler:
 
     def stats(self) -> Stats:
         with self._mu:
-            return Stats(len(self._queue),
+            return Stats(self._total_queued,
                          sum(s.active for s in self._slots),
                          self._completed, self._rejected)
 

@@ -37,6 +37,43 @@ class NotReadyError(Exception):
     """Model exists but cannot serve yet (→ HTTP 503, retryable)."""
 
 
+def _client_gone(sock) -> bool:
+    """True when the streaming client hung up. A write into a dead socket
+    only fails once the kernel send buffer fills, so an abandoned stream
+    could decode for many chunks before the BrokenPipeError lands (the
+    cancellation-storm gap, ROADMAP #4). The request body was fully read
+    and SSE clients never pipeline a second request (Connection: close),
+    so the socket becoming READABLE means EOF/RST: select + MSG_PEEK
+    detects the disconnect before the next token write, and the engine
+    slot frees at the next chunk boundary instead of at buffer-full.
+
+    DOCUMENTED TRADE-OFF: a client that half-closes its WRITE side
+    (shutdown(SHUT_WR)) after the request but keeps reading presents the
+    same read-side EOF and is treated as gone — its stream is cancelled.
+    Half-close is vanishingly rare for SSE consumers, and the
+    alternative (decoding to completion for every silently-vanished
+    client) is the capacity leak this probe exists to close."""
+    import select
+    import socket
+
+    try:
+        r, _, _ = select.select([sock], [], [], 0)
+        if not r:
+            return False
+        sock.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT)
+    except (BlockingIOError, InterruptedError):
+        return False  # spurious select wakeup (select(2) BUGS: readable
+        #               then EAGAIN) / EINTR: the client is still there
+    except (OSError, ValueError):
+        return True   # closed/invalid fd: the client is gone either way
+    # readable with data is ALSO treated as gone: an SSE client never
+    # sends during the response (Connection: close — pipelining is
+    # ignored anyway), and because MSG_PEEK never drains, one stray
+    # byte would otherwise read as "readable, not EOF" on every token
+    # and permanently blind the probe for this stream
+    return True
+
+
 class ModelServer:
     def __init__(self, repository: ModelRepository | None = None,
                  port: int = 0, name: str = "kubeflow-tpu-server",
@@ -298,6 +335,16 @@ class ModelServer:
                     or seed < 0:
                 raise ProtocolError("seed must be a non-negative integer")
             payload["seed"] = seed
+        # OpenAI `user` → engine tenant: per-tenant fair scheduling and
+        # admission caps key on it (loadgen subsystem)
+        user = body.get("user")
+        if user is not None:
+            if not isinstance(user, str) or not 1 <= len(user) <= 256:
+                # the length cap matters: tenant names are retained for
+                # the engine's lifetime (the fairness map), so unbounded
+                # client-chosen strings would be a memory lever
+                raise ProtocolError("user must be a string of 1..256 chars")
+            payload["tenant"] = user
         try:
             n = int(body.get("n", 1))
             best_of = int(body.get("best_of", n))
@@ -406,6 +453,19 @@ class ModelServer:
         gen_tokens = sum(len(r["token_ids"]) for r in results)
         choices = [self._build_choice(m, payload, r, i, chat)
                    for i, r in enumerate(results[:n_choices])]
+        usage = {"prompt_tokens": len(payload["prompt_tokens"]),
+                 "completion_tokens": gen_tokens,
+                 "total_tokens":
+                     len(payload["prompt_tokens"]) + gen_tokens}
+        # cancelled terminal state (deadline / disconnect): count over the
+        # RETURNED choices only — a discarded best_of candidate that was
+        # cancelled must not flag a fully-delivered answer as partial
+        # (its tokens still bill via completion_tokens, like any other
+        # discarded candidate's)
+        n_cancelled = sum(r["finish_reason"] == "cancelled"
+                          for r in results[:n_choices])
+        if n_cancelled:
+            usage["cancelled"] = n_cancelled
         return 200, {
             "object": "chat.completion" if chat else "text_completion",
             "model": m.name, "choices": choices,
@@ -413,10 +473,7 @@ class ModelServer:
             # best_of candidates that were not returned) — the tokens the
             # accelerator actually produced; total_tokens is their sum
             # (the field OpenAI clients read for billing/limits)
-            "usage": {"prompt_tokens": len(payload["prompt_tokens"]),
-                      "completion_tokens": gen_tokens,
-                      "total_tokens":
-                          len(payload["prompt_tokens"]) + gen_tokens}}
+            "usage": usage}
 
     def _stream_completion(self, handler, body: dict[str, Any],
                            chat: bool = False) -> None:
@@ -449,10 +506,12 @@ class ModelServer:
         decoder = StreamDecoder(m.tokenizer)
         first = [True]
         want_lp = payload.get("want_logprobs")
+        n_sent = 0
 
         def chunk_of(text: str, token_id: int | None = None,
                      reason: str | None = None,
-                     logprob: float | None = None) -> bytes:
+                     logprob: float | None = None,
+                     usage: dict[str, Any] | None = None) -> bytes:
             choice: dict[str, Any] = {"index": 0, "finish_reason": reason}
             if chat:
                 choice["delta"] = ({"role": "assistant", "content": text}
@@ -464,10 +523,13 @@ class ModelServer:
                 choice["token_id"] = token_id
             if logprob is not None:
                 choice["logprob"] = logprob
-            return ("data: " + json.dumps(
-                {"object": ("chat.completion.chunk" if chat
-                            else "text_completion.chunk"),
-                 "model": m.name, "choices": [choice]}) + "\n\n").encode()
+            body: dict[str, Any] = {
+                "object": ("chat.completion.chunk" if chat
+                           else "text_completion.chunk"),
+                "model": m.name, "choices": [choice]}
+            if usage is not None:
+                body["usage"] = usage
+            return ("data: " + json.dumps(body) + "\n\n").encode()
 
         try:   # everything after the headers: a disconnect anywhere here
                # must not fall back to do_POST's JSON 500 on this socket
@@ -478,6 +540,12 @@ class ModelServer:
                 handler.wfile.flush()
             try:
                 for tok, lp in token_iter:
+                    if _client_gone(handler.connection):
+                        # detected BEFORE the kernel buffer masks it: jump
+                        # to the disconnect path, which closes the
+                        # generator and cancels the engine request
+                        raise BrokenPipeError("stream client disconnected")
+                    n_sent += 1
                     handler.wfile.write(chunk_of(
                         decoder.push(tok), token_id=int(tok),
                         logprob=(float(lp) if want_lp else None)))
@@ -494,7 +562,20 @@ class ModelServer:
             else:
                 tail = decoder.flush()
                 reason = finish[0] if finish else "length"
-                handler.wfile.write(chunk_of(tail, reason=reason))
+                # the final chunk carries the usage object; a deadline-
+                # cancelled stream (engine finish_reason "cancelled")
+                # surfaces its terminal state HERE — the client sees how
+                # many tokens were actually delivered and why it ended
+                n_prompt = len(payload["prompt_tokens"])
+                usage = {"prompt_tokens": n_prompt,
+                         "completion_tokens": n_sent,
+                         "total_tokens": n_prompt + n_sent}
+                if reason == "cancelled":
+                    # same type as the buffered path: a COUNT of
+                    # cancelled returned choices (a stream has one)
+                    usage["cancelled"] = 1
+                handler.wfile.write(chunk_of(tail, reason=reason,
+                                             usage=usage))
             handler.wfile.write(b"data: [DONE]\n\n")
             handler.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):

@@ -1,0 +1,176 @@
+"""Loadgen trace generator: determinism is a hard contract (same seed =>
+byte-identical trace, in-process AND across processes), plus the
+statistical shape each scenario dimension promises. All jax-free — the
+trace layer must stay importable by lightweight clients."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.loadgen import scenarios
+from kubeflow_tpu.loadgen.trace import (Trace, TraceConfig, generate_trace,
+                                        offered_tokens, tenant_names,
+                                        trace_bytes, trace_sha256)
+
+CFG = TraceConfig(seed=7, duration_s=20.0, base_rate_rps=3.0,
+                  burst_amplitude=0.6, burst_period_s=8.0, n_tenants=4,
+                  adapters=("a0", "a1"), cancel_frac=0.3, vocab=512)
+
+
+def test_same_seed_byte_identical_in_process():
+    a, b = generate_trace(CFG), generate_trace(CFG)
+    assert trace_bytes(a) == trace_bytes(b)
+    assert trace_sha256(a) == trace_sha256(b)
+
+
+def test_same_seed_byte_identical_across_processes():
+    """The sha re-derives in a FRESH interpreter — no hidden process
+    state (hash randomization, dict order, platform rng) in the bytes."""
+    prog = (
+        "from kubeflow_tpu.loadgen.trace import *\n"
+        f"cfg = TraceConfig.from_json({CFG.to_json()!r})\n"
+        "print(trace_sha256(generate_trace(cfg)))\n")
+    out = subprocess.run([sys.executable, "-c", prog],
+                        capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == trace_sha256(generate_trace(CFG))
+
+
+def test_different_seed_differs():
+    assert trace_bytes(generate_trace(CFG)) != \
+        trace_bytes(generate_trace(CFG.replace(seed=8)))
+
+
+def test_config_round_trip_and_trace_round_trip():
+    tr = generate_trace(CFG)
+    assert TraceConfig.from_json(
+        json.loads(json.dumps(CFG.to_json()))) == CFG
+    assert Trace.from_json(json.loads(trace_bytes(tr))) == tr
+
+
+def test_arrivals_sorted_within_window():
+    tr = generate_trace(CFG)
+    ts = [r.arrival_s for r in tr.requests]
+    assert ts == sorted(ts)
+    assert all(0.0 <= t < CFG.duration_s for t in ts)
+    assert len(ts) > 10   # 3 rps x 20 s can't plausibly produce fewer
+
+
+def test_prompt_lengths_follow_the_mixture():
+    tr = generate_trace(CFG.replace(duration_s=60.0))
+    lens = [len(r.prompt) for r in tr.requests]
+    lo = min(l for l, _, _ in CFG.prompt_len_mix)
+    hi = max(h for _, h, _ in CFG.prompt_len_mix)
+    assert min(lens) >= lo and max(lens) <= hi
+    # the mixture is heterogeneous: both the short and long bands appear
+    assert any(l <= 48 for l in lens) and any(l > 120 for l in lens)
+    assert all(1 <= t < CFG.vocab for r in tr.requests for t in r.prompt)
+
+
+def test_output_budgets_within_range():
+    tr = generate_trace(CFG)
+    assert all(CFG.output_len[0] <= r.max_new_tokens <= CFG.output_len[1]
+               for r in tr.requests)
+
+
+def test_tenant_popularity_is_zipf_skewed():
+    tr = generate_trace(CFG.replace(duration_s=120.0, tenant_skew=1.5))
+    counts = {}
+    for r in tr.requests:
+        counts[r.tenant] = counts.get(r.tenant, 0) + 1
+    # rank-1 tenant strictly dominates the tail under skew 1.5
+    assert counts["t0"] > counts.get("t3", 0)
+    assert set(counts) <= {f"t{i}" for i in range(CFG.n_tenants)}
+
+
+def test_adapter_fleet_and_base_fraction():
+    tr = generate_trace(CFG.replace(duration_s=120.0))
+    used = {r.adapter for r in tr.requests}
+    assert None in used            # adapter_none_frac keeps base traffic
+    assert used - {None} <= set(CFG.adapters)
+
+
+def test_cancellation_fraction_approximate():
+    tr = generate_trace(CFG.replace(duration_s=120.0, cancel_frac=0.5))
+    frac = np.mean([r.cancel_after_s is not None for r in tr.requests])
+    assert 0.35 < frac < 0.65
+    for r in tr.requests:
+        if r.cancel_after_s is not None:
+            assert CFG.cancel_after_s[0] <= r.cancel_after_s \
+                <= CFG.cancel_after_s[1]
+
+
+def test_burst_modulation_changes_density():
+    """Amplitude ~1 concentrates arrivals near the sine peaks: the
+    peak-half of each cycle must hold well over half the arrivals."""
+    cfg = CFG.replace(duration_s=80.0, burst_amplitude=1.0,
+                      burst_period_s=20.0, cancel_frac=0.0)
+    tr = generate_trace(cfg)
+    phase = [(2 * np.pi * r.arrival_s / 20.0) % (2 * np.pi)
+             for r in tr.requests]
+    peak_half = sum(0.0 <= p < np.pi for p in phase)
+    assert peak_half / len(phase) > 0.6
+
+
+def test_validation_rejects_bad_configs():
+    with pytest.raises(ValueError):
+        generate_trace(CFG.replace(burst_amplitude=1.5))
+    with pytest.raises(ValueError):
+        generate_trace(CFG.replace(cancel_frac=-0.1))
+    with pytest.raises(ValueError):
+        generate_trace(CFG.replace(n_tenants=0))
+    with pytest.raises(ValueError):
+        generate_trace(CFG.replace(prompt_len_mix=((0, 4, 1.0),)))
+
+
+def test_helpers():
+    tr = generate_trace(CFG)
+    names = tenant_names(tr)
+    assert names and all(n.startswith("t") for n in names)
+    assert offered_tokens(tr) == sum(r.max_new_tokens
+                                     for r in tr.requests)
+    assert offered_tokens(tr, [names[0]]) <= offered_tokens(tr)
+
+
+# -- committed scenario configs ---------------------------------------------
+
+def test_all_committed_scenarios_load_and_generate():
+    assert len(scenarios.SCENARIOS) >= 4
+    for name in scenarios.SCENARIOS:
+        s = scenarios.load_scenario(name)
+        assert s.name == name
+        tr = generate_trace(s.trace)
+        assert len(tr.requests) > 0
+        assert trace_sha256(tr) == trace_sha256(generate_trace(s.trace))
+
+
+def test_scenario_fleet_covers_the_dimensions():
+    """The committed fleet exercises every workload dimension the suite
+    exists for: bursts, multi-tenant adapter fleets with caps,
+    cancellations, and the SLO-chase control hook."""
+    fleet = {n: scenarios.load_scenario(n) for n in scenarios.SCENARIOS}
+    assert any(s.trace.burst_amplitude > 0 for s in fleet.values())
+    assert any(s.trace.adapters and s.trace.n_tenants > 1
+               and s.tenant_max_active > 0 for s in fleet.values())
+    assert any(s.trace.cancel_frac > 0 for s in fleet.values())
+    assert any(s.slo_chase for s in fleet.values())
+
+
+def test_miniature_preserves_shape():
+    s = scenarios.load_scenario("multi_tenant_lora")
+    m = scenarios.miniature(s, vocab=128, max_prompt_len=14,
+                            duration_s=3.0, rate_rps=5.0)
+    assert m.name == s.name
+    assert m.tenant_max_active == s.tenant_max_active
+    assert m.trace.n_tenants == s.trace.n_tenants
+    assert m.trace.adapters == s.trace.adapters
+    tr = generate_trace(m.trace)
+    assert all(len(r.prompt) <= 14 for r in tr.requests)
+    assert all(t < 128 for r in tr.requests for t in r.prompt)
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(KeyError):
+        scenarios.load_scenario("nope")

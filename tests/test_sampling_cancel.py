@@ -539,6 +539,45 @@ def test_openai_sampling_field_validation(sampling_server):
     assert "top_logprobs" not in out["choices"][0]["logprobs"]
 
 
+def test_cancelled_terminal_state_in_usage(sampling_server):
+    """A deadline-cancelled buffered completion still returns 200 with
+    its partial output, finish_reason "cancelled", and the usage object
+    carrying the cancelled terminal state (the loadgen prerequisite:
+    clients must be able to tell a truncated-result bill from a full
+    one)."""
+    code, out = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 40,
+        "timeout": 0.001})
+    assert code == 200
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "cancelled"
+    assert len(choice["token_ids"]) < 40
+    usage = out["usage"]
+    assert usage["cancelled"] == 1
+    assert usage["completion_tokens"] == len(choice["token_ids"])
+    # an uncancelled request's usage stays exactly the old shape
+    code, out = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 2})
+    assert code == 200
+    assert "cancelled" not in out["usage"]
+
+
+def test_openai_user_field_routes_tenant(sampling_server):
+    """OpenAI `user` -> engine tenant: bad types 400, good requests land
+    in the per-tenant fair queues (observable via tenants_seen)."""
+    code, _ = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 2, "user": 7})
+    assert code == 400
+    code, _ = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 2, "user": "acme"})
+    assert code == 200
+    code, _ = _post(sampling_server, {
+        "model": "llm", "prompt": "Hi", "max_tokens": 2, "user": "bbb"})
+    assert code == 200
+    m = sampling_server.repository.get("llm")
+    assert m.metrics()["tenants_seen"] >= 2
+
+
 def test_openai_stop_string_over_http(sampling_server, tiny):
     """A stop STRING is tokenizer-encoded and trimmed from the output
     (byte tokenizer: exact token-aligned matching)."""

@@ -1,0 +1,169 @@
+"""Per-tenant fairness and admission in the CB scheduler — both twins
+(the Python scheduler is the differential oracle for the C++ one, same
+as test_llm_serving's policy tests). jax-free."""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.serving.scheduler import (DecodeAction, NativeScheduler,
+                                            PrefillAction, PyScheduler,
+                                            TenantOverQuota)
+
+BOTH = pytest.mark.parametrize("cls", [NativeScheduler, PyScheduler])
+
+
+@BOTH
+def test_single_tenant_stays_fifo(cls):
+    """Back-compat: all-tenant-0 traffic reduces to the old global FIFO."""
+    s = cls(2, (8,))
+    ids = [s.submit(4, 2) for _ in range(4)]
+    got = []
+    for _ in range(2):
+        a = s.next()
+        assert isinstance(a, PrefillAction)
+        got.append(a.req_id)
+    assert got == ids[:2]
+    assert isinstance(s.next(), DecodeAction)
+
+
+@BOTH
+def test_max_min_fair_pop_interleaves_tenants(cls):
+    """Tenant A floods first; B arrives later — the pop still alternates,
+    because B holds fewer slots at each choice point."""
+    s = cls(4, (8,))
+    a_ids = [s.submit(4, 2, tenant=1) for _ in range(4)]
+    b_ids = [s.submit(4, 2, tenant=2) for _ in range(2)]
+    order = [s.next().req_id for _ in range(4)]
+    # both at 0 active: tie breaks to A's older head; then B (0 < 1);
+    # then A (1 vs 1, A's head older); then B
+    assert order == [a_ids[0], b_ids[0], a_ids[1], b_ids[1]]
+
+
+@BOTH
+def test_share_cap_skips_over_cap_tenant(cls):
+    """max_active_per_tenant=1: once A holds a slot, B's queued request
+    wins the next free slot even though A queued first."""
+    s = cls(3, (8,))
+    s.set_fairness(max_active_per_tenant=1)
+    a1 = s.submit(4, 8, tenant=1)
+    a2 = s.submit(4, 8, tenant=1)
+    b1 = s.submit(4, 8, tenant=2)
+    assert s.next().req_id == a1
+    assert s.next().req_id == b1      # A is at cap, B under
+    # only A has queued work: the cap is WORK-CONSERVING — the free slot
+    # still serves A rather than idling
+    assert s.next().req_id == a2
+    assert s.tenant_active(1) == 2 and s.tenant_active(2) == 1
+
+
+@BOTH
+def test_admission_quota_rejects_per_tenant(cls):
+    s = cls(1, (8,))
+    s.set_fairness(max_queued_per_tenant=2)
+    s.submit(4, 2, tenant=1)
+    s.submit(4, 2, tenant=1)
+    before = s.stats().rejected
+    with pytest.raises(TenantOverQuota):
+        s.submit(4, 2, tenant=1)
+    assert s.stats().rejected == before + 1
+    # the quota is PER tenant: another tenant still gets in
+    s.submit(4, 2, tenant=2)
+    assert s.stats().queued == 3
+
+
+@BOTH
+def test_freed_slot_returns_to_starved_tenant(cls):
+    """When A holds every slot and B waits, the first completion hands
+    the slot to B (max-min share of slots)."""
+    s = cls(2, (8,))
+    s.submit(4, 4, tenant=1)
+    s.submit(4, 4, tenant=1)
+    s.submit(4, 4, tenant=1)
+    sl0 = s.next().slot
+    s.next()
+    assert s.tenant_active(1) == 2     # A holds every slot
+    b = s.submit(4, 4, tenant=2)       # B arrives while starved
+    s.token_done(sl0, finished=True)   # A's first request completes
+    assert s.next().req_id == b        # the freed slot goes to B,
+    assert s.tenant_active(2) == 1     # not A's older queued request
+
+
+@BOTH
+def test_cancel_queued_under_tenant_queues(cls):
+    s = cls(1, (8,))
+    s.submit(4, 2, tenant=1)
+    r2 = s.submit(4, 2, tenant=2)
+    assert s.cancel(r2) == "queued"
+    assert s.stats().queued == 1
+    assert s.cancel(r2) is None
+
+
+def test_drained_tenant_queues_are_dropped():
+    """Per-tenant queues are erased once empty: scheduler memory and
+    per-pop scan cost stay bounded by LIVE tenants, not every tenant id
+    ever seen (client-controlled via the OpenAI `user` field)."""
+    p = PyScheduler(2, (8,))
+    for t in range(1, 6):
+        p.submit(4, 1, tenant=t)
+    assert len(p._queues) == 5
+    p.next()
+    p.next()
+    assert len(p._queues) == 3    # two popped queues dropped
+    # cancelling the last queued request of a tenant drops its queue too
+    rid = p.submit(4, 1, tenant=9)
+    assert p.cancel(rid) == "queued"
+    assert 9 not in p._queues
+
+
+def test_differential_tenant_workload():
+    """Same randomized multi-tenant workload with caps through both
+    schedulers -> identical action traces, stats, and rejections (the
+    fairness policy must be implementation-identical, not just
+    similar)."""
+    rng = random.Random(42)
+    n = NativeScheduler(3, (8, 16, 32))
+    p = PyScheduler(3, (8, 16, 32))
+    n.set_fairness(2, 4)
+    p.set_fairness(2, 4)
+    live_n: list[int] = []
+    for step in range(400):
+        op = rng.random()
+        if op < 0.35:
+            plen = rng.choice((4, 9, 17, 31))
+            mx = rng.randint(1, 4)
+            tenant = rng.randint(0, 3)
+            rn = rp = None
+            try:
+                rn = n.submit(plen, mx, tenant=tenant)
+            except TenantOverQuota:
+                with pytest.raises(TenantOverQuota):
+                    p.submit(plen, mx, tenant=tenant)
+            else:
+                rp = p.submit(plen, mx, tenant=tenant)
+                assert rn == rp
+        elif op < 0.45 and live_n:
+            victim = rng.choice(live_n)
+            assert n.cancel(victim) == p.cancel(victim)
+            live_n = [r for r in live_n if r != victim]
+        else:
+            an, ap = n.next(), p.next()
+            assert an == ap
+            if isinstance(an, PrefillAction):
+                live_n.append(an.req_id)
+            elif isinstance(an, DecodeAction):
+                # advance one token on every active slot, randomly
+                # finishing a few — in matched order on both twins
+                for slot in range(3):
+                    rid = n.slot_request(slot)
+                    assert rid == p.slot_request(slot)
+                    if rid >= 0:
+                        fin = rng.random() < 0.3
+                        freed_n = n.token_done(slot, finished=fin)
+                        freed_p = p.token_done(slot, finished=fin)
+                        assert freed_n == freed_p
+                        if freed_n:
+                            live_n = [r for r in live_n if r != rid]
+        for t in range(4):
+            assert n.tenant_active(t) == p.tenant_active(t)
+    assert n.stats() == p.stats()
