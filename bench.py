@@ -269,6 +269,11 @@ def main() -> None:
                 on_tpu, budget)
         except Exception as e:
             extras["serving_scenarios_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "rl_anakin"):
+        try:
+            extras["rl_anakin"] = rl_anakin_bench(on_tpu)
+        except Exception as e:
+            extras["rl_anakin_error"] = f"{type(e).__name__}: {e}"
     extras["budget"] = {"total_s": budget.total_s,
                         "used_s": round(budget.elapsed(), 1),
                         "env": BUDGET_ENV}
@@ -293,10 +298,11 @@ def main() -> None:
                    else os.path.join(tempfile.gettempdir(),
                                      "BENCH_EXTRAS.cpu.json"))
     with open(extras_path, "w") as f:
-        # schema 2 = the record carries serving_scenarios; the floor gate
-        # only demands scenario metrics from records new enough to know
-        # about them (older committed records stay valid under --check)
-        json.dump({"schema": 2, "headline": headline, "extras": extras},
+        # schema 2 = the record carries serving_scenarios; schema 3 adds
+        # rl_anakin. The floor gate only demands a section's metrics from
+        # records new enough to know about it (older committed records
+        # stay valid under --check).
+        json.dump({"schema": 3, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -344,6 +350,12 @@ PERF_FLOORS = {
     # hundreds of tok/s of capacity and a 2 s TTFT SLO; raise toward the
     # measured number once the first green hardware run lands.
     "scenario_steady_slo_attainment": 0.5,
+    # rl_anakin (r8): enforced only on schema>=3 records. Conservative —
+    # the fused Anakin step sustains ~100k env-steps/s on the 1-core CPU
+    # box at B=64×T=32; a TPU at B=2048×T=64 clears this by orders of
+    # magnitude. Raise to just under the measured number once the first
+    # hardware record lands.
+    "rl_anakin_env_steps_per_s": 100_000.0,
 }
 
 
@@ -385,6 +397,9 @@ def check_floors(path: str) -> list[str]:
         checks.append(("scenario_steady_slo_attainment",
                        get(ex, "serving_scenarios", "steady",
                            "aggregate", "slo_attainment")))
+    if rec.get("schema", 1) >= 3:
+        checks.append(("rl_anakin_env_steps_per_s",
+                       get(ex, "rl_anakin", "env_steps_per_s")))
     failures = []
     for name, got in checks:
         floor = PERF_FLOORS[name]
@@ -1444,6 +1459,117 @@ def serving_scenarios_bench(on_tpu: bool, budget: Budget | None = None
         engine.close()
         del engine
     return out
+
+
+def rl_anakin_bench(on_tpu: bool) -> dict:
+    """Podracer/Anakin RL point (ROADMAP #5, the r8 rl/ subsystem):
+
+    - sustained env-steps/s of the fused rollout+PPO step (the whole
+      acting+learning loop is ONE compiled program — this number is the
+      on-device RL throughput the Podracer paper optimizes for);
+    - a seeded CartPole reward curve with a committed threshold (the
+      same seed is pinned bitwise by tests/test_rl_anakin.py, so the
+      recorded curve is reproducible by construction);
+    - a solo-vs-co-located interference record: the learner and a live
+      serving engine share the chip, each measured alone and packed
+      (PAPERS.md "Exploring the limits of Concurrency in ML Training on
+      Google TPUs"), plus the gang scheduler PackingPolicy's decision on
+      that record — the committed input that teaches the scheduler
+      whether rl-learner/llm-serving may share a chip.
+    """
+    from kubeflow_tpu.rl.anakin import AnakinLearner
+    from kubeflow_tpu.rl.config import REWARD_METRIC, AnakinConfig
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    cfg = AnakinConfig(
+        env="cartpole",
+        n_envs=2048 if on_tpu else 64,
+        rollout_len=64 if on_tpu else 32,
+        hidden=(64, 64), learning_rate=3e-3, seed=0)
+    learner = AnakinLearner(cfg)
+    state = learner.init(0)
+    state, steps_per_s = learner.measure_steps_per_s(
+        state, iters=20 if on_tpu else 10)
+
+    # committed seeded reward curve (fresh state so the curve is the
+    # canonical from-init trajectory, not continuation of the perf run)
+    curve_state = learner.init(0)
+    _, hist = learner.train(curve_state, 150, log_every=25)
+    threshold = 100.0   # mean balanced steps; random policy sits at ~20
+    curve = [{"update": h["update"],
+              REWARD_METRIC: round(h[REWARD_METRIC], 2)} for h in hist]
+    out = {
+        "env": cfg.env, "n_envs": cfg.n_envs,
+        "rollout_len": cfg.rollout_len,
+        "env_steps_per_update": learner.env_steps_per_update(),
+        "env_steps_per_s": round(steps_per_s, 1),
+        "updates_per_s": round(
+            steps_per_s / learner.env_steps_per_update(), 2),
+        "seed": cfg.seed,
+        "reward_curve": curve,
+        "reward_threshold": threshold,
+        "reward_reached": bool(hist[-1][REWARD_METRIC] >= threshold),
+    }
+    try:
+        out["interference"] = _rl_interference_point(learner, state, on_tpu,
+                                                     LLMEngine)
+    except Exception as e:   # best-effort, like the other extras
+        out["interference_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _rl_interference_point(learner, state, on_tpu: bool, engine_cls) -> dict:
+    """Solo/solo/packed rates for (Anakin learner, serving engine) on one
+    chip, and the PackingPolicy verdict the gang scheduler would apply."""
+    from kubeflow_tpu.control.scheduler import PackingPolicy
+    from kubeflow_tpu.rl.packing import measure_interference
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+        d_ff=3584, max_seq_len=1024, remat=False,
+    ) if on_tpu else llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    n_slots = 8 if on_tpu else 2
+    new_tokens = 32 if on_tpu else 8
+    prompt = list(range(1, 100)) if on_tpu else [3, 7, 11]
+    engine = engine_cls(params, cfg, n_slots=n_slots,
+                        max_len=256 if on_tpu else 64,
+                        buckets=(128,) if on_tpu else (16,))
+
+    cur = {"state": state}
+
+    def learner_chunk() -> float:
+        cur["state"], metrics = learner.step(cur["state"])
+        float(metrics["loss"])   # force completion (fetch = sync)
+        return float(learner.env_steps_per_update())
+
+    def serve_chunk() -> float:
+        rids = [engine.submit(prompt, new_tokens) for _ in range(n_slots)]
+        engine.run_until_idle()
+        for r in rids:
+            engine.release(r)
+        return float(n_slots * new_tokens)
+
+    # warmup INSIDE the try: an OOM mid-warmup (shared chip) must still
+    # close() the engine — it is cyclic, so gc alone does not drop its
+    # KV cache/params HBM promptly, and the rest of the bench would run
+    # against a needlessly pinned chip
+    try:
+        engine.warmup()
+        record = measure_interference(
+            "rl-learner", learner_chunk, "llm-serving", serve_chunk,
+            seconds=4.0 if on_tpu else 1.5,
+            unit_a="env_steps/s", unit_b="tok/s")
+    finally:
+        engine.close()
+        del engine, params
+    policy = PackingPolicy()
+    decision = policy.learn("rl-learner", "llm-serving", record.to_json())
+    return {**record.to_json(), "decision": decision.to_json(),
+            "policy": {"min_combined_retention":
+                       policy.min_combined_retention,
+                       "min_each_retention": policy.min_each_retention,
+                       "max_per_chip": policy.max_per_chip}}
 
 
 if __name__ == "__main__":

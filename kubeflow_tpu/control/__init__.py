@@ -33,6 +33,8 @@ from kubeflow_tpu.control.controller import Controller, Cluster  # noqa: F401
 from kubeflow_tpu.control.scheduler import (  # noqa: F401
     DeviceInventory,
     GangScheduler,
+    PackingDecision,
+    PackingPolicy,
 )
 from kubeflow_tpu.control.executor import PodExecutor, worker_target  # noqa: F401
 from kubeflow_tpu.control.jobs import JAXJobController  # noqa: F401

@@ -209,14 +209,17 @@ class Cluster:
         cluster.stop()
     """
 
-    def __init__(self, n_devices: int | None = None):
+    def __init__(self, n_devices: int | None = None, packing=None):
         # local imports: scheduler/executor import back into this package
         from kubeflow_tpu.control.executor import PodExecutor
         from kubeflow_tpu.control.scheduler import (DeviceInventory,
                                                     GangScheduler)
 
         self.store = ResourceStore()
-        self.inventory = DeviceInventory(n_devices=n_devices)
+        # `packing`: an optional scheduler.PackingPolicy — chips stay
+        # exclusive without one (see DeviceInventory)
+        self.inventory = DeviceInventory(n_devices=n_devices,
+                                         packing=packing)
         self.scheduler = GangScheduler(self.store, self.inventory)
         self.executor = PodExecutor(self.store)
         self.controllers: list[Controller] = []

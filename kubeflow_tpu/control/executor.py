@@ -69,7 +69,8 @@ def get_target(name: str) -> Callable[..., Any]:
         return getattr(importlib.import_module(mod), attr)
     if name not in _TARGETS:
         import importlib
-        for builtin in ("kubeflow_tpu.training.job",):
+        for builtin in ("kubeflow_tpu.training.job",
+                        "kubeflow_tpu.rl.job"):
             importlib.import_module(builtin)
     return _TARGETS[name]
 

@@ -238,18 +238,30 @@ FRAMEWORK_KINDS: tuple[str, ...] = tuple(
     c.kind for c in TRAINING_CONTROLLERS)
 
 # every training job kind, JAXJob first (the canonical list — cli.py and
-# hpo/trial.py must agree on what exists)
-ALL_JOB_KINDS: tuple[str, ...] = (JAXJobController.kind,) + FRAMEWORK_KINDS
+# hpo/trial.py must agree on what exists). RLJob rides the same engine but
+# its controller lives in kubeflow_tpu/rl/job.py, which imports THIS
+# package — so the kind constant is defined HERE (rl/job.py imports it;
+# that direction is cycle-free) and the class is resolved lazily by
+# _all_controllers() (add/validate time, never import time).
+RL_JOB_KIND = "RLJob"
+ALL_JOB_KINDS: tuple[str, ...] = ((JAXJobController.kind,)
+                                  + FRAMEWORK_KINDS + (RL_JOB_KIND,))
+
+
+def _all_controllers() -> tuple[type[JAXJobController], ...]:
+    from kubeflow_tpu.rl.job import RLJobController
+
+    return TRAINING_CONTROLLERS + (RLJobController,)
 
 
 def add_training_controllers(cluster) -> None:
     """Register every framework job kind on a Cluster — the unified
     training-operator manager analog (one manager, all reconcilers,
     ⊘ cmd/training-operator.v1/main.go)."""
-    for ctrl in TRAINING_CONTROLLERS:
+    for ctrl in _all_controllers():
         cluster.add(ctrl)
 
 
 def job_validators() -> dict[str, Any]:
     """kind → validator map for the admission layer (api/specs.py)."""
-    return {c.kind: c.validate for c in TRAINING_CONTROLLERS}
+    return {c.kind: c.validate for c in _all_controllers()}

@@ -11,46 +11,195 @@ The inventory models one TPU slice: `tpu` chips are countable, exclusive
 resources (the `google.com/tpu` extended-resource analog); `cpu` is a soft
 resource. Binding records concrete chip ids in `status.deviceIds` so a worker
 can pin itself (JAX visible-devices) — the device-plugin mount analog.
+
+Concurrency packing (PAPERS.md "Exploring the limits of Concurrency in ML
+Training on Google TPUs", ROADMAP #5): exclusive chips are the safe default,
+but a chip that is not roofline-bound on one workload can run a second in
+the gaps. A pod that declares `resources: {tpu: 1, packing_class: "<class>"}`
+opts into sharing; the inventory co-locates it onto an occupied chip ONLY
+when a `PackingPolicy` — fed by measured solo-vs-packed interference records
+(kubeflow_tpu.rl.packing) — has admitted that class pair. No policy, or no
+admitted pair, degrades to the exclusive behavior.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
-from typing import Any
+from typing import Any, Iterable
 
 from kubeflow_tpu.control.store import ResourceStore
 
 GROUP_LABEL = "kubeflow-tpu/pod-group"
 
+#: pod spec.resources key that opts a single-chip pod into packing
+PACKING_CLASS_KEY = "packing_class"
+
+
+@dataclasses.dataclass(frozen=True)
+class PackingDecision:
+    allow: bool
+    reason: str
+    combined_retention: float | None = None
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class PackingPolicy:
+    """Chip-time-slicing/packing policy, taught by interference records.
+
+    The decision quantity is `combined_retention` = packed_a/solo_a +
+    packed_b/solo_b. Perfect time-slicing scores exactly 1.0 (each
+    workload owns the chip half the time), so a pair is admitted only
+    when the measured sum clears `min_combined_retention` AND neither
+    side is starved below `min_each_retention` (the SLO guard: a packing
+    win that zeroes one tenant's throughput is not a win).
+
+    `learn(class_a, class_b, record)` applies `decide` and remembers the
+    verdict; `allows(cls, existing)` is the inventory-facing query.
+    """
+
+    def __init__(self, *, min_combined_retention: float = 1.05,
+                 min_each_retention: float = 0.25, max_per_chip: int = 2):
+        if max_per_chip < 1:
+            raise ValueError("max_per_chip must be >= 1")
+        self.min_combined_retention = min_combined_retention
+        self.min_each_retention = min_each_retention
+        self.max_per_chip = max_per_chip
+        self._pairs: dict[frozenset[str], PackingDecision] = {}
+
+    def decide(self, record: dict[str, Any]) -> PackingDecision:
+        """Pure decision logic over a record with solo_a/solo_b/packed_a/
+        packed_b (an InterferenceRecord.to_json shape)."""
+        solo_a, solo_b = record.get("solo_a", 0), record.get("solo_b", 0)
+        if solo_a <= 0 or solo_b <= 0:
+            return PackingDecision(False, "unmeasured solo rate")
+        ra = record.get("packed_a", 0) / solo_a
+        rb = record.get("packed_b", 0) / solo_b
+        combined = ra + rb
+        if min(ra, rb) < self.min_each_retention:
+            return PackingDecision(
+                False, f"one workload starved: retention "
+                f"{min(ra, rb):.3f} < {self.min_each_retention}", combined)
+        if combined < self.min_combined_retention:
+            return PackingDecision(
+                False, f"time-slicing wins: combined retention "
+                f"{combined:.3f} < {self.min_combined_retention}", combined)
+        return PackingDecision(
+            True, f"packing beats time-slicing: combined retention "
+            f"{combined:.3f}", combined)
+
+    def learn(self, class_a: str, class_b: str,
+              record: dict[str, Any]) -> PackingDecision:
+        d = self.decide(record)
+        self._pairs[frozenset((class_a, class_b))] = d
+        return d
+
+    def allows(self, cls: str, existing: Iterable[str]) -> bool:
+        """May a pod of `cls` join a chip already running `existing`?"""
+        occupants = list(existing)
+        if len(occupants) + 1 > self.max_per_chip:
+            return False
+        for other in occupants:
+            d = self._pairs.get(frozenset((cls, other)))
+            if d is None or not d.allow:
+                return False
+        return True
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "min_combined_retention": self.min_combined_retention,
+            "min_each_retention": self.min_each_retention,
+            "max_per_chip": self.max_per_chip,
+            "pairs": {"|".join(sorted(k)): d.to_json()
+                      for k, d in self._pairs.items()},
+        }
+
 
 class DeviceInventory:
-    """Countable chip inventory with exclusive allocation."""
+    """Countable chip inventory: exclusive allocation by default, policy-
+    gated chip sharing for pods that declare a packing class."""
 
-    def __init__(self, n_devices: int | None = None, cpu_capacity: int = 256):
+    def __init__(self, n_devices: int | None = None, cpu_capacity: int = 256,
+                 packing: PackingPolicy | None = None):
         if n_devices is None:
             n_devices = 8
         self.n_devices = n_devices
         self.cpu_capacity = cpu_capacity
+        self.packing = packing
         self._lock = threading.Lock()
         self._free = set(range(n_devices))
         self._cpu_used = 0
         self._held: dict[str, tuple[list[int], int]] = {}  # uid -> (chips, cpu)
+        # chips occupied by packable pods: chip -> [(uid, class), ...]
+        self._shared: dict[int, list[tuple[str, str]]] = {}
 
-    def fits(self, requests: list[dict[str, int]]) -> bool:
+    def set_packing(self, policy: PackingPolicy | None) -> None:
+        """Install/replace the packing policy (already-bound pods keep
+        their chips; only future placement consults the new policy)."""
         with self._lock:
-            tpu = sum(r.get("tpu", 0) for r in requests)
+            self.packing = policy
+
+    def _place(self, request: dict[str, Any], free: set[int],
+               shared: dict[int, list[str]]
+               ) -> tuple[list[int] | None, str | None]:
+        """THE greedy placement step, shared by fits() and allocate() so
+        the gang gate and the per-pod bind can never disagree: a
+        packable single-chip request joins the lowest-id compatible
+        shared chip, else opens `min(free)` as a new shared chip; an
+        exclusive request takes the lowest free ids. Mutates the passed
+        views and returns (chips, packing_class) — allocate passes live
+        state (a class-only shadow of _shared), fits passes copies."""
+        tpu = request.get("tpu", 0)
+        cls = request.get(PACKING_CLASS_KEY)
+        if tpu == 1 and cls is not None and self.packing is not None:
+            chip = next((ch for ch in sorted(shared)
+                         if self.packing.allows(cls, shared[ch])), None)
+            if chip is None:
+                if not free:
+                    return None, cls
+                chip = min(free)
+                free.discard(chip)
+                shared[chip] = []
+            shared[chip].append(cls)
+            return [chip], cls
+        if tpu > len(free):
+            return None, None
+        chips = sorted(free)[:tpu]
+        free -= set(chips)
+        return chips, None
+
+    def _shared_classes(self) -> dict[int, list[str]]:
+        return {chip: [c for _, c in occs]
+                for chip, occs in self._shared.items()}
+
+    def fits(self, requests: list[dict[str, Any]]) -> bool:
+        """Dry-run placement of a whole gang through the same _place
+        step the binds will take, against copied views."""
+        with self._lock:
             cpu = sum(r.get("cpu", 1) for r in requests)
-            return (tpu <= len(self._free)
-                    and self._cpu_used + cpu <= self.cpu_capacity)
+            if self._cpu_used + cpu > self.cpu_capacity:
+                return False
+            free = set(self._free)
+            shared = self._shared_classes()
+            return all(self._place(r, free, shared)[0] is not None
+                       for r in requests)
 
-    def allocate(self, uid: str, request: dict[str, int]) -> list[int] | None:
+    def allocate(self, uid: str, request: dict[str, Any]) -> list[int] | None:
         with self._lock:
-            tpu = request.get("tpu", 0)
             cpu = request.get("cpu", 1)
-            if tpu > len(self._free) or self._cpu_used + cpu > self.cpu_capacity:
+            if self._cpu_used + cpu > self.cpu_capacity:
                 return None
-            chips = sorted(self._free)[:tpu]
-            self._free -= set(chips)
+            free = set(self._free)
+            chips, cls = self._place(request, free, self._shared_classes())
+            if chips is None:
+                return None
+            self._free = free
+            if cls is not None:
+                # single shared chip: record the occupant (opening the
+                # chip if _place just took it out of the free set)
+                self._shared.setdefault(chips[0], []).append((uid, cls))
             self._cpu_used += cpu
             self._held[uid] = (chips, cpu)
             return chips
@@ -58,14 +207,25 @@ class DeviceInventory:
     def release(self, uid: str) -> None:
         with self._lock:
             held = self._held.pop(uid, None)
-            if held:
-                self._free |= set(held[0])
-                self._cpu_used -= held[1]
+            if not held:
+                return
+            self._cpu_used -= held[1]
+            for chip in held[0]:
+                occs = self._shared.get(chip)
+                if occs is not None:
+                    self._shared[chip] = [
+                        (u, c) for u, c in occs if u != uid]
+                    if not self._shared[chip]:
+                        del self._shared[chip]
+                        self._free.add(chip)
+                else:
+                    self._free.add(chip)
 
     def usage(self) -> dict[str, int]:
         with self._lock:
             return {"tpu_used": self.n_devices - len(self._free),
                     "tpu_capacity": self.n_devices,
+                    "tpu_shared": len(self._shared),
                     "cpu_used": self._cpu_used}
 
 

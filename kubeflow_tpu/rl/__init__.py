@@ -1,0 +1,57 @@
+"""RL on the platform: Podracer/Anakin on-TPU learners + concurrency
+packing (ROADMAP #5).
+
+- envs.py    : pure-JAX batched envs (jit/vmap, explicit PRNG, auto-reset)
+- anakin.py  : lax.scan rollout fused with the PPO/A2C update — one
+               compiled step, sharded over the mesh data axis
+- config.py  : jax-free AnakinConfig + KTPU_RL_CONFIG parsing
+- job.py     : the RLJob kind (JAXJob engine) + the `rl_learner` target
+- packing.py : solo-vs-co-located interference records for the gang
+               scheduler's PackingPolicy (control/scheduler.py)
+
+Import split: config/job/packing are jax-free at import time (the control
+plane registers RLJob without pulling the JAX runtime); envs/anakin load
+lazily via module __getattr__.
+"""
+
+from kubeflow_tpu.rl.config import (  # noqa: F401
+    AnakinConfig,
+    LEARNER_METRICS,
+    REWARD_METRIC,
+    parse_rl_config,
+)
+from kubeflow_tpu.rl.packing import (  # noqa: F401
+    InterferenceRecord,
+    measure_interference,
+)
+
+# job.py (the controller) and envs/anakin (jax) both load lazily: job.py
+# imports the control package, which in turn resolves RLJobController
+# lazily out of job.py — an eager import here would close that cycle.
+_LAZY = {
+    "RLJobController": ("kubeflow_tpu.rl.job", "RLJobController"),
+    "RL_JOB_KIND": ("kubeflow_tpu.rl.job", "RL_JOB_KIND"),
+    "AnakinLearner": ("kubeflow_tpu.rl.anakin", "AnakinLearner"),
+    "gae_advantages": ("kubeflow_tpu.rl.anakin", "gae_advantages"),
+    "ppo_loss": ("kubeflow_tpu.rl.anakin", "ppo_loss"),
+    "make_env": ("kubeflow_tpu.rl.envs", "make_env"),
+    "CartPole": ("kubeflow_tpu.rl.envs", "CartPole"),
+    "GridWorld": ("kubeflow_tpu.rl.envs", "GridWorld"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AnakinConfig", "AnakinLearner", "CartPole", "GridWorld",
+    "InterferenceRecord", "LEARNER_METRICS", "REWARD_METRIC",
+    "RLJobController", "RL_JOB_KIND", "gae_advantages", "make_env",
+    "measure_interference", "parse_rl_config", "ppo_loss",
+]

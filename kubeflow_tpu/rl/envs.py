@@ -1,0 +1,151 @@
+"""Pure-JAX batched RL environments — the Anakin substrate (PAPERS.md
+"Podracer architectures for scalable Reinforcement Learning" §2: the
+environment itself is compiled onto the accelerator, so rollout + learning
+fuse into ONE XLA program with no host↔device round trip per step).
+
+Conventions (gymnax-style, chosen so `lax.scan`/`vmap` compose cleanly):
+
+- Every env is a frozen dataclass of static physics/shape constants; the
+  dynamic state is a NamedTuple pytree of arrays.
+- `reset(key) -> (state, obs)` and `step(state, action, key) ->
+  (state, obs, reward, done)` operate on ONE environment; the learner
+  vmaps them over the batch axis. All randomness comes from the explicit
+  PRNG key — same key, same trajectory, bitwise.
+- **Auto-reset**: when a step terminates the episode, the returned state
+  and obs are ALREADY the next episode's reset (drawn from this step's
+  key), and `done=True` marks the boundary so GAE masks the bootstrap.
+  The terminal step's reward is kept; the terminal observation is not
+  (the policy never acts on it) — the standard Anakin/Brax contract.
+
+CartPole is the classic control task (reward 1 per balanced step, so the
+episode return IS the balanced length); GridWorld is a sparse-ish N×N
+navigation task that a tiny MLP learns in seconds on CPU — the fast-lane
+determinism/threshold tests run on these exact dynamics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array          # cart position
+    x_dot: jax.Array
+    theta: jax.Array      # pole angle (rad)
+    theta_dot: jax.Array
+    t: jax.Array          # steps into the episode (int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class CartPole:
+    """Cart-pole swing-keep (Barto-Sutton-Anderson physics, the standard
+    constants). Episode ends when the pole falls past ±12°, the cart
+    leaves ±2.4, or `max_steps` elapse."""
+
+    gravity: float = 9.8
+    cart_mass: float = 1.0
+    pole_mass: float = 0.1
+    pole_half_length: float = 0.5
+    force_mag: float = 10.0
+    tau: float = 0.02               # integration step (s)
+    theta_limit: float = 12 * 2 * jnp.pi / 360
+    x_limit: float = 2.4
+    max_steps: int = 200
+    reset_scale: float = 0.05       # uniform(-s, s) initial state
+
+    num_actions: ClassVar[int] = 2
+    obs_dim: ClassVar[int] = 4
+
+    def reset(self, key: jax.Array) -> Tuple[CartPoleState, jax.Array]:
+        v = jax.random.uniform(key, (4,), minval=-self.reset_scale,
+                               maxval=self.reset_scale)
+        state = CartPoleState(v[0], v[1], v[2], v[3],
+                              jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    def _obs(self, s: CartPoleState) -> jax.Array:
+        return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot])
+
+    def step(self, state: CartPoleState, action: jax.Array, key: jax.Array
+             ) -> Tuple[CartPoleState, jax.Array, jax.Array, jax.Array]:
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        total_mass = self.cart_mass + self.pole_mass
+        ml = self.pole_mass * self.pole_half_length
+        cos, sin = jnp.cos(state.theta), jnp.sin(state.theta)
+        tmp = (force + ml * state.theta_dot ** 2 * sin) / total_mass
+        theta_acc = (self.gravity * sin - cos * tmp) / (
+            self.pole_half_length
+            * (4.0 / 3.0 - self.pole_mass * cos ** 2 / total_mass))
+        x_acc = tmp - ml * theta_acc * cos / total_mass
+        nxt = CartPoleState(
+            x=state.x + self.tau * state.x_dot,
+            x_dot=state.x_dot + self.tau * x_acc,
+            theta=state.theta + self.tau * state.theta_dot,
+            theta_dot=state.theta_dot + self.tau * theta_acc,
+            t=state.t + 1)
+        done = ((jnp.abs(nxt.x) > self.x_limit)
+                | (jnp.abs(nxt.theta) > self.theta_limit)
+                | (nxt.t >= self.max_steps))
+        reward = jnp.ones((), jnp.float32)   # 1 per step survived
+        fresh, fresh_obs = self.reset(key)
+        state = jax.tree.map(lambda a, b: jnp.where(done, a, b), fresh, nxt)
+        obs = jnp.where(done, fresh_obs, self._obs(nxt))
+        return state, obs, reward, done
+
+
+class GridWorldState(NamedTuple):
+    xy: jax.Array         # int32[2], (col, row)
+    t: jax.Array          # int32 step counter
+
+
+@dataclasses.dataclass(frozen=True)
+class GridWorld:
+    """N×N grid: start at (0, 0), goal at (N-1, N-1); actions
+    right/down/left/up (walls clip); −0.01 per step, +1 at the goal.
+    Episode ends at the goal or after `max_steps`."""
+
+    size: int = 5
+    max_steps: int = 40
+    step_cost: float = 0.01
+    goal_reward: float = 1.0
+
+    num_actions: ClassVar[int] = 4
+    obs_dim: ClassVar[int] = 2
+
+    def reset(self, key: jax.Array) -> Tuple[GridWorldState, jax.Array]:
+        del key   # fixed start keeps the task stationary
+        state = GridWorldState(jnp.zeros((2,), jnp.int32),
+                               jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    def _obs(self, s: GridWorldState) -> jax.Array:
+        return s.xy.astype(jnp.float32) / max(self.size - 1, 1)
+
+    def step(self, state: GridWorldState, action: jax.Array, key: jax.Array
+             ) -> Tuple[GridWorldState, jax.Array, jax.Array, jax.Array]:
+        moves = jnp.array([[1, 0], [0, 1], [-1, 0], [0, -1]], jnp.int32)
+        xy = jnp.clip(state.xy + moves[action], 0, self.size - 1)
+        at_goal = jnp.all(xy == self.size - 1)
+        t = state.t + 1
+        done = at_goal | (t >= self.max_steps)
+        reward = jnp.where(at_goal, self.goal_reward,
+                           -self.step_cost).astype(jnp.float32)
+        fresh, fresh_obs = self.reset(key)
+        nxt = GridWorldState(xy, t)
+        state = jax.tree.map(lambda a, b: jnp.where(done, a, b), fresh, nxt)
+        obs = jnp.where(done, fresh_obs, self._obs(nxt))
+        return state, obs, reward, done
+
+
+ENVS: dict[str, type] = {"cartpole": CartPole, "gridworld": GridWorld}
+
+
+def make_env(name: str, **kwargs: Any):
+    """Instantiate a registered env (the model-registry analog for RL)."""
+    if name not in ENVS:
+        raise ValueError(f"unknown env {name!r}; registered: {sorted(ENVS)}")
+    return ENVS[name](**kwargs)

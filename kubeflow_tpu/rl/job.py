@@ -1,0 +1,107 @@
+"""RLJob — the RL training job kind on the shared JAXJob reconcile engine.
+
+Registered through control/frameworks.py exactly like the framework-compat
+kinds, so gang scheduling, expectations, RunPolicy, elastic resize,
+heartbeat detection, conditions, and Katib trial templating all treat an
+RLJob like a JAXJob (SURVEY.md §2.2's one-engine-many-kinds shape). What
+an RLJob adds:
+
+- a `learner` role (the Anakin single-program shape: one process per
+  chip-group, the env batch sharded inside the program — scale is mesh
+  axes, not replica counts, so the default replica count is 1);
+- admission-time validation of `KTPU_RL_CONFIG` (a typo'd field fails at
+  apply, not minutes into a gang-scheduled run);
+- the `rl_learner` worker target: builds an AnakinLearner from env
+  config, streams `mean_episode_return`/loss/entropy to the metrics file
+  and (under a Trial) the observation DB — which is what lets Katib
+  drive lr / entropy_coef / clip_eps through the existing suggestion
+  services with zero new plumbing.
+
+This module stays jax-free at import time (the controller/admission path
+must not pull the JAX runtime); the target imports the learner lazily.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from kubeflow_tpu.control.executor import worker_target
+# the kind string lives in frameworks.py (the canonical ALL_JOB_KINDS
+# list); importing it here is cycle-safe because frameworks never
+# imports this module at import time — only lazily in _all_controllers
+from kubeflow_tpu.control.frameworks import RL_JOB_KIND  # noqa: F401
+from kubeflow_tpu.control.jobs import JAXJobController
+from kubeflow_tpu.rl.config import parse_rl_config
+
+
+class RLJobController(JAXJobController):
+    """RLJob: the Anakin learner job kind. Inherits the JAXJob rendezvous
+    env (KTPU_COORDINATOR_ADDRESS for multi-host `jax.distributed`
+    learners) — an RL learner IS a JAX program; only the role schema and
+    the config admission check differ."""
+
+    kind = RL_JOB_KIND
+    roles = ("learner",)
+    role_priority = ("learner",)
+    success_roles = ("learner",)
+
+    @classmethod
+    def validate(cls, job: dict[str, Any]) -> list[str]:
+        errs = super().validate(job)
+        for rtype, rspec in job.get("spec", {}).get("replicaSpecs",
+                                                    {}).items():
+            raw = (rspec.get("template", {}).get("env", {})
+                   .get("KTPU_RL_CONFIG"))
+            if raw is None:
+                continue
+            try:
+                parse_rl_config(raw)
+            except (ValueError, TypeError) as e:
+                errs.append(
+                    f"replicaSpecs.{rtype}.template.env.KTPU_RL_CONFIG: {e}")
+        return errs
+
+
+@worker_target("rl_learner")
+def rl_learner_target(env: dict[str, str],
+                      cancel: threading.Event) -> None:
+    """Run an Anakin learner from env-provided config (the `trainer`
+    target's RL sibling — see training/job.py for the contract it
+    mirrors: metrics to KTPU_METRICS_FILE, observations to the trial DB,
+    cancellation between updates as SystemExit(143))."""
+    from kubeflow_tpu.hpo.observations import report_metric
+    from kubeflow_tpu.rl.anakin import AnakinLearner
+    from kubeflow_tpu.training.metrics_writer import MetricsWriter
+
+    cfg, num_updates, log_every = parse_rl_config(
+        env.get("KTPU_RL_CONFIG", "{}"))
+    metrics = MetricsWriter(env.get("KTPU_METRICS_FILE"))
+    trial = env.get("KTPU_TRIAL_NAME")
+
+    learner = AnakinLearner(cfg)
+    state = learner.init(cfg.seed)
+
+    def on_log(update: int, scalars: dict[str, float]) -> None:
+        emit = {k: v for k, v in scalars.items() if k != "update"}
+        metrics.write(update, emit)
+        if trial:
+            for k, v in emit.items():
+                report_metric(trial, k, float(v), update)
+
+    def cancelled() -> bool:
+        # checked every update, not just at the log cadence: pod
+        # deletion / elastic resize / Katib early-stop must not wait
+        # out up to log_every more fused updates
+        if cancel.is_set():
+            raise SystemExit(143)
+        return False
+
+    try:
+        learner.train(state, num_updates, log_every=log_every,
+                      callback=on_log, should_stop=cancelled)
+    finally:
+        metrics.close()
+    print(f"rl training done: {num_updates} updates on {cfg.env} "
+          f"({learner.env_steps_per_update()} env-steps/update)",
+          flush=True)

@@ -142,6 +142,9 @@ class InferenceServiceController(Controller):
                 for inst in replicas:
                     inst.stop()
             self._instances.clear()
+            for inst in self._pending_stop:   # deferred scale-downs
+                inst.stop()
+            self._pending_stop.clear()
             for r in self._routers.values():
                 r.stop()
             self._routers.clear()
@@ -434,7 +437,15 @@ class InferenceServiceController(Controller):
             return
         last = router.last_request_time
         if last and time.time() - last > idle:
-            self._stop_instance(ns, name, "predictor")
+            # defer the actual stop until AFTER this pass's set_backends
+            # has dropped the ports (the _pending_stop contract): stopping
+            # here would leave the router forwarding to a dead port for
+            # the rest of the pass — a request landing in that window got
+            # a 502 (caught by test_rollout_under_load racing the idle
+            # edge under the steady scenario)
+            with self._lock:
+                drop = self._instances.pop((ns, name, "predictor"), [])
+                self._pending_stop.extend(drop)
             default.update(ready=False, scaledToZero=True)
             default.pop("port", None)
             default.pop("ports", None)

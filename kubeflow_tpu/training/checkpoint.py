@@ -93,6 +93,20 @@ def restore_params(directory: str, abstract_params, *, step: int | None = None):
         step = step if step is not None else mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory!r}")
-        restored = mngr.restore(step, args=ocp.args.PyTreeRestore(
-            {"params": abstract}, partial_restore=True))
+        try:
+            args = ocp.args.PyTreeRestore({"params": abstract},
+                                          partial_restore=True)
+        except TypeError:
+            # older orbax spells partial restore via the legacy transforms
+            # API: transforms={} + an explicit restore_args tree drops
+            # checkpoint entries (opt_state, step) missing from the item
+            restore_args = jax.tree.map(
+                lambda x: ocp.ArrayRestoreArgs(sharding=sharding,
+                                               global_shape=x.shape,
+                                               dtype=x.dtype),
+                abstract)
+            args = ocp.args.PyTreeRestore(
+                {"params": abstract}, transforms={},
+                restore_args={"params": restore_args})
+        restored = mngr.restore(step, args=args)
     return restored["params"]

@@ -1,0 +1,181 @@
+"""RLJob: the Anakin learner job kind through the real control plane —
+gang-scheduled lifecycle, admission validation, metrics emission, and a
+Katib experiment driving lr/entropy_coef through templateKind RLJob
+(ROADMAP #5: Katib drives the RL hyperparameters with zero new plumbing)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeflow_tpu import hpo
+from kubeflow_tpu.control import (Cluster, add_training_controllers,
+                                  new_resource)
+from kubeflow_tpu.control.conditions import (JobConditionType, has_condition,
+                                             is_finished)
+from kubeflow_tpu.control.frameworks import ALL_JOB_KINDS
+from kubeflow_tpu.rl import RL_JOB_KIND, RLJobController, REWARD_METRIC
+from kubeflow_tpu.training.metrics_writer import read_metrics
+
+TINY_RL_CONFIG = {
+    "env": "gridworld", "env_kwargs": {"size": 3, "max_steps": 12},
+    "n_envs": 8, "rollout_len": 4, "hidden": [8, 8],
+    "learning_rate": 5e-3, "num_updates": 6, "log_every": 3,
+}
+
+
+def rl_job(name, config=None, **env):
+    return new_resource(RL_JOB_KIND, name, spec={
+        "replicaSpecs": {"learner": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {
+                "backend": "thread", "target": "rl_learner",
+                "env": {"KTPU_RL_CONFIG":
+                        json.dumps(config or TINY_RL_CONFIG), **env},
+                "resources": {"cpu": 1}},
+        }},
+    })
+
+
+def test_rl_job_kind_registered_everywhere():
+    assert RL_JOB_KIND in ALL_JOB_KINDS
+    from kubeflow_tpu.api.specs import VALIDATORS
+
+    assert RL_JOB_KIND in VALIDATORS
+    # Katib accepts RLJob as a trialTemplate kind
+    from kubeflow_tpu.hpo.experiment import validate_experiment
+
+    exp = {"spec": {
+        "objective": {"type": "maximize",
+                      "objectiveMetricName": REWARD_METRIC},
+        "parameters": [{"name": "lr", "parameterType": "double",
+                        "feasibleSpace": {"min": 1e-4, "max": 1e-1}}],
+        "trialTemplate": {"kind": RL_JOB_KIND, "spec": {}},
+    }}
+    assert not [e for e in validate_experiment(exp) if "kind" in e]
+
+
+def test_rl_job_validation():
+    # wrong role name
+    bad = new_resource(RL_JOB_KIND, "b", spec={"replicaSpecs": {
+        "worker": {"replicas": 1,
+                   "template": {"backend": "thread",
+                                "target": "rl_learner"}}}})
+    errs = RLJobController.validate(bad)
+    assert any("does not allow replica type" in e for e in errs)
+    # a typo'd config key fails at admission, not at run time
+    bad2 = rl_job("b2", config=dict(TINY_RL_CONFIG, learning_rat=0.1))
+    errs = RLJobController.validate(bad2)
+    assert any("unknown rl config keys" in e and "learning_rat" in e
+               for e in errs), errs
+    # unparseable JSON too
+    bad3 = rl_job("b3")
+    bad3["spec"]["replicaSpecs"]["learner"]["template"]["env"][
+        "KTPU_RL_CONFIG"] = "{not json"
+    assert any("KTPU_RL_CONFIG" in e
+               for e in RLJobController.validate(bad3))
+    # bad VALUES fail at admission too, not at run time (log_every=0
+    # would otherwise ZeroDivisionError inside the learner loop)
+    for bad_vals in ({"log_every": 0}, {"n_envs": 0},
+                     {"learning_rate": -1.0}, {"gamma": 0.0},
+                     {"env": "cartpol"},
+                     {"env_kwargs": {"max_step": 12}}):
+        j = rl_job("bv", config=dict(TINY_RL_CONFIG, **bad_vals))
+        assert RLJobController.validate(j), bad_vals
+    # the good job is clean
+    assert RLJobController.validate(rl_job("g")) == []
+
+
+def test_rl_job_e2e_trains_and_emits_metrics(tmp_path):
+    """An RLJob runs the fused Anakin learner through the ordinary gang
+    machinery: Created -> Running -> Succeeded, with the reward metric
+    streamed to the structured metrics file."""
+    mfile = str(tmp_path / "rl.jsonl")
+    c = Cluster(n_devices=8)
+    add_training_controllers(c)   # registers RLJob with everything else
+    with c:
+        c.store.create(rl_job("anakin", KTPU_METRICS_FILE=mfile))
+        done = c.wait_for(RL_JOB_KIND, "anakin",
+                          lambda o: is_finished(o["status"]), timeout=120)
+    assert has_condition(done["status"], JobConditionType.SUCCEEDED), \
+        done["status"]
+    recs = read_metrics(mfile)
+    assert recs, "learner wrote no metrics"
+    steps = [r["step"] for r in recs]
+    assert steps[-1] == 6                      # num_updates
+    for r in recs:
+        assert REWARD_METRIC in r["metrics"]
+        assert "entropy" in r["metrics"] and "loss" in r["metrics"]
+
+
+def test_rl_job_invalid_spec_fails_fast():
+    c = Cluster(n_devices=8)
+    c.add(RLJobController)
+    with c:
+        c.store.create(rl_job("bad",
+                              config=dict(TINY_RL_CONFIG, nope=1)))
+        done = c.wait_for(RL_JOB_KIND, "bad",
+                          lambda o: is_finished(o["status"]), timeout=30)
+    assert has_condition(done["status"], JobConditionType.FAILED)
+    msg = done["status"]["conditions"][-1]["message"]
+    assert "unknown rl config keys" in msg
+
+
+@pytest.fixture()
+def rl_hpo_cluster(tmp_path):
+    c = Cluster(n_devices=8)
+    add_training_controllers(c)
+    db = hpo.add_hpo_controllers(c, metrics_dir=str(tmp_path))
+    with c:
+        yield c, db
+    hpo.set_default_db(None)
+
+
+def test_katib_drives_rl_hyperparameters(rl_hpo_cluster):
+    """Experiment with templateKind RLJob: the suggestion service samples
+    lr/entropy_coef, each trial runs a real Anakin learner, and the
+    observation DB aggregates mean_episode_return as the objective."""
+    cluster, _ = rl_hpo_cluster
+    cfg_tpl = dict(TINY_RL_CONFIG,
+                   learning_rate="${trialParameters.lr}",
+                   entropy_coef="${trialParameters.ent}")
+    # placeholders must interpolate as bare JSON numbers, not strings:
+    # strip the quotes json.dumps put around them
+    tpl_str = json.dumps(cfg_tpl)
+    for ph in ("${trialParameters.lr}", "${trialParameters.ent}"):
+        tpl_str = tpl_str.replace(f'"{ph}"', ph)
+    cluster.store.create(new_resource("Experiment", "rl-sweep", spec={
+        "objective": {"type": "maximize",
+                      "objectiveMetricName": REWARD_METRIC},
+        "algorithm": {"algorithmName": "random"},
+        "parameters": [
+            {"name": "lr", "parameterType": "double",
+             "feasibleSpace": {"min": 1e-3, "max": 1e-2, "scale": "log"}},
+            {"name": "ent", "parameterType": "double",
+             "feasibleSpace": {"min": 0.0, "max": 0.05}},
+        ],
+        "parallelTrialCount": 2,
+        "maxTrialCount": 2,
+        "maxFailedTrialCount": 1,
+        "trialTemplate": {
+            "kind": RL_JOB_KIND,
+            "spec": {"replicaSpecs": {"learner": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {
+                    "backend": "thread", "target": "rl_learner",
+                    "env": {"KTPU_RL_CONFIG": tpl_str},
+                    "resources": {"cpu": 1}},
+            }}}},
+    }))
+    exp = cluster.wait_for("Experiment", "rl-sweep",
+                           lambda o: is_finished(o["status"]), timeout=180)
+    assert has_condition(exp["status"], JobConditionType.SUCCEEDED), \
+        exp["status"]
+    opt = exp["status"]["currentOptimalTrial"]
+    p = opt["parameterAssignments"]
+    assert 1e-3 <= p["lr"] <= 1e-2 and 0.0 <= p["ent"] <= 0.05
+    # the objective really is the learner's reward metric
+    metrics = {m["name"] for m in opt["observation"]["metrics"]}
+    assert REWARD_METRIC in metrics
+    assert opt["objectiveValue"] > 0.0   # gridworld returns are positive

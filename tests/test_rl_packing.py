@@ -1,0 +1,270 @@
+"""Concurrency packing: the gang scheduler's PackingPolicy decision logic
+(unit-tested against interference records — the r8 acceptance criterion),
+policy-gated chip sharing in the DeviceInventory, and the solo-vs-packed
+measurement harness."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.scheduler import (PACKING_CLASS_KEY,
+                                            DeviceInventory, PackingPolicy)
+from kubeflow_tpu.rl.packing import InterferenceRecord, measure_interference
+
+
+def record(solo_a=100.0, solo_b=100.0, packed_a=80.0, packed_b=60.0):
+    return InterferenceRecord("a", "b", solo_a, solo_b, packed_a,
+                              packed_b).to_json()
+
+
+# -- decision logic -----------------------------------------------------------
+
+
+class TestPackingDecision:
+    def test_allows_when_packing_beats_time_slicing(self):
+        # retentions 0.8 + 0.6 = 1.4 > 1.05, neither starved
+        d = PackingPolicy().decide(record())
+        assert d.allow
+        assert d.combined_retention == pytest.approx(1.4)
+
+    def test_denies_when_time_slicing_wins(self):
+        # 0.5 + 0.5 = 1.0: each workload could just own the chip half the
+        # time — packing buys nothing, exclusive scheduling stays
+        d = PackingPolicy().decide(record(packed_a=50.0, packed_b=50.0))
+        assert not d.allow
+        assert "time-slicing" in d.reason
+
+    def test_denies_when_one_workload_starves(self):
+        # combined 1.1 clears the bar but B keeps only 10% of its solo
+        # rate — an SLO-relevant starvation, not a packing win
+        d = PackingPolicy().decide(record(packed_a=100.0, packed_b=10.0))
+        assert not d.allow
+        assert "starved" in d.reason
+
+    def test_denies_unmeasured_solo(self):
+        d = PackingPolicy().decide(record(solo_a=0.0))
+        assert not d.allow and "unmeasured" in d.reason
+
+    def test_learn_and_allows(self):
+        p = PackingPolicy()
+        assert p.learn("rl", "serve", record()).allow
+        assert p.allows("rl", ["serve"])
+        assert p.allows("serve", ["rl"])      # pair key is unordered
+        assert not p.allows("rl", ["other"])  # unknown pair stays denied
+        # max_per_chip=2: a third cohabitant is always denied
+        assert p.learn("rl", "rl", record()).allow
+        assert not p.allows("rl", ["rl", "serve"])
+
+    def test_learned_denial_sticks(self):
+        p = PackingPolicy()
+        assert not p.learn("rl", "serve",
+                           record(packed_a=50.0, packed_b=50.0)).allow
+        assert not p.allows("rl", ["serve"])
+
+    def test_to_json_roundtrips_pairs(self):
+        p = PackingPolicy()
+        p.learn("rl", "serve", record())
+        j = p.to_json()
+        assert j["pairs"]["rl|serve"]["allow"] is True
+        assert j["max_per_chip"] == 2
+
+
+# -- inventory sharing --------------------------------------------------------
+
+
+def make_policy(**pairs):
+    p = PackingPolicy()
+    for key, rec in pairs.items():
+        a, b = key.split("__")
+        p.learn(a, b, rec)
+    return p
+
+
+class TestInventoryPacking:
+    def test_two_packable_pods_share_one_chip(self):
+        inv = DeviceInventory(n_devices=1,
+                              packing=make_policy(rl__serve=record()))
+        a = inv.allocate("u1", {"tpu": 1, PACKING_CLASS_KEY: "rl"})
+        b = inv.allocate("u2", {"tpu": 1, PACKING_CLASS_KEY: "serve"})
+        assert a == b == [0]
+        # chip full (max_per_chip=2): a third packable pod has nowhere
+        assert inv.allocate("u3", {"tpu": 1, PACKING_CLASS_KEY: "rl"}) \
+            is None
+        inv.release("u1")
+        assert inv.allocate("u3", {"tpu": 1, PACKING_CLASS_KEY: "rl"}) \
+            == [0]
+
+    def test_exclusive_default_without_policy(self):
+        inv = DeviceInventory(n_devices=1)
+        assert inv.allocate("u1", {"tpu": 1,
+                                   PACKING_CLASS_KEY: "rl"}) == [0]
+        assert inv.allocate("u2", {"tpu": 1,
+                                   PACKING_CLASS_KEY: "rl"}) is None
+
+    def test_exclusive_pod_never_joins_shared_chip(self):
+        inv = DeviceInventory(n_devices=2,
+                              packing=make_policy(rl__rl=record()))
+        inv.allocate("u1", {"tpu": 1, PACKING_CLASS_KEY: "rl"})
+        # plain pod gets its own chip, not chip 0's spare slot
+        assert inv.allocate("u2", {"tpu": 1}) == [1]
+        # and a multi-chip request can never pack
+        assert inv.allocate("u3", {"tpu": 2, PACKING_CLASS_KEY: "rl"}) \
+            is None
+
+    def test_release_returns_chip_when_last_occupant_leaves(self):
+        inv = DeviceInventory(n_devices=1,
+                              packing=make_policy(rl__serve=record()))
+        inv.allocate("u1", {"tpu": 1, PACKING_CLASS_KEY: "rl"})
+        inv.allocate("u2", {"tpu": 1, PACKING_CLASS_KEY: "serve"})
+        inv.release("u1")
+        assert inv.usage()["tpu_used"] == 1    # still held by u2
+        inv.release("u2")
+        assert inv.usage()["tpu_used"] == 0
+        assert inv.allocate("u3", {"tpu": 1}) == [0]
+
+    def test_fits_counts_shared_slots(self):
+        inv = DeviceInventory(n_devices=1,
+                              packing=make_policy(rl__serve=record()))
+        reqs = [{"tpu": 1, PACKING_CLASS_KEY: "rl"},
+                {"tpu": 1, PACKING_CLASS_KEY: "serve"}]
+        assert inv.fits(reqs)
+        assert not inv.fits(reqs + [{"tpu": 1}])
+        inv.allocate("u1", {"tpu": 1, PACKING_CLASS_KEY: "rl"})
+        assert inv.fits([{"tpu": 1, PACKING_CLASS_KEY: "serve"}])
+        assert not inv.fits([{"tpu": 1}])
+
+    def test_fits_mirrors_allocate_join_order(self):
+        """The gang gate and the per-pod bind must use the SAME greedy
+        chip ordering. Construction where a fits() simulation with its
+        own (e.g. virtual) fresh-chip ids would pack [a, b, c] but the
+        real allocate order cannot: fits must say False, exactly like
+        the binds it gates."""
+        p = PackingPolicy()
+        p.learn("a", "b", record())
+        p.learn("b", "x", record())
+        p.learn("c", "x", record())   # (a,x) and (c,a) stay denied
+        inv = DeviceInventory(n_devices=2, packing=p)
+        assert inv.allocate("ux", {"tpu": 1,
+                                   PACKING_CLASS_KEY: "x"}) == [0]
+        reqs = [{"tpu": 1, PACKING_CLASS_KEY: c} for c in "abc"]
+        # real order: a opens fresh chip 1; b joins chip 0 (with x,
+        # lowest id first); c has nowhere — so fits must deny
+        assert not inv.fits(reqs)
+        assert inv.allocate("ua", reqs[0]) == [1]
+        assert inv.allocate("ub", reqs[1]) == [0]
+        assert inv.allocate("uc", reqs[2]) is None
+        # and the two-pod prefix both fits and binds
+        inv2 = DeviceInventory(n_devices=2, packing=p)
+        inv2.allocate("ux", {"tpu": 1, PACKING_CLASS_KEY: "x"})
+        assert inv2.fits(reqs[:2])
+
+    def test_set_packing_post_hoc(self):
+        inv = DeviceInventory(n_devices=1)
+        inv.allocate("u1", {"tpu": 1, PACKING_CLASS_KEY: "rl"})
+        inv.set_packing(make_policy(rl__rl=record()))
+        # the already-bound pod took its chip exclusively; sharing starts
+        # with the next packable placement on a fresh/shared chip
+        assert inv.allocate("u2", {"tpu": 1, PACKING_CLASS_KEY: "rl"}) \
+            is None
+        inv.release("u1")
+        assert inv.allocate("u2", {"tpu": 1,
+                                   PACKING_CLASS_KEY: "rl"}) == [0]
+        assert inv.allocate("u3", {"tpu": 1,
+                                   PACKING_CLASS_KEY: "rl"}) == [0]
+
+
+# -- through the live gang scheduler ------------------------------------------
+
+
+def test_scheduler_packs_policy_admitted_pods():
+    """One chip, an admitted (rl, serve) pair: both pods bind onto chip 0
+    through the ordinary scheduler loop; a third (exclusive) pod stays
+    Pending with InsufficientDevices."""
+    policy = make_policy(rl__serve=record())
+    c = Cluster(n_devices=1, packing=policy)
+    with c:
+        for name, cls in (("learn", "rl"), ("serve", "serve")):
+            c.store.create(new_resource("Pod", name, spec={
+                "backend": "thread", "target": "sleep_briefly",
+                "resources": {"tpu": 1, PACKING_CLASS_KEY: cls}}))
+        a = c.wait_for("Pod", "learn",
+                       lambda o: o["status"].get("deviceIds") is not None,
+                       timeout=10)
+        b = c.wait_for("Pod", "serve",
+                       lambda o: o["status"].get("deviceIds") is not None,
+                       timeout=10)
+        assert a["status"]["deviceIds"] == b["status"]["deviceIds"] == [0]
+        c.store.create(new_resource("Pod", "excl", spec={
+            "backend": "thread", "target": "sleep_briefly",
+            "resources": {"tpu": 1}}))
+        excl = c.wait_for(
+            "Pod", "excl",
+            lambda o: o["status"].get("reason") == "InsufficientDevices",
+            timeout=10)
+        assert excl["status"].get("phase", "Pending") == "Pending"
+
+
+def test_scheduler_denied_pair_stays_exclusive():
+    policy = make_policy(rl__serve=record(packed_a=50.0, packed_b=50.0))
+    c = Cluster(n_devices=1, packing=policy)
+    with c:
+        for name, cls in (("learn", "rl"), ("serve", "serve")):
+            c.store.create(new_resource("Pod", name, spec={
+                "backend": "thread", "target": "sleep_briefly",
+                "resources": {"tpu": 1, PACKING_CLASS_KEY: cls}}))
+        c.wait_for("Pod", "learn",
+                   lambda o: o["status"].get("deviceIds") is not None,
+                   timeout=10)
+        time.sleep(0.3)   # give the scheduler rounds to (wrongly) bind
+        other = c.store.get("Pod", "serve")
+        assert other["status"].get("deviceIds") is None
+
+
+from kubeflow_tpu.control import worker_target  # noqa: E402
+
+
+@worker_target("sleep_briefly")
+def _sleep_briefly(env, cancel):
+    cancel.wait(timeout=5.0)
+
+
+# -- measurement harness ------------------------------------------------------
+
+
+def test_interference_record_math():
+    r = InterferenceRecord("a", "b", solo_a=200.0, solo_b=100.0,
+                           packed_a=150.0, packed_b=50.0)
+    assert r.retention_a == pytest.approx(0.75)
+    assert r.retention_b == pytest.approx(0.5)
+    assert r.combined_retention == pytest.approx(1.25)
+    j = r.to_json()
+    assert j["combined_retention"] == pytest.approx(1.25, abs=1e-3)
+
+
+def test_measure_interference_synthetic():
+    """Two sleep-bound workloads barely interfere: both solo and packed
+    rates come out near the nominal chunk rate, and the policy admits
+    the pair (combined retention ~2)."""
+    def chunk():
+        time.sleep(0.01)
+        return 1.0
+
+    rec = measure_interference("a", chunk, "b", chunk, seconds=0.25)
+    assert 50 <= rec.solo_a <= 110
+    assert rec.combined_retention > 1.4
+    assert PackingPolicy().decide(rec.to_json()).allow
+
+
+def test_measure_interference_propagates_errors():
+    def ok():
+        time.sleep(0.005)
+        return 1.0
+
+    def boom():
+        raise RuntimeError("workload died")
+
+    with pytest.raises(RuntimeError, match="workload died"):
+        measure_interference("a", ok, "b", boom, seconds=0.2)

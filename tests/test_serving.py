@@ -324,7 +324,8 @@ class TestInferenceServiceE2E:
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             with ctrl._lock:
-                gone = ("zero", "predictor") not in ctrl._instances
+                gone = ("default", "zero",
+                        "predictor") not in ctrl._instances
             if gone:
                 break
             time.sleep(0.1)

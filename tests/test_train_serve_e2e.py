@@ -67,6 +67,142 @@ def test_train_checkpoint_serve_round_trip(tmp_path):
     assert acc > 0.9, acc   # the SERVED model kept its trained accuracy
 
 
+@pytest.mark.slow
+def test_lora_train_serve_openai_e2e(tmp_path):
+    """The flagship train→serve loop at the LLM tier (ROADMAP #5 /
+    VERDICT ask #8): JAXJob LoRA fine-tune (`llama_lora`, adapters-only
+    optimizer state) → orbax checkpoint → InferenceService whose llama
+    runtime restores {base, lora}, merges, and serves through the
+    continuous-batching engine WITH speculative decoding → OpenAI
+    completion request exercising presence/frequency penalties and the
+    reproducible-seed contract, through the ISVC router.
+
+    Dims: `KTPU_E2E_TRUE_DIMS=1` (a TPU box driving this test outside the
+    CPU-pinned fast lane) runs the true Llama-3-8B geometry with int8
+    weights+KV — the on-chip acceptance run; the default is a scaled
+    geometry through the IDENTICAL code path (same job target, same
+    runtime restore/merge, same engine programs, same HTTP surface)."""
+    import http.client
+    import os
+    import time as _time
+
+    from kubeflow_tpu.control import JAXJobController
+    from kubeflow_tpu.control.conditions import is_finished
+    from kubeflow_tpu.training.loader import write_corpus
+    from scripts.gen_corpus import synthetic_corpus
+
+    true_dims = os.environ.get("KTPU_E2E_TRUE_DIMS") == "1"
+    if true_dims:
+        base = dict(vocab_size=128256, d_model=4096, n_layers=32,
+                    n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=2048)
+        seq_len, steps, batch = 2048, 30, 8
+        engine_kw = {"n_slots": 8, "max_len": 2048, "buckets": [128],
+                     "quantize": "int8", "kv_quantize": "int8"}
+    else:
+        base = dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq_len=128)
+        seq_len, steps, batch = 64, 30, 8
+        engine_kw = {"n_slots": 2, "max_len": 64, "buckets": [16]}
+
+    corpus = str(tmp_path / "corpus.bin")
+    write_corpus(corpus, synthetic_corpus(60_000, base["vocab_size"],
+                                          seed=0))
+    ckpt = str(tmp_path / "ckpt")
+
+    c = Cluster(n_devices=8)
+    c.add(JAXJobController)
+    c.add(serving.InferenceServiceController)
+    with c:
+        # 1) LoRA fine-tune as a JAXJob (the llama-lora-jaxjob.yaml shape)
+        c.store.create(new_resource("JAXJob", "lora-ft", spec={
+            "runPolicy": {"backoffLimit": 0},
+            "replicaSpecs": {"worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {
+                    "backend": "thread", "target": "trainer",
+                    "env": {"KTPU_TRAINER_CONFIG": json.dumps({
+                        "model": "llama_lora",
+                        "batch_size": batch, "num_steps": steps,
+                        "log_every": 10,
+                        "model_overrides": {"rank": 4, "alpha": 8.0,
+                                            "llama": base},
+                        "dataset": {"type": "token_file", "path": corpus,
+                                    "seq_len": seq_len},
+                        "mesh": {"data": -1},
+                        "checkpoint_dir": ckpt,
+                        "checkpoint_every": steps,
+                        "optimizer": {"learning_rate": 1e-3,
+                                      "warmup_steps": 5,
+                                      "trainable_prefix": "lora"},
+                    })},
+                    "resources": {"cpu": 1}},
+            }},
+        }))
+        job = c.wait_for("JAXJob", "lora-ft",
+                         lambda o: is_finished(o["status"]), timeout=300)
+        assert has_condition(job["status"], "Succeeded"), job["status"]
+
+        # 2) the checkpoint registered behind an InferenceService on the
+        #    llama engine: runtime restores {base, lora}, merges, serves
+        #    with speculative decoding on
+        c.store.create(new_resource(serving.ISVC_KIND, "lora-llm", spec={
+            "predictor": {"model": {
+                "modelFormat": "llama",
+                "config": {"model": base,
+                           "lora": {"rank": 4, "alpha": 8.0},
+                           "checkpoint": ckpt,
+                           "speculative": 3, "seed": 0, **engine_kw},
+            }, "minReplicas": 1},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "lora-llm",
+            lambda o: has_condition(o["status"], "Ready"), timeout=600)
+        host, port = isvc["status"]["url"].split("//")[1].split(":")
+
+        # 3) OpenAI completion through the router: penalties + seeded
+        #    sampling on the speculative engine
+        def complete(body):
+            conn = http.client.HTTPConnection(host, int(port), timeout=300)
+            conn.request("POST", "/openai/v1/completions",
+                         body=json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            return resp.status, out
+
+        req = {"model": "lora-llm", "prompt": "Hello", "max_tokens": 8,
+               "presence_penalty": 0.6, "frequency_penalty": 0.4,
+               "temperature": 0.9, "seed": 7}
+        status, out = complete(req)
+        assert status == 200, out
+        choice = out["choices"][0]
+        assert len(choice["token_ids"]) == 8
+        assert choice["finish_reason"] == "length"
+        assert out["usage"]["total_tokens"] == \
+            out["usage"]["prompt_tokens"] + 8
+        assert isinstance(choice["text"], str)
+
+        # the reproducible-seed contract holds through the whole stack
+        t0 = _time.perf_counter()
+        status2, out2 = complete(req)
+        warm_latency_s = _time.perf_counter() - t0
+        assert status2 == 200
+        assert out2["choices"][0]["token_ids"] == choice["token_ids"]
+
+        # a different seed is a different (still penalized) sample path
+        status3, out3 = complete(dict(req, seed=8))
+        assert status3 == 200
+        # greedy + penalties (no sampling) also serves — the penalty
+        # logit-edit path inside the compiled programs
+        status4, out4 = complete({"model": "lora-llm", "prompt": "Hello",
+                                  "max_tokens": 8,
+                                  "presence_penalty": 1.0})
+        assert status4 == 200
+        assert len(out4["choices"][0]["token_ids"]) == 8
+        assert warm_latency_s < 60.0   # warm path, no recompiles
+
+
 def test_trainer_runtime_without_checkpoint_serves_init():
     """No uri → fresh init params (smoke path for any registry model)."""
     from kubeflow_tpu.serving.model import load_model
