@@ -1,0 +1,39 @@
+"""Chaos harness — deterministic fault injection for the serving plane
+(ISSUE 10, the robustness tentpole).
+
+The training plane already survives faults (elastic gang resize,
+heartbeat dead-rank detection, checkpoint resume); this package gives
+the SERVING plane the same story, as committed, replayable artifacts:
+
+- `chaos.script`   — seeded byte-deterministic fault scripts (same
+  splitmix64 + sha256-pin contract as `loadgen/trace.py`); committed
+  configs in `chaos/configs/` (`crash_midstream`, `stall_and_partition`).
+- `chaos.injector` — the runtime poll-side: components ask "is this
+  fault due for me now"; fired events are logged for the bench record.
+  Also the process-global I/O fault hook `training/checkpoint.py`'s
+  commit path calls.
+
+The consumers live where the behavior lives: the engine supervisor
+(`serving/agent.py`) eats crashes and stalls, the router
+(`serving/router.py`) eats partitions, the heartbeat reporter
+(`runtime/heartbeat.py`) eats drops, and the checkpoint manifest
+(`training/checkpoint.py`) eats I/O faults. All jax-free.
+"""
+
+from kubeflow_tpu.chaos.injector import (FaultInjector, io_fault,
+                                         set_io_fault_hook)
+from kubeflow_tpu.chaos.script import (FAULT_KINDS, FAULT_SCRIPTS,
+                                       FaultEvent, FaultScript,
+                                       FaultScriptConfig, FaultSpec,
+                                       generate_fault_script,
+                                       load_fault_config,
+                                       load_fault_script, script_bytes,
+                                       script_sha256)
+
+__all__ = [
+    "FAULT_KINDS", "FAULT_SCRIPTS", "FaultEvent", "FaultInjector",
+    "FaultScript", "FaultScriptConfig", "FaultSpec",
+    "generate_fault_script", "io_fault", "load_fault_config",
+    "load_fault_script", "script_bytes", "script_sha256",
+    "set_io_fault_hook",
+]

@@ -156,15 +156,36 @@ def run_trace(engine, trace: Trace, *, controller=None,
     return out
 
 
-def run_scenario(engine, scenario, *, max_wall_s: float | None = None
-                 ) -> dict[str, Any]:
+def run_scenario(engine, scenario, *, max_wall_s: float | None = None,
+                 fault_script: str | None = None) -> dict[str, Any]:
     """Generate a scenario's trace, apply its fairness/control knobs, and
     replay it. Returns the committed-record shape the bench section and
     the floor gate consume: config echo + trace hash + aggregate +
-    per-tenant SLO table (+ the SLO controller's chunk trajectory)."""
+    per-tenant SLO table (+ the SLO controller's chunk trajectory).
+
+    A fault script (the scenario's `fault_script`, or the override
+    argument) turns the replay into a chaos run: the script is
+    materialized onto the trace's window, armed on the engine's
+    supervisor (the engine must be an `EngineSupervisor` — a bare engine
+    has no recovery story to inject faults into), and the supervisor's
+    zero-lost accounting + fired-event log ride the committed record
+    under `chaos`."""
     from kubeflow_tpu.loadgen.control import SLOController
 
     trace = generate_trace(scenario.trace)
+    script_name = fault_script or scenario.fault_script
+    script = None
+    if script_name:
+        from kubeflow_tpu.chaos import load_fault_script, script_sha256
+
+        if not hasattr(engine, "arm_faults"):
+            raise ValueError(
+                f"scenario carries fault script {script_name!r} but the "
+                "engine is not supervised — wrap it in "
+                "serving.agent.EngineSupervisor")
+        script = load_fault_script(script_name,
+                                   duration_s=scenario.trace.duration_s)
+        engine.arm_faults(script)
     engine.set_tenant_limits(scenario.tenant_max_active,
                              scenario.tenant_max_queued)
     controller = None
@@ -185,6 +206,14 @@ def run_scenario(engine, scenario, *, max_wall_s: float | None = None
         "timed_out": res["timed_out"],
         **res["summary"],
     }
+    if script is not None:
+        out["chaos"] = {
+            "fault_script": script_name,
+            "script_sha256": script_sha256(script),
+            "events_scheduled": [e.to_json() for e in script.events],
+            "events_fired": engine.injector.log(),
+            "accounting": engine.accounting(),
+        }
     if controller is not None:
         out["slo_chase"] = {
             "ttft_target_ms": scenario.ttft_target_ms,

@@ -46,6 +46,9 @@ class Scenario:
     slo_chase: bool = False
     ttft_target_ms: float = 300.0
     control_interval_s: float = 5.0
+    #: committed chaos fault script (chaos/configs/) replayed against the
+    #: serving plane alongside the trace; requires a supervised engine
+    fault_script: str | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -85,7 +88,8 @@ def load_scenario(name: str, **trace_overrides: Any) -> Scenario:
         tenant_max_queued=int(d.get("tenant_max_queued", 0)),
         slo_chase=bool(d.get("slo_chase", False)),
         ttft_target_ms=float(d.get("ttft_target_ms", 300.0)),
-        control_interval_s=float(d.get("control_interval_s", 5.0)))
+        control_interval_s=float(d.get("control_interval_s", 5.0)),
+        fault_script=d.get("fault_script"))
 
 
 def miniature(scenario: Scenario, *, vocab: int, max_prompt_len: int,

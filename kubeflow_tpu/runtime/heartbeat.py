@@ -5,17 +5,35 @@ KTPU_RENDEZVOUS_ADDRESS/KTPU_HEARTBEAT_TTL injected; calling
 keeps a daemon thread heartbeating at ttl/3. A worker that stops (crash,
 hang, SIGKILL) goes silent and the controller converts the dead rank into a
 pod failure → restart/elastic path.
+
+Send failures (ISSUE 10 satellite): a failed send no longer kills the
+loop silently — the reporter retries with jittered exponential backoff
+(capped at ttl/3, the healthy cadence: even two consecutive failed
+sends keep the gap since the last successful beat under the TTL, so a
+transient coordinator blip never expires the rank by itself) and
+surfaces `consecutive_failures` so a supervisor can
+distinguish "the REPORTER is struggling" (failures climbing, process
+alive) from "the RANK is dead" (silence). Only after
+`max_consecutive_failures` does the loop give up, setting
+`reporter_dead` — the old behavior, but now an explicit, inspectable
+terminal state. An armed chaos injector with an active `heartbeat_drop`
+window makes the reporter SKIP sends (counted in `dropped`) — from the
+controller's side that is indistinguishable from a dead rank, which is
+exactly the fault the script injects.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 
 
 class HeartbeatReporter:
     def __init__(self, address: str, job_gang: str, world: int, rank: int,
-                 worker_addr: str, ttl_s: float):
+                 worker_addr: str, ttl_s: float,
+                 max_consecutive_failures: int = 8,
+                 injector=None):
         from kubeflow_tpu.runtime.rendezvous import RendezvousClient
 
         self._client = RendezvousClient(address, timeout=max(ttl_s * 4, 30.0))
@@ -24,17 +42,55 @@ class HeartbeatReporter:
         self.head_address = self._client.register(job_gang, world, rank,
                                                   worker_addr)
         self._interval = max(ttl_s / 3.0, 0.02)
+        self._ttl = ttl_s
+        self.max_consecutive_failures = max_consecutive_failures
+        self.injector = injector
+        #: consecutive failed sends (0 after any success) — the signal a
+        #: controller reads to tell "reporter struggling" from "rank dead"
+        self.consecutive_failures = 0
+        self.last_error: str | None = None
+        self.reporter_dead = False
+        self.dropped = 0           # beats suppressed by an injected drop
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"heartbeat-{job_gang}-{rank}")
         self._thread.start()
 
+    def _next_wait(self) -> float:
+        """Steady cadence while healthy; jittered exponential backoff
+        while failing (full jitter over [interval/2, backoff] — retries
+        from a gang of workers must not re-synchronize on the
+        coordinator they just knocked over). The cap is the HEALTHY
+        cadence (ttl/3): backing off further than the normal beat gap
+        would let the retry schedule itself expire the rank — the gap
+        since the last successful beat must stay under the TTL across a
+        couple of transient failures."""
+        if self.consecutive_failures == 0:
+            return self._interval
+        backoff = min(self._interval,
+                      (self._interval / 4.0)
+                      * (2 ** self.consecutive_failures))
+        lo = self._interval / 2.0
+        return lo + random.random() * max(0.0, backoff - lo)
+
     def _loop(self) -> None:
-        while not self._stop.wait(self._interval):
+        while not self._stop.wait(self._next_wait()):
+            if self.injector is not None \
+                    and self.injector.active("heartbeat_drop") is not None:
+                self.dropped += 1   # chaos: the beat is eaten in flight
+                continue
             try:
                 self._client.heartbeat(self.job_gang, self.rank)
-            except OSError:
-                return  # coordinator gone (job finishing) — nothing to report
+                self.consecutive_failures = 0
+            except OSError as e:
+                self.consecutive_failures += 1
+                self.last_error = str(e)
+                if self.consecutive_failures \
+                        >= self.max_consecutive_failures:
+                    # coordinator persistently unreachable (job likely
+                    # finishing / torn down): stop, but say so
+                    self.reporter_dead = True
+                    return
 
     def stop(self, mark_done: bool = True) -> None:
         self._stop.set()
@@ -47,8 +103,8 @@ class HeartbeatReporter:
         self._client.close()
 
 
-def start_heartbeat(env: dict[str, str] | None = None
-                    ) -> HeartbeatReporter | None:
+def start_heartbeat(env: dict[str, str] | None = None,
+                    injector=None) -> HeartbeatReporter | None:
     """Start heartbeating from the injected KTPU_* env; None when the job
     has no failureDetection configured (env key absent)."""
     e = os.environ if env is None else env
@@ -63,4 +119,5 @@ def start_heartbeat(env: dict[str, str] | None = None
         int(e.get("KTPU_PROCESS_ID", "0")),
         e.get("KTPU_COORDINATOR_ADDRESS", "127.0.0.1:0"),
         float(e.get("KTPU_HEARTBEAT_TTL", "10")),
+        injector=injector,
     )

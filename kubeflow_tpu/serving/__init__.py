@@ -22,7 +22,9 @@ from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
 from kubeflow_tpu.serving.router import Router
 from kubeflow_tpu.serving.server import ModelServer
 from kubeflow_tpu.serving.storage import StorageError, download
-from kubeflow_tpu.serving.agent import MultiModelAgent, PayloadLogger
+from kubeflow_tpu.serving.agent import (EngineSupervisor, MultiModelAgent,
+                                        PayloadLogger)
+from kubeflow_tpu.serving.scheduler import ShedPolicy, TenantShed
 from kubeflow_tpu.serving.trainedmodel import (TRAINEDMODEL_KIND,
                                                TrainedModelController,
                                                validate_trainedmodel)
@@ -32,13 +34,15 @@ from kubeflow_tpu.serving import trainer_runtime as _tr  # noqa: F401
 #   ("llama" continuous batching; "trainer" = any registry model checkpoint)
 
 __all__ = [
-    "DynamicBatcher", "FunctionModel", "GRAPH_KIND", "GraphRouter",
+    "DynamicBatcher", "EngineSupervisor", "FunctionModel", "GRAPH_KIND",
+    "GraphRouter",
     "ISVC_KIND", "InferRequest",
     "InferResponse", "InferTensor", "InferenceGraphController",
     "InferenceServiceController", "Model",
     "ModelError", "ModelRepository", "ModelServer", "MultiModelAgent",
     "PayloadLogger", "ProtocolError",
-    "Router", "StorageError", "TRAINEDMODEL_KIND", "TrainedModelController",
+    "Router", "ShedPolicy", "StorageError", "TRAINEDMODEL_KIND",
+    "TenantShed", "TrainedModelController",
     "download", "load_model", "serving_runtime",
     "v1_decode", "v1_encode", "validate_graph", "validate_isvc",
     "validate_trainedmodel",

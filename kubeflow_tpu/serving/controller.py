@@ -57,6 +57,15 @@ def validate_isvc(isvc: dict[str, Any]) -> list[str]:
     pct = spec.get("canaryTrafficPercent", 0)
     if not isinstance(pct, int) or not 0 <= pct <= 100:
         errs.append("canaryTrafficPercent must be an int in [0,100]")
+    for comp in ("predictor", "canary"):
+        rp = spec.get(comp, {}).get("restartPolicy", "Always")
+        if rp not in ("Always", "Never"):
+            errs.append(f"spec.{comp}.restartPolicy must be Always|Never, "
+                        f"got {rp!r}")
+        bl = spec.get(comp, {}).get("backoffLimit", 5)
+        if not isinstance(bl, int) or bl < 0:
+            errs.append(f"spec.{comp}.backoffLimit must be a "
+                        "non-negative int")
     if pct > 0 and not spec.get("canary", {}).get("model"):
         errs.append("canaryTrafficPercent > 0 requires spec.canary.model")
     for comp in ("predictor", "canary", "transformer"):
@@ -134,6 +143,10 @@ class InferenceServiceController(Controller):
         # replicas dropped by a scale-down, stopped only AFTER the router's
         # backend list is updated (no routing to dead ports)
         self._pending_stop: list[_Instance] = []
+        # crash-restart bookkeeping (chaos tentpole): per-component crash
+        # count + next-allowed-restart instant — the restartPolicy /
+        # backoffLimit semantics of the reference's pod restart machinery
+        self._crash_backoff: dict[tuple[str, str, str], dict] = {}
 
     def stop(self) -> None:
         super().stop()
@@ -161,6 +174,7 @@ class InferenceServiceController(Controller):
             self._activation_locks.pop((namespace, name), None)
             for component in ("predictor", "canary"):
                 self._last_scale.pop((namespace, name, component), None)
+                self._crash_backoff.pop((namespace, name, component), None)
         if router is not None:
             router.stop()
         return None
@@ -230,7 +244,23 @@ class InferenceServiceController(Controller):
                 set_condition(o["status"], "Ready", "PredictorReady",
                               "predictor is ready" if default.get("ready")
                               else "scaled to zero; activates on request")
+            blocked = default.get("restartBlocked")
+            if blocked in ("CrashLoopBackOff", "RestartPolicyNever") \
+                    and not default.get("ready"):
+                # terminal restart block with nothing serving: FAILED,
+                # loudly — the operator must intervene (bump backoffLimit,
+                # fix the model, delete the service)
+                set_condition(
+                    o["status"], JobConditionType.FAILED, blocked,
+                    f"predictor crashed {default.get('crashes', 0)} "
+                    "time(s) and restarts are "
+                    + ("disabled by restartPolicy: Never"
+                       if blocked == "RestartPolicyNever"
+                       else "exhausted (backoffLimit)"))
         self.store.mutate(ISVC_KIND, name, write, ns)
+        if any(c.get("restartBlocked") == "Backoff"
+               for c in components.values()):
+            return 0.25   # retry the restart soon, not at resync leisure
         return 1.0 if scale_to_zero else None
 
     # -- component lifecycle --------------------------------------------------
@@ -341,6 +371,54 @@ class InferenceServiceController(Controller):
         return any(tm["spec"].get("inferenceService") == name
                    for tm in self.store.list(TRAINEDMODEL_KIND, ns))
 
+    #: backoff schedule for crash restarts (capped exponential), and the
+    #: crash-free interval after which the counter resets
+    _BACKOFF_BASE_S = 0.2
+    _BACKOFF_CAP_S = 30.0
+    _CRASH_RESET_S = 60.0
+
+    def _prune_crashed(self, key: tuple[str, str, str],
+                       replicas: list[_Instance]) -> list[_Instance]:
+        """Drop replicas whose server died (the pod-crash analog) and
+        advance the component's crash-backoff state."""
+        dead = [i for i in replicas if not i.server.alive]
+        if not dead:
+            return replicas
+        with self._lock:
+            kept = [i for i in self._instances.get(key, [])
+                    if i.server.alive]
+            self._instances[key] = kept
+            cb = self._crash_backoff.setdefault(
+                key, {"count": 0, "next_t": 0.0, "last": 0.0})
+            now = time.time()
+            if now - cb["last"] > self._CRASH_RESET_S:
+                cb["count"] = 0   # stable for a while: forgive history
+            cb["count"] += len(dead)
+            cb["last"] = now
+            cb["next_t"] = now + min(
+                self._BACKOFF_CAP_S,
+                self._BACKOFF_BASE_S * 2 ** (cb["count"] - 1))
+        for inst in dead:
+            inst.stop()   # reap sockets; shutdown on a dead loop is a no-op
+        return kept
+
+    def _restart_block(self, key: tuple[str, str, str],
+                       comp_spec: dict[str, Any]) -> str | None:
+        """Why a crashed component may NOT be restarted right now:
+        "RestartPolicyNever" / "CrashLoopBackOff" (terminal — backoffLimit
+        exhausted) / "Backoff" (try again after next_t) / None (go)."""
+        with self._lock:
+            cb = self._crash_backoff.get(key)
+            if cb is None or not cb["count"]:
+                return None
+            if comp_spec.get("restartPolicy", "Always") == "Never":
+                return "RestartPolicyNever"
+            if cb["count"] > int(comp_spec.get("backoffLimit", 5)):
+                return "CrashLoopBackOff"
+            if time.time() < cb["next_t"]:
+                return "Backoff"
+            return None
+
     def _reconcile_component(self, isvc: dict[str, Any], component: str,
                              comp_spec: dict[str, Any],
                              lazy: bool) -> dict[str, Any]:
@@ -353,11 +431,25 @@ class InferenceServiceController(Controller):
         if replicas and replicas[0].revision != revision:
             self._stop_instance(ns, name, component)   # rollout: replace
             replicas = []
+        replicas = self._prune_crashed(key, replicas)
         if not replicas and lazy:
             return {"ready": False, "scaledToZero": True,
                     "revision": revision}
         desired = self._desired_replicas(isvc, component, comp_spec,
                                          len(replicas))
+        blocked = self._restart_block(key, comp_spec)
+        if blocked is not None and len(replicas) < desired:
+            # crashed and not (yet) restartable: publish what remains —
+            # the router's circuit breakers gate the gap meanwhile
+            with self._lock:
+                crashes = self._crash_backoff[key]["count"]
+            out = {"ready": bool(replicas), "revision": revision,
+                   "replicas": len(replicas), "crashes": crashes,
+                   "restartBlocked": blocked}
+            if replicas:
+                out["port"] = replicas[0].server.port
+                out["ports"] = [r.server.port for r in replicas]
+            return out
         while len(replicas) < desired:
             # the OIP gRPC server rides the FIRST replica only (that is the
             # only address status publishes; extras would serve nothing)

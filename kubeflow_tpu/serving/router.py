@@ -7,26 +7,102 @@ One Router per InferenceService: an HTTP reverse proxy that
     (deterministic modular schedule, so a 20% canary gets exactly every
     5th request — testable, no RNG flakes);
   - on scale-to-zero services, calls the activator hook to spin the backend
-    up on first request and records last-request time for idle scale-down.
+    up on first request and records last-request time for idle scale-down;
+  - health-gates every backend behind a per-port circuit breaker
+    (closed → open → half-open, the chaos tentpole): transport-level
+    failures trip the circuit, an open circuit takes no traffic for an
+    escalating hold-off, and one half-open probe decides whether it
+    closes again. When EVERY circuit in the eligible pool is open the
+    router answers 503 with a Retry-After header pointing at the soonest
+    half-open instant — back-pressure with a schedule, not a dropped
+    connection.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class _Circuit:
+    """Per-backend breaker state. Not self-locking — the Router's lock
+    covers every transition (state changes are tiny; the proxied request
+    itself runs outside the lock)."""
+
+    def __init__(self, failure_threshold: int, open_s: float,
+                 open_cap_s: float):
+        self.failure_threshold = failure_threshold
+        self.base_open_s = open_s
+        self.open_cap_s = open_cap_s
+        self.state = CLOSED
+        self.failures = 0            # consecutive transport failures
+        self.opened_count = 0        # times this circuit tripped (metric)
+        self.open_until = 0.0
+        self.open_s = open_s
+        self.probing = False         # a half-open probe is in flight
+
+    def admits(self, now: float) -> bool:
+        """May a request be sent to this backend right now?"""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN and now >= self.open_until:
+            # hold-off over: become half-open, admit ONE probe
+            self.state = HALF_OPEN
+            self.probing = False
+        if self.state == HALF_OPEN and not self.probing:
+            return True
+        return False
+
+    def on_attempt(self, now: float) -> None:
+        if self.state == HALF_OPEN:
+            self.probing = True
+
+    def on_success(self) -> None:
+        self.state = CLOSED
+        self.failures = 0
+        self.probing = False
+        self.open_s = self.base_open_s   # recovery resets the escalation
+
+    def on_failure(self, now: float) -> None:
+        self.failures += 1
+        self.probing = False
+        if self.state == HALF_OPEN:
+            # failed probe: reopen with doubled hold-off (capped)
+            self.open_s = min(self.open_cap_s, self.open_s * 2.0)
+            self._trip(now)
+        elif self.failures >= self.failure_threshold:
+            self._trip(now)
+
+    def _trip(self, now: float) -> None:
+        if self.state != OPEN:
+            self.opened_count += 1
+        self.state = OPEN
+        self.open_until = now + self.open_s
+
+    def retry_in(self, now: float) -> float:
+        return max(0.0, self.open_until - now)
+
 
 class Router:
     def __init__(self, name: str, port: int = 0,
                  activator: Callable[[], int | None] | None = None,
-                 activation_timeout: float = 30.0):
+                 activation_timeout: float = 30.0,
+                 failure_threshold: int = 3,
+                 circuit_open_s: float = 0.5,
+                 circuit_open_cap_s: float = 30.0):
         self.name = name
         self.activator = activator
         self.activation_timeout = activation_timeout
+        self.failure_threshold = failure_threshold
+        self.circuit_open_s = circuit_open_s
+        self.circuit_open_cap_s = circuit_open_cap_s
         self._lock = threading.Lock()
         self._default_ports: list[int] = []
         self._canary_ports: list[int] = []
@@ -36,9 +112,15 @@ class Router:
         # deterministic canary schedule can phase-lock and starve a replica
         self._rr_default = 0
         self._rr_canary = 0
+        self._circuits: dict[int, _Circuit] = {}
         self.canary_count = 0
         self.total_count = 0
+        self.breaker_rejected = 0     # 503s served with every circuit open
         self.last_request_time: float = 0.0
+        # optional chaos injector: an active "partition" event makes the
+        # target backend unreachable from THIS router (the fault is in the
+        # network path, so it must be injected here, not in the backend)
+        self.fault_injector = None
         # concurrency tracking for the autoscaler (Knative queue-proxy
         # reports concurrency; here the router IS the queue-proxy)
         self.inflight = 0
@@ -54,10 +136,13 @@ class Router:
             def _proxy(self):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
-                code, body = router.forward(self.command, self.path, raw)
+                code, body, extra = router.forward(self.command, self.path,
+                                                   raw)
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -88,6 +173,30 @@ class Router:
             self._default_ports = self._ports(default_port)
             self._canary_ports = self._ports(canary_port)
             self._canary_percent = max(0, min(100, int(canary_percent)))
+            live = set(self._default_ports) | set(self._canary_ports)
+            for p in live:
+                self._circuits.setdefault(p, _Circuit(
+                    self.failure_threshold, self.circuit_open_s,
+                    self.circuit_open_cap_s))
+            for p in list(self._circuits):
+                if p not in live:   # replaced replicas take their state away
+                    del self._circuits[p]
+
+    def set_fault_injector(self, injector) -> None:
+        self.fault_injector = injector
+
+    def circuit_states(self) -> dict[int, str]:
+        """Port -> breaker state (metrics / tests)."""
+        now = time.monotonic()
+        with self._lock:
+            # report through admits() so an expired OPEN shows half_open
+            out = {}
+            for p, c in self._circuits.items():
+                if c.state == OPEN and now >= c.open_until:
+                    out[p] = HALF_OPEN
+                else:
+                    out[p] = c.state
+            return out
 
     def take_peak_inflight(self) -> int:
         """Peak concurrency since the last call (autoscaler signal)."""
@@ -101,53 +210,145 @@ class Router:
 
     # -- routing --------------------------------------------------------------
 
-    def _pick(self) -> tuple[int | None, bool]:
+    @staticmethod
+    def _rotate(pool: list[int], cursor: int) -> list[int]:
+        if not pool:
+            return []
+        i = cursor % len(pool)
+        return pool[i:] + pool[:i]
+
+    def _route(self) -> tuple[list[int], bool, float | None]:
+        """ONE client request's routing decision (the canary schedule
+        advances exactly once per request, never per retry attempt):
+        returns (candidates, is_canary, retry_in_s). Candidates are the
+        ADMITTING backends of the scheduled pool in round-robin order,
+        followed by the other pool's admitting backends — a pool whose
+        circuits are all open falls back to the healthy pool instead of
+        serving 503s while capacity idles. Empty candidates with
+        retry_in set means EVERY circuit is open; with retry_in None the
+        service has no backends at all (scale-to-zero)."""
+        now = time.monotonic()
         with self._lock:
             self._count += 1
             n, pct = self._count, self._canary_percent
             use_canary = (bool(self._canary_ports) and pct > 0
                           and (n * pct) // 100 > ((n - 1) * pct) // 100)
-            pool = self._canary_ports if use_canary else self._default_ports
-            if not pool:
-                return None, use_canary
+            prim = self._canary_ports if use_canary else self._default_ports
+            sec = self._default_ports if use_canary else self._canary_ports
+            if not prim and not sec:
+                return [], use_canary, None
             if use_canary:
                 self._rr_canary += 1
-                return pool[self._rr_canary % len(pool)], True
-            self._rr_default += 1
-            return pool[self._rr_default % len(pool)], False
+                cursor = self._rr_canary
+            else:
+                self._rr_default += 1
+                cursor = self._rr_default
+            cand = [p for p in self._rotate(prim, cursor)
+                    if self._circuits[p].admits(now)]
+            cand += [p for p in self._rotate(sec, cursor)
+                     if p not in cand and self._circuits[p].admits(now)]
+            if not cand:
+                retry = min(self._circuits[p].retry_in(now)
+                            for p in prim + sec)
+                self.breaker_rejected += 1
+                return [], use_canary, retry
+            return cand, use_canary, None
+
+    def _record(self, port: int, ok: bool) -> None:
+        with self._lock:
+            c = self._circuits.get(port)
+            if c is None:
+                return   # backend replaced while the request was in flight
+            if ok:
+                c.on_success()
+            else:
+                c.on_failure(time.monotonic())
 
     def forward(self, method: str, path: str, body: bytes
-                ) -> tuple[int, bytes]:
+                ) -> tuple[int, bytes, dict[str, str] | None]:
+        """Proxy one request. Only CONNECT-phase failures (refused,
+        injected partition — the backend provably never saw the request)
+        are retried on the next candidate backend: with one healthy
+        replica left, the client sees 200, not the corpse's 502. A
+        failure AFTER the request was sent (timeout mid-generation,
+        reset mid-response) is NOT retried — the backend may have
+        executed it, and replaying a non-idempotent generation would
+        silently duplicate it. Every failure feeds its backend's
+        circuit."""
         self.last_request_time = time.time()
-        port, is_canary = self._pick()
-        if port is None and self.activator is not None:
+        candidates, is_canary, retry_in = self._route()
+        if not candidates and retry_in is not None:
+            # every backend's circuit is open: schedule the retry instead
+            # of hammering dead ports (503 + Retry-After, the chaos
+            # tentpole's "all circuits open" contract)
+            return 503, json.dumps(
+                {"error": f"{self.name}: all backends unhealthy "
+                          "(circuit open)"}).encode(), \
+                {"Retry-After": str(max(1, math.ceil(retry_in)))}
+        if not candidates and self.activator is not None:
             try:
                 port = self._activate()
             except Exception as e:
-                # a failing activator (model no longer loads) must surface as
-                # an HTTP error, not a dropped connection from a dead handler
+                # a failing activator (model no longer loads) must
+                # surface as an HTTP error, not a dropped connection
+                # from a dead handler
                 return 503, json.dumps(
-                    {"error": f"{self.name}: activation failed: {e}"}).encode()
-        if port is None:
+                    {"error": f"{self.name}: activation failed: {e}"}
+                ).encode(), None
+            candidates = [port] if port is not None else []
+        if not candidates:
             return 503, json.dumps(
-                {"error": f"{self.name}: no ready backend"}).encode()
+                {"error": f"{self.name}: no ready backend"}
+            ).encode(), None
         with self._lock:
+            # counters are per client REQUEST, not per retry attempt —
+            # the deterministic canary split and the autoscaler signal
+            # must not drift during an outage
             self.total_count += 1
             if is_canary:
                 self.canary_count += 1
             self.inflight += 1
             self.peak_inflight = max(self.peak_inflight, self.inflight)
         try:
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-            conn.request(method, path, body=body or None,
-                         headers={"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            data = resp.read()
-            conn.close()
-            return resp.status, data
-        except OSError as e:
+            last_err: str | None = None
+            for port in candidates:
+                with self._lock:
+                    c = self._circuits.get(port)
+                    if c is not None:
+                        c.on_attempt(time.monotonic())
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                try:
+                    inj = self.fault_injector
+                    if inj is not None and inj.active(
+                            "partition", target=str(port)) is not None:
+                        raise ConnectionRefusedError(
+                            "injected partition: router cannot "
+                            f"reach :{port}")
+                    conn.connect()
+                except OSError as e:   # never reached the backend: retry
+                    self._record(port, False)
+                    last_err = str(e)
+                    continue
+                try:
+                    conn.request(method, path, body=body or None,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    conn.close()
+                except OSError as e:
+                    # the backend may have processed (part of) this —
+                    # surface the failure, do NOT re-execute
+                    self._record(port, False)
+                    return 502, json.dumps(
+                        {"error": f"backend failed mid-request: {e}"}
+                    ).encode(), None
+                self._record(port, True)
+                return resp.status, data, None
             return 502, json.dumps(
-                {"error": f"backend unreachable: {e}"}).encode()
+                {"error": f"backend unreachable: {last_err}"}
+            ).encode(), None
         finally:
             with self._lock:
                 self.inflight -= 1
@@ -163,4 +364,7 @@ class Router:
         if port is not None:
             with self._lock:
                 self._default_ports = self._ports(port)
+                self._circuits.setdefault(port, _Circuit(
+                    self.failure_threshold, self.circuit_open_s,
+                    self.circuit_open_cap_s))
         return port

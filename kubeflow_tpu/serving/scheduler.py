@@ -59,6 +59,38 @@ class TenantOverQuota(QueueFull):
     of QueueFull so existing 503 mappings catch it."""
 
 
+class TenantShed(QueueFull):
+    """Degraded-mode load shedding: the serving plane is running at
+    reduced capacity (backend dead/restarting) and this tenant's priority
+    class is below the shed threshold. A subtype of QueueFull so the
+    existing 503 mappings and the loadgen runner's admission-control
+    accounting catch it — a shed request is a recorded rejection, never a
+    silent drop."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ShedPolicy:
+    """Priority-ordered degraded-mode shedding (the anti-collapse policy):
+    while the plane is degraded, requests from tenants whose priority is
+    below `shed_below` are rejected at admission so the remaining capacity
+    serves the tenants the operator ranked highest. Priorities are higher
+    = more important; unlisted tenants get `default_priority`. The SLO
+    consequences land in the ordinary loadgen accounting (shed requests
+    show up in the per-tenant `rejected` column)."""
+    priorities: tuple[tuple[str, int], ...] = ()
+    default_priority: int = 0
+    shed_below: int = 1
+
+    def priority_of(self, tenant: str | None) -> int:
+        for name, p in self.priorities:
+            if name == tenant:
+                return p
+        return self.default_priority
+
+    def sheds(self, tenant: str | None) -> bool:
+        return self.priority_of(tenant) < self.shed_below
+
+
 class PromptTooLong(ValueError):
     pass
 

@@ -135,10 +135,13 @@ class ModelServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        self._t_start = time.monotonic()
+        self._stopped = False
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self, background: bool = True) -> "ModelServer":
+        self._t_start = time.monotonic()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True,
                                         name=f"model-server-{self.port}")
@@ -148,11 +151,21 @@ class ModelServer:
         return self
 
     def stop(self) -> None:
+        self._stopped = True
         self._httpd.shutdown()
         self._httpd.server_close()
         for b in self._batchers.values():
             b.stop()
         self._batchers.clear()
+
+    @property
+    def alive(self) -> bool:
+        """Liveness the supervisor/controller can poll without a socket
+        round-trip: the server thread is serving and stop() has not run.
+        A crashed/stopped replica reads False — the controller's
+        restartPolicy machinery keys off this."""
+        return (not self._stopped and self._thread is not None
+                and self._thread.is_alive())
 
     @property
     def url(self) -> str:
@@ -161,6 +174,13 @@ class ModelServer:
     # -- routing --------------------------------------------------------------
 
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:
+        if path == "/healthz":
+            # the liveness probe (chaos tentpole): cheap, model-free —
+            # answering at all means the serving thread is alive; the
+            # payload carries uptime so flap detectors can spot restarts
+            return 200, {"alive": True, "name": self.name,
+                         "uptime_s": round(
+                             time.monotonic() - self._t_start, 3)}
         if path in ("/", "/v2"):
             return 200, {"name": self.name, "version": "2",
                          "extensions": ["health", "models", "metrics"]}

@@ -5,15 +5,174 @@ platform resume = restart policies. Here checkpointing is a framework
 guarantee: sharded async orbax saves of {params, opt_state, step}, restored
 with the *current* mesh's shardings — so a job restarted on a different
 topology (elastic recovery, §5.3) resumes with a resharded state.
+
+Integrity (ISSUE 10 satellite): every step this manager commits gets a
+per-file sha256 manifest, written atomically (temp file + fsync + rename
++ directory fsync) AFTER the step's files are hashed — so a torn write,
+bit rot, or a truncation between commit and restore is detectable, not
+silently restored. `latest_intact_step()` walks steps newest-first,
+QUARANTINES any step whose manifest mismatches (moved aside to
+`_quarantine/`, out of orbax's step namespace), and falls back to the
+newest intact step. A step with NO manifest in a tree that otherwise has
+them is treated as partial (a crash mid-commit) and quarantined too;
+a tree with no manifests at all is a legacy/foreign checkpoint and the
+newest step is trusted as before. The chaos I/O fault hook
+(`chaos.injector.io_fault`) is called at the commit points so tests can
+truncate a file "mid-write" through a supported seam.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from kubeflow_tpu.chaos.injector import io_fault
+
+MANIFEST_NAME = "ktpu_manifest.json"
+QUARANTINE_DIR = "_quarantine"
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    """temp file in the same directory + flush + fsync + rename + dir
+    fsync: the manifest either exists complete or not at all — a partial
+    manifest would itself be indistinguishable from corruption."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, sort_keys=True, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _hash_tree(step_dir: str) -> dict[str, dict[str, Any]]:
+    """{relative_path: {sha256, size}} over every file of one committed
+    step (the manifest body). The manifest itself is excluded."""
+    out: dict[str, dict[str, Any]] = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in sorted(files):
+            if fn == MANIFEST_NAME or fn.endswith(".tmp"):
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, step_dir)
+            out[rel] = {"sha256": _hash_file(p),
+                        "size": os.path.getsize(p)}
+    return out
+
+
+def _step_dir(directory: str, step: int) -> str | None:
+    """Resolve orbax's on-disk directory for `step` (orbax's default
+    layout names it str(step); tolerate padded variants)."""
+    cand = os.path.join(directory, str(step))
+    if os.path.isdir(cand):
+        return cand
+    for name in os.listdir(directory):
+        p = os.path.join(directory, name)
+        # padded layouts: any all-digit name parsing to this step
+        # (int("00000000") == 0 covers zero-padded step 0 too)
+        if os.path.isdir(p) and name.isdigit() and int(name) == step:
+            return p
+    return None
+
+
+def write_step_manifest(directory: str, step: int) -> bool:
+    """Hash + atomically commit the manifest for one completed step.
+    Returns False when the step's directory does not exist (e.g. orbax
+    garbage-collected it past max_to_keep)."""
+    step_dir = _step_dir(directory, step)
+    if step_dir is None:
+        return False
+    digests = _hash_tree(step_dir)
+    # chaos seams: "checkpoint_commit" runs after hashing (a hook that
+    # corrupts a file here models a torn write / bit rot the checksum
+    # must catch at restore); "manifest_write" runs before the manifest
+    # lands (raising here models a crash mid-commit → a partial step)
+    io_fault("checkpoint_commit", step_dir)
+    io_fault("manifest_write", os.path.join(step_dir, MANIFEST_NAME))
+    _atomic_write_json(os.path.join(step_dir, MANIFEST_NAME),
+                       {"version": 1, "step": step, "files": digests})
+    return True
+
+
+def verify_step(directory: str, step: int) -> str:
+    """"intact" | "corrupt" | "unmanifested" | "missing" for one step."""
+    step_dir = _step_dir(directory, step)
+    if step_dir is None:
+        return "missing"
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return "unmanifested"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError):
+        return "corrupt"
+    try:
+        for rel, meta in files.items():
+            p = os.path.join(step_dir, rel)
+            if not os.path.exists(p) \
+                    or os.path.getsize(p) != meta["size"] \
+                    or _hash_file(p) != meta["sha256"]:
+                return "corrupt"
+    except OSError:
+        # files vanishing mid-hash = another rank already quarantined
+        # this step; report corrupt, the caller's fallback handles it
+        return "corrupt"
+    # files that APPEARED since the manifest are tolerated (orbax may add
+    # bookkeeping); files that vanished or changed are not
+    return "intact"
+
+
+def quarantine_step(directory: str, step: int) -> str | None:
+    """Move a corrupt/partial step OUT of orbax's step namespace (into
+    `_quarantine/`), so neither orbax nor a later fallback can restore
+    it. Returns the quarantine path."""
+    step_dir = _step_dir(directory, step)
+    if step_dir is None:
+        return None
+    qroot = os.path.join(directory, QUARANTINE_DIR)
+    os.makedirs(qroot, exist_ok=True)
+    dest = os.path.join(qroot, os.path.basename(step_dir))
+    if os.path.exists(dest):
+        shutil.rmtree(dest)
+    try:
+        os.replace(step_dir, dest)
+    except OSError:
+        # raced by another rank of a multi-process restore quarantining
+        # the same step: the LOSER must keep falling back, not crash in
+        # the middle of the corruption-recovery path
+        return None
+    _fsync_dir(directory)
+    return dest
 
 
 class CheckpointManager:
@@ -29,19 +188,71 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        #: steps saved through THIS manager whose manifest is still owed
+        #: (saves are async — hashing runs at wait(), after orbax commits)
+        self._pending_manifest: set[int] = set()
 
     def save(self, step: int, state: dict[str, Any], *, force: bool = False) -> bool:
-        return self._mngr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        saved = self._mngr.save(step, args=ocp.args.StandardSave(state),
+                                force=force)
+        if saved:
+            self._pending_manifest.add(step)
+        return saved
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for every committed-but-unmanifested save.
+        Process 0 only under multiprocess checkpointing — every rank
+        hashes the same completed tree, one writer avoids the pile-up."""
+        if not self._pending_manifest:
+            return
+        pending, self._pending_manifest = self._pending_manifest, set()
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        for step in sorted(pending):
+            try:
+                write_step_manifest(self.directory, step)
+            except OSError:
+                # a failed commit (disk error, injected fault) leaves the
+                # step UNMANIFESTED — in a manifested tree that reads as
+                # partial and is quarantined at restore, which is the
+                # honest outcome of a commit that did not finish
+                pass
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
+    def latest_intact_step(self) -> int | None:
+        """Newest step that passes manifest verification; corrupt and
+        partial steps are quarantined on the way down (the restore-side
+        half of the integrity contract)."""
+        self.wait()
+        steps = sorted((s for s in self._mngr.all_steps()), reverse=True)
+        # hash each step ONCE — verify_step sha256s the whole tree, and
+        # at 8B scale a second pass doubles crash-recovery wall time
+        statuses = {s: verify_step(self.directory, s) for s in steps}
+        has_manifests = any(st not in ("unmanifested", "missing")
+                            for st in statuses.values())
+        for s in steps:
+            status = statuses[s]
+            if status == "intact":
+                return s
+            if status == "unmanifested" and not has_manifests:
+                # legacy/foreign tree (pre-manifest checkpoints): trust
+                # the newest step, the pre-r9 behavior
+                return s
+            if status == "missing":
+                continue
+            # corrupt, or partial in a manifested tree: out of the way
+            quarantine_step(self.directory, s)
+        return None
+
     def restore(self, state_like: dict[str, Any], step: int | None = None
                 ) -> dict[str, Any]:
         """Restore into the sharding/structure of `state_like` (an abstract or
-        concrete state pytree from the current mesh)."""
-        step = step if step is not None else self.latest_step()
+        concrete state pytree from the current mesh). With no explicit
+        step, the newest INTACT step is used — a corrupt/partial newest
+        step is quarantined and the restore falls back."""
+        step = step if step is not None else self.latest_intact_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         abstract = jax.tree.map(
@@ -51,9 +262,11 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        self._flush_manifests()
         self._mngr.close()
 
 
@@ -64,9 +277,9 @@ def restore_or_init(trainer, directory: str | None):
     Returns (state, resumed: bool)."""
     if directory:
         mngr = CheckpointManager(directory)
-        has_ckpt = mngr.latest_step() is not None
-        if has_ckpt:
-            restored = mngr.restore(trainer.abstract_state())
+        step = mngr.latest_intact_step()
+        if step is not None:
+            restored = mngr.restore(trainer.abstract_state(), step=step)
             mngr.close()
             return restored, True
         mngr.close()

@@ -52,6 +52,10 @@ if os.environ.get("KTPU_TEST_CACHE"):
                       os.environ["KTPU_TEST_CACHE"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -66,5 +70,84 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+# -- subprocess containment (ISSUE 10 satellite) ------------------------------
+# Tests that spawn real subprocesses (test_multiprocess_*, the chaos
+# suite) get a safety net: any child process that appears during the
+# test and survives teardown — or outlives the watchdog timeout — is
+# killed along with its whole process GROUP. A hung fault-injection
+# child can therefore never starve the tier-1 wall clock: the group
+# kill fires from a daemon timer even while the test body is blocked
+# in a wait().
+
+def _child_pids() -> set[int]:
+    """Direct children of this process (via /proc; Linux-only, which is
+    the only platform the tier-1 lane runs on)."""
+    me = os.getpid()
+    kids: set[int] = set()
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return kids
+    for d in entries:
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat", "rb") as f:
+                # field 4 (after the parenthesized comm, which may
+                # itself contain spaces) is ppid
+                ppid = int(f.read().split(b") ", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        if ppid == me:
+            kids.add(int(d))
+    return kids
+
+
+def _kill_group(pid: int, sig: int) -> None:
+    """Kill pid's process group — but NEVER our own (a child spawned
+    without start_new_session shares pytest's group; killpg there would
+    take the whole test session down)."""
+    try:
+        pgid = os.getpgid(pid)
+    except OSError:
+        return
+    try:
+        if pgid != os.getpgid(0):
+            os.killpg(pgid, sig)
+        else:
+            os.kill(pid, sig)
+    except OSError:
+        pass
+
+
+@pytest.fixture
+def procgroup_guard():
+    """Reap surviving child process groups on teardown, and after a hard
+    watchdog timeout even if the test body is still blocked. Use on any
+    test that spawns subprocesses."""
+    before = _child_pids()
+
+    def reap():
+        new = _child_pids() - before
+        if not new:
+            return
+        for pid in new:
+            _kill_group(pid, signal.SIGTERM)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and _child_pids() - before:
+            time.sleep(0.1)
+        for pid in _child_pids() - before:
+            _kill_group(pid, signal.SIGKILL)
+
+    watchdog = threading.Timer(240.0, reap)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        yield
+    finally:
+        watchdog.cancel()
+        reap()
 
 

@@ -1,0 +1,262 @@
+"""Crash recovery on the small engine (the chaos tentpole's fast-lane
+evidence): a supervised engine survives injected crashes and stalls with
+ZERO silently-lost requests, seeded/greedy requests replay
+byte-identically after a backend death, unseeded requests resume through
+the cancelled→retried chain, and degraded mode sheds by priority instead
+of collapsing."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.chaos import (FaultScriptConfig, FaultSpec,
+                                generate_fault_script)
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.agent import EngineSupervisor
+from kubeflow_tpu.serving.llm import LLMEngine
+from kubeflow_tpu.serving.scheduler import (QueueFull, ShedPolicy,
+                                            TenantShed)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=64, attention_impl="xla",
+                            dtype=jnp.float32, remat=False)
+    params = llama.init(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def _factory(tiny):
+    params, cfg = tiny
+
+    def make():
+        return LLMEngine(params, cfg, n_slots=2, max_len=64,
+                         buckets=(8, 16), prefer_native=False)
+    return make
+
+
+def _crash_now_script():
+    """A crash scheduled at t=0: armed mid-run, it fires on the very next
+    step — the test controls WHEN by choosing when to arm."""
+    return generate_fault_script(FaultScriptConfig(
+        seed=1, duration_s=1.0,
+        faults=(FaultSpec("backend_crash", 1, (0.0, 0.0)),)), name="now")
+
+
+def _supervisor(tiny, **kw):
+    kw.setdefault("stall_timeout_s", 30.0)   # compile-proof by default
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.05)
+    return EngineSupervisor(_factory(tiny), **kw)
+
+
+def _drive(sup, rids, max_steps=20000):
+    n = 0
+    while not all(sup.is_done(r) for r in rids):
+        sup.step()
+        n += 1
+        assert n < max_steps, "no convergence"
+
+
+def test_crash_midstream_replays_byte_identical(tiny):
+    params, cfg = tiny
+    # reference: the same requests on an undisturbed engine
+    ref = _factory(tiny)()
+    g_ref = ref.generate([1, 2, 3, 4], 12)
+    rid = ref.submit([5, 6, 7], 12, temperature=0.8, seed=42)
+    while not ref.is_done(rid):
+        ref.step()
+    s_ref = ref.result(rid)
+    ref.close()
+
+    sup = _supervisor(tiny)
+    a = sup.submit([1, 2, 3, 4], 12)                        # greedy
+    b = sup.submit([5, 6, 7], 12, temperature=0.8, seed=42)  # seeded
+    # let real tokens land BEFORE the crash (this is what "midstream"
+    # means: the journal holds partial generations)
+    while not (len(sup.partial_result(a)) >= 2
+               and len(sup.partial_result(b)) >= 2):
+        sup.step()
+    pre_a = sup.partial_result(a)
+    sup.arm_faults(_crash_now_script())   # fires on the next step
+    _drive(sup, [a, b])
+    assert sup.result(a) == g_ref
+    assert sup.result(b) == s_ref
+    # the replayed stream really is a superset of what was delivered
+    assert sup.result(a)[:len(pre_a)] == pre_a
+    assert sup.usage_chain(a) == ["replayed"]
+    assert sup.usage_chain(b) == ["replayed"]
+    acc = sup.accounting()
+    assert acc["lost"] == 0 and acc["restarts"] == 1
+    assert acc["replay_verified"] == 2 and acc["replay_mismatch"] == 0
+    assert acc["outages"][0]["cause"] == "injected_crash"
+    assert acc["mttr_s"] is not None and acc["mttr_s"] >= 0
+    sup.close()
+
+
+def test_unseeded_resumes_with_cancelled_retried_chain(tiny):
+    sup = _supervisor(tiny)
+    c = sup.submit([9, 10, 11], 10, temperature=0.9)   # unseeded sampled
+    while len(sup.partial_result(c)) < 3:
+        sup.step()
+    prefix = sup.partial_result(c)
+    sup.arm_faults(_crash_now_script())
+    _drive(sup, [c])
+    assert sup.usage_chain(c) == ["cancelled", "retried"]
+    # the journaled prefix is preserved, the tail is a fresh generation
+    assert sup.result(c)[:len(prefix)] == prefix
+    assert len(sup.result(c)) == 10
+    assert sup.finish_reason(c) in ("stop", "length")
+    acc = sup.accounting()
+    assert acc["retried"] == 1 and acc["lost"] == 0
+    # the retried request still reads as COMPLETED in the terminal tally
+    assert acc["completed"] == 1
+    sup.close()
+
+
+def test_second_crash_before_retry_token_keeps_prefix(tiny):
+    """An unseeded request whose RETRY is itself killed before emitting a
+    token must not rewind: the journaled prefix from the first
+    generation survives the second crash (regression for the
+    base_tokens-blind replay branch), and the budget never regrows."""
+    script = generate_fault_script(FaultScriptConfig(
+        seed=4, duration_s=1.0,
+        faults=(FaultSpec("backend_crash", 2, (0.0, 0.0)),)), name="x2")
+    sup = _supervisor(tiny)
+    c = sup.submit([9, 10, 11], 10, temperature=0.9)
+    while len(sup.partial_result(c)) < 3:
+        sup.step()
+    prefix = sup.partial_result(c)
+    sup.arm_faults(script)
+    sup.step()            # crash #1 fires; retry submitted on restart
+    seen = len(sup.partial_result(c))
+    _drive(sup, [c])      # crash #2 fires before/while the retry runs
+    assert seen >= len(prefix)   # the stream never rewound
+    assert sup.result(c)[:len(prefix)] == prefix
+    assert len(sup.result(c)) == 10
+    acc = sup.accounting()
+    assert acc["restarts"] == 2 and acc["lost"] == 0
+    assert sup.usage_chain(c)[:2] == ["cancelled", "retried"]
+    sup.close()
+
+
+def test_stall_watchdog_detects_and_restarts(tiny):
+    # stall active from t=0 and far longer than the watchdog timeout:
+    # only a restart (which "reschedules off the sick chip") can finish
+    script = generate_fault_script(FaultScriptConfig(
+        seed=2, duration_s=1.0,
+        faults=(FaultSpec("decode_stall", 1, (0.0, 0.0),
+                          (30.0, 30.0)),)), name="stall")
+    sup = _supervisor(tiny, stall_timeout_s=0.2, stall_min_steps=5)
+    a = sup.submit([1, 2, 3], 6)
+    sup.arm_faults(script)
+    _drive(sup, [a])
+    assert sup.finish_reason(a) in ("stop", "length")
+    acc = sup.accounting()
+    assert acc["restarts"] >= 1 and acc["lost"] == 0
+    assert any(o["cause"].startswith("stall") for o in acc["outages"])
+    sup.close()
+
+
+def test_degraded_mode_sheds_by_priority(tiny):
+    sup = _supervisor(tiny, shed_policy=ShedPolicy(
+        priorities=(("vip", 10),), default_priority=0, shed_below=1))
+    a = sup.submit([1, 2], 6, tenant="vip")
+    while len(sup.partial_result(a)) < 1:
+        sup.step()
+    sup.arm_faults(_crash_now_script())
+    sup.step()   # crash fires: engine down, degraded mode on
+    assert sup.degraded
+    with pytest.raises(TenantShed):
+        sup.submit([3, 4], 4, tenant="best-effort")
+    # the vip tenant is still ACCEPTED during the outage (journal-queued)
+    b = sup.submit([5, 6], 4, tenant="vip")
+    _drive(sup, [a, b])
+    assert not sup.degraded
+    acc = sup.accounting()
+    assert acc["shed"] == 1 and acc["lost"] == 0
+    assert acc["completed"] == 2
+    sup.close()
+
+
+def test_backoff_escalates_and_permanent_failure_is_terminal(tiny):
+    # 4 crashes vs max_restarts=2: backoff doubles per consecutive
+    # failure, then the supervisor declares the backend failed, finalizes
+    # everything as cancelled (terminal — never lost), and rejects new
+    # submits
+    script = generate_fault_script(FaultScriptConfig(
+        seed=3, duration_s=1.0,
+        faults=(FaultSpec("backend_crash", 4, (0.0, 0.0)),)), name="x4")
+    sup = _supervisor(tiny, max_restarts=2)
+    a = sup.submit([1, 2, 3], 8)
+    sup.arm_faults(script)
+    for _ in range(2000):
+        if not sup.step():
+            break
+    assert sup.failed
+    acc = sup.accounting()
+    delays = [o["backoff_s"] for o in acc["outages"]]
+    assert delays == sorted(delays) and delays[0] < delays[-1]
+    assert acc["lost"] == 0
+    assert sup.is_done(a) and sup.finish_reason(a) == "cancelled"
+    with pytest.raises(QueueFull):
+        sup.submit([1], 2)
+    sup.close()
+
+
+def test_client_cancel_rides_through_supervisor(tiny):
+    sup = _supervisor(tiny)
+    a = sup.submit([1, 2, 3], 32)
+    while len(sup.partial_result(a)) < 1:
+        sup.step()
+    assert sup.cancel(a)
+    assert sup.is_done(a) and sup.finish_reason(a) == "cancelled"
+    assert not sup.cancel(a)   # already terminal
+    sup.run_until_idle()
+    acc = sup.accounting()
+    assert acc["cancelled"] == 1 and acc["lost"] == 0
+    sup.close()
+
+
+def test_scenario_replay_with_fault_script_loses_nothing(tiny):
+    """The acceptance-criteria integration: a committed loadgen scenario
+    carrying the committed crash_midstream fault script, replayed through
+    the ordinary runner path — every accepted request terminal, the
+    chaos record committed alongside the SLO summary."""
+    from kubeflow_tpu.loadgen import load_scenario, miniature, run_scenario
+
+    scenario = miniature(load_scenario("steady"), vocab=120,
+                         max_prompt_len=14, duration_s=3.0, rate_rps=4.0)
+    sup = _supervisor(tiny, stall_timeout_s=5.0)
+    out = run_scenario(sup, scenario, fault_script="crash_midstream")
+    assert not out["timed_out"]
+    ch = out["chaos"]
+    assert ch["fault_script"] == "crash_midstream"
+    assert [e["kind"] for e in ch["events_scheduled"]] == ["backend_crash"]
+    acc = ch["accounting"]
+    assert acc["accepted"] == out["aggregate"]["n_requests"] \
+        - out["aggregate"]["rejected"]
+    assert acc["lost"] == 0 and acc["in_flight"] == 0
+    assert acc["restarts"] >= 1
+    # every record reached a terminal state the SLO table understands
+    agg = out["aggregate"]
+    assert agg["completed"] + agg["rejected"] \
+        + agg["client_cancelled"] >= agg["n_requests"] \
+        - acc["cancelled"]
+    sup.close()
+
+
+def test_bare_engine_refuses_fault_script(tiny):
+    from kubeflow_tpu.loadgen import load_scenario, miniature, run_scenario
+
+    params, cfg = tiny
+    eng = _factory(tiny)()
+    scenario = miniature(load_scenario("steady"), vocab=120,
+                         max_prompt_len=14, duration_s=1.0)
+    with pytest.raises(ValueError, match="not supervised"):
+        run_scenario(eng, scenario, fault_script="crash_midstream")
+    eng.close()

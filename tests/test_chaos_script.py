@@ -1,0 +1,130 @@
+"""Chaos fault scripts: determinism is a hard contract (same seed =>
+byte-identical script, in-process AND across processes — mirroring
+tests/test_loadgen_trace.py), plus the timeline shape each committed
+script promises. All jax-free — the chaos script layer must stay
+importable by lightweight clients."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.chaos import (FAULT_KINDS, FAULT_SCRIPTS, FaultScript,
+                                FaultScriptConfig, FaultSpec,
+                                generate_fault_script, load_fault_config,
+                                load_fault_script, script_bytes,
+                                script_sha256)
+from kubeflow_tpu.chaos.script import ONE_SHOT_KINDS, WINDOWED_KINDS
+
+CFG = FaultScriptConfig(seed=99, duration_s=20.0, faults=(
+    FaultSpec("backend_crash", 2, (0.2, 0.8)),
+    FaultSpec("decode_stall", 1, (0.1, 0.5), (1.0, 3.0)),
+    FaultSpec("partition", 1, (0.5, 0.9), (2.0, 4.0), target="0"),
+    FaultSpec("heartbeat_drop", 1, (0.0, 1.0), (0.5, 1.5)),
+))
+
+
+def test_same_seed_byte_identical_in_process():
+    a = generate_fault_script(CFG, name="x")
+    b = generate_fault_script(CFG, name="x")
+    assert script_bytes(a) == script_bytes(b)
+    assert script_sha256(a) == script_sha256(b)
+
+
+def test_same_seed_byte_identical_across_processes():
+    """The sha re-derives in a FRESH interpreter — no hidden process
+    state in the bytes (the loadgen trace contract, applied to faults)."""
+    prog = (
+        "from kubeflow_tpu.chaos import *\n"
+        f"cfg = FaultScriptConfig.from_json({CFG.to_json()!r})\n"
+        "print(script_sha256(generate_fault_script(cfg, name='x')))\n")
+    out = subprocess.run([sys.executable, "-c", prog],
+                         capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == script_sha256(
+        generate_fault_script(CFG, name="x"))
+
+
+def test_different_seed_differs():
+    assert script_bytes(generate_fault_script(CFG, name="x")) != \
+        script_bytes(generate_fault_script(CFG.replace(seed=100),
+                                           name="x"))
+
+
+def test_round_trip():
+    s = generate_fault_script(CFG, name="x")
+    assert FaultScriptConfig.from_json(
+        json.loads(json.dumps(CFG.to_json()))) == CFG
+    assert FaultScript.from_json(json.loads(script_bytes(s))) == s
+
+
+def test_timeline_shape():
+    s = generate_fault_script(CFG, name="x")
+    ts = [e.at_s for e in s.events]
+    assert ts == sorted(ts)
+    assert len(s.events) == 5
+    for e in s.events:
+        assert e.kind in FAULT_KINDS
+        assert 0.0 <= e.at_s <= CFG.duration_s
+        if e.kind in ONE_SHOT_KINDS:
+            assert e.duration_s == 0.0 and e.one_shot
+        else:
+            assert e.kind in WINDOWED_KINDS and e.duration_s > 0.0
+    # per-spec window bounds hold
+    crash = [e for e in s.events if e.kind == "backend_crash"]
+    assert all(0.2 * 20.0 <= e.at_s <= 0.8 * 20.0 for e in crash)
+    part = next(e for e in s.events if e.kind == "partition")
+    assert part.target == "0"
+
+
+def test_rescale_keeps_fractions_and_scales_durations():
+    full = generate_fault_script(CFG, name="x")
+    mini = generate_fault_script(CFG, name="x", duration_s=2.0)
+    scale = 2.0 / CFG.duration_s
+    for a, b in zip(full.events, mini.events):
+        assert a.kind == b.kind
+        assert b.at_s == pytest.approx(a.at_s * scale, abs=1e-4)
+        assert b.duration_s == pytest.approx(a.duration_s * scale,
+                                             abs=1e-4)
+
+
+def test_validation_rejects_bad_configs():
+    with pytest.raises(ValueError):
+        generate_fault_script(CFG.replace(faults=(
+            FaultSpec("nope", 1),)))
+    with pytest.raises(ValueError):
+        generate_fault_script(CFG.replace(faults=(
+            FaultSpec("backend_crash", 1, (0.8, 0.2)),)))
+    with pytest.raises(ValueError):
+        generate_fault_script(CFG.replace(faults=(
+            FaultSpec("decode_stall", 1, (0.0, 1.0), (3.0, 1.0)),)))
+    with pytest.raises(ValueError):
+        generate_fault_script(CFG.replace(faults=(
+            FaultSpec("backend_crash", 0),)))
+    with pytest.raises(ValueError):
+        generate_fault_script(CFG.replace(duration_s=0.0))
+    with pytest.raises(KeyError):
+        load_fault_config("nope")
+
+
+# -- committed fault scripts --------------------------------------------------
+
+def test_committed_scripts_load_and_pin():
+    assert set(FAULT_SCRIPTS) >= {"crash_midstream", "stall_and_partition"}
+    for name in FAULT_SCRIPTS:
+        s = load_fault_script(name)
+        assert s.name == name and len(s.events) >= 1
+        assert script_sha256(s) == script_sha256(load_fault_script(name))
+
+
+def test_committed_script_shapes():
+    crash = load_fault_script("crash_midstream")
+    assert [e.kind for e in crash.events] == ["backend_crash"]
+    # "midstream": strictly inside the window, not at an edge
+    assert 0.2 * crash.duration_s < crash.events[0].at_s \
+        < 0.8 * crash.duration_s
+    sp = load_fault_script("stall_and_partition")
+    kinds = [e.kind for e in sp.events]
+    assert kinds == ["decode_stall", "partition"]
+    stall, part = sp.events
+    assert stall.at_s + stall.duration_s < part.at_s   # disjoint phases

@@ -1,0 +1,116 @@
+"""Heartbeat reporter resilience (ISSUE 10 satellite): failed sends are
+retried with jittered exponential backoff instead of silently killing
+the loop, consecutive-failure count is surfaced (the "reporter
+struggling" vs "rank dead" distinction), and an injected heartbeat_drop
+window suppresses beats — making the rank look dead to the controller
+while the process is fine, which is the fault the chaos script means."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeflow_tpu.chaos import (FaultInjector, FaultScriptConfig,
+                                FaultSpec, generate_fault_script)
+from kubeflow_tpu.runtime.heartbeat import HeartbeatReporter
+from kubeflow_tpu.runtime.rendezvous import (PyCoordinatorServer,
+                                             RendezvousClient)
+
+
+def _reporter(srv, *, injector=None, max_failures=8,
+              ttl=0.3) -> HeartbeatReporter:
+    return HeartbeatReporter(srv.address, "hb-job", 1, 0,
+                             "10.0.0.1:5000", ttl,
+                             max_consecutive_failures=max_failures,
+                             injector=injector)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def test_transient_failures_recover_and_counter_resets():
+    srv = PyCoordinatorServer(hb_ttl_s=5.0)
+    hb = _reporter(srv)
+    try:
+        _wait(lambda: _present(srv), msg="registration")
+        # make sends fail transiently by breaking the client's call
+        real = hb._client.heartbeat
+        fail = {"on": True}
+
+        def flaky(gang, rank):
+            if fail["on"]:
+                raise ConnectionResetError("injected send failure")
+            return real(gang, rank)
+
+        hb._client.heartbeat = flaky
+        _wait(lambda: hb.consecutive_failures >= 2,
+              msg="failures to accumulate")
+        assert not hb.reporter_dead        # still retrying, loop alive
+        assert hb.last_error is not None
+        fail["on"] = False                 # network heals
+        _wait(lambda: hb.consecutive_failures == 0, msg="recovery")
+        assert not hb.reporter_dead
+    finally:
+        hb.stop()
+        srv.stop()
+
+
+def test_persistent_failure_surfaces_reporter_dead():
+    srv = PyCoordinatorServer(hb_ttl_s=5.0)
+    hb = _reporter(srv, max_failures=3, ttl=0.1)
+    try:
+        _wait(lambda: _present(srv), msg="registration")
+
+        def always_fail(gang, rank):
+            raise ConnectionResetError("injected: coordinator gone")
+
+        hb._client.heartbeat = always_fail
+        _wait(lambda: hb.reporter_dead, msg="reporter_dead")
+        assert hb.consecutive_failures >= 3
+        assert not hb._thread.is_alive() or hb.reporter_dead
+    finally:
+        hb.stop(mark_done=False)
+        srv.stop()
+
+
+def test_injected_heartbeat_drop_suppresses_beats():
+    """During an active heartbeat_drop window the reporter SKIPS sends
+    (dropped counts up, failures stay 0): the controller-side detector
+    sees silence exactly as if the rank died."""
+    srv = PyCoordinatorServer(hb_ttl_s=5.0)
+    script = generate_fault_script(FaultScriptConfig(
+        seed=11, duration_s=10.0,
+        faults=(FaultSpec("heartbeat_drop", 1, (0.0, 0.0),
+                          (0.6, 0.6)),)), name="drop")
+    inj = FaultInjector(script)
+    inj.start()
+    hb = _reporter(srv, injector=inj, ttl=0.15)
+    try:
+        _wait(lambda: hb.dropped >= 2, msg="beats to be dropped")
+        assert hb.consecutive_failures == 0   # drops are not failures
+        time.sleep(0.7)                        # window passes
+        before = hb.dropped
+        time.sleep(0.4)
+        assert hb.dropped == before            # beating normally again
+        assert not hb.reporter_dead
+    finally:
+        hb.stop()
+        srv.stop()
+
+
+def _present(srv) -> bool:
+    c = RendezvousClient(srv.address)
+    try:
+        present, _world, _dead = c.status("hb-job")
+        return present >= 1
+    except OSError:
+        return False
+    finally:
+        c.close()

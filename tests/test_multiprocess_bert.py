@@ -64,6 +64,7 @@ print("rank", ctx.process_id, "bert 4-host ok", round(losses[0], 4),
 
 
 @pytest.mark.slow
+@pytest.mark.usefixtures("procgroup_guard")
 def test_bert_four_process_ddp_jaxjob():
     job = new_resource("JAXJob", "bert-ddp", spec={
         "successPolicy": "AllWorkers",

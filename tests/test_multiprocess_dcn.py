@@ -10,6 +10,8 @@ topology → collectives cross process boundaries."""
 
 from __future__ import annotations
 
+import pytest
+
 from kubeflow_tpu.control import Cluster, JAXJobController, new_resource
 from kubeflow_tpu.control.conditions import has_condition, is_finished
 
@@ -52,6 +54,7 @@ print("rank", ctx.process_id, "dcn collective ok")
 """
 
 
+@pytest.mark.usefixtures("procgroup_guard")
 def test_jaxjob_two_process_distributed_collective():
     job = new_resource("JAXJob", "dcn", spec={
         "successPolicy": "AllWorkers",

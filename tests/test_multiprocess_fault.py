@@ -110,6 +110,7 @@ print(f"rank {rank} resumed-and-finished", flush=True)
 
 
 @pytest.mark.slow
+@pytest.mark.usefixtures("procgroup_guard")
 def test_heartbeat_gang_restart_across_real_processes(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     job = new_resource("JAXJob", "fault-dcn", spec={

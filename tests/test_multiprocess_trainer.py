@@ -63,6 +63,7 @@ print("rank", ctx.process_id, "multi-host train ok", losses)
 
 
 @pytest.mark.slow
+@pytest.mark.usefixtures("procgroup_guard")
 def test_jaxjob_two_process_sharded_train_step():
     job = new_resource("JAXJob", "dcn-train", spec={
         "successPolicy": "AllWorkers",

@@ -53,3 +53,39 @@ def test_check_floors_flags_missing_sections(tmp_path):
     failures = bench.check_floors(str(bad))
     assert any("missing" in f for f in failures)
     assert len(failures) >= 5
+
+
+def test_chaos_floors_gated_on_schema_4(tmp_path):
+    """serving_chaos floors (r9) only bind records new enough to carry
+    the section: the committed schema-3 record stays valid, a schema-4
+    record missing the section fails loudly, and a schema-4 record with
+    the section passing its floors is green."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 4   # committed record predates chaos
+    assert not any("chaos" in f for f in bench.check_floors(_RECORD))
+
+    rec4 = json.loads(json.dumps(rec))
+    rec4["schema"] = 4
+    p = tmp_path / "rec4.json"
+    p.write_text(json.dumps(rec4))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("chaos_crash_terminal_frac") for f in fails)
+    assert any(f.startswith("chaos_crash_goodput_retained")
+               for f in fails)
+
+    rec4["extras"]["serving_chaos"] = {
+        "crash_midstream": {"terminal_frac": 1.0,
+                            "goodput_retained": 0.5}}
+    p.write_text(json.dumps(rec4))
+    fails = bench.check_floors(str(p))
+    assert not any("chaos" in f for f in fails)
+
+    # the zero-lost invariant floor is EXACT: 0.999 is a failure
+    rec4["extras"]["serving_chaos"]["crash_midstream"][
+        "terminal_frac"] = 0.999
+    p.write_text(json.dumps(rec4))
+    assert any(f.startswith("chaos_crash_terminal_frac")
+               for f in bench.check_floors(str(p)))

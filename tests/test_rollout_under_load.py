@@ -32,6 +32,86 @@ def _post(url, name, payload, timeout=30.0):
         return r.status, json.loads(r.read())
 
 
+def test_idle_stop_race_never_502():
+    """The r8 `_pending_stop` fix under REAL concurrency (ISSUE 10
+    satellite): with scaleToZeroIdleSeconds smaller than the steady
+    scenario's typical inter-arrival gap, the controller keeps stopping
+    the predictor between requests while traffic keeps arriving — every
+    request lands somewhere on the activate/idle-stop edge. The contract
+    is zero 502s: the router must never forward to a port whose server
+    was stopped before `set_backends` dropped it. Two extra jitter
+    threads fire deliberately-unaligned requests to hit the window from
+    more phases than the open-loop schedule alone."""
+    scenario = miniature(load_scenario("steady"), vocab=64,
+                         max_prompt_len=8, duration_s=4.0, rate_rps=5.0)
+    trace = generate_trace(scenario.trace)
+    arrivals = [r.arrival_s for r in trace.requests]
+    assert len(arrivals) >= 10
+
+    c = Cluster(n_devices=8)
+    c.add(serving.InferenceServiceController)
+    with c:
+        c.store.create(new_resource(serving.ISVC_KIND, "edge", spec={
+            "predictor": {"model": {"modelFormat": "mean"},
+                          "minReplicas": 0,
+                          # well under the ~0.2 s mean gap at 5 rps: the
+                          # idle stop fires BETWEEN arrivals, repeatedly
+                          "scaleToZeroIdleSeconds": 0.1},
+        }))
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "edge",
+            lambda o: has_condition(o["status"], "Ready"), timeout=30)
+        url = isvc["status"]["url"]
+
+        statuses: list[int] = []
+        thread_errors: list[BaseException] = []
+        lock = threading.Lock()
+
+        def fire():
+            status, out = _post(url, "edge", {"instances": [[1.0, 3.0]]},
+                                timeout=60)
+            with lock:
+                statuses.append(status)
+            assert out.get("predictions") == [2.0] or status != 200
+
+        def jitter(offset: float, period: float, until: float):
+            # exceptions must FAIL the test, not die with the thread —
+            # a jitter request that 502s or errors is exactly the
+            # regression this test exists to catch
+            try:
+                t0 = time.perf_counter()
+                time.sleep(offset)
+                while time.perf_counter() - t0 < until:
+                    fire()
+                    time.sleep(period)
+            except BaseException as e:
+                with lock:
+                    thread_errors.append(e)
+
+        # jitter threads phase-shifted against the idle threshold so
+        # requests land both just-before and just-after stop decisions
+        threads = [
+            threading.Thread(target=jitter, args=(0.05, 0.13, 4.0)),
+            threading.Thread(target=jitter, args=(0.11, 0.17, 4.0)),
+        ]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        for due in arrivals:
+            now = time.perf_counter() - t0
+            if now < due:
+                time.sleep(due - now)
+            fire()
+        for t in threads:
+            t.join()
+
+        assert not thread_errors, thread_errors
+        # main-thread arrivals + both jitter threads all landed
+        assert len(statuses) > len(arrivals) + 2
+        bad = [s for s in statuses if s != 200]
+        assert not bad, f"{len(bad)} non-200 of {len(statuses)}: {bad[:5]}"
+
+
 @pytest.mark.slow
 def test_canary_and_scale_to_zero_under_steady_load():
     scenario = miniature(load_scenario("steady"), vocab=64,

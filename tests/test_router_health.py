@@ -1,0 +1,237 @@
+"""Health-gated routing (the chaos tentpole's router half): per-backend
+circuit breakers, transport-failure retry onto healthy replicas,
+503+Retry-After when every circuit is open, half-open recovery after an
+injected partition heals, and the controller's restartPolicy /
+backoffLimit crash-restart machinery."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import serving
+from kubeflow_tpu.chaos import (FaultInjector, FaultScriptConfig,
+                                FaultSpec, generate_fault_script)
+from kubeflow_tpu.control import Cluster, new_resource
+from kubeflow_tpu.control.conditions import has_condition
+from kubeflow_tpu.serving.model import ModelRepository, load_model
+from kubeflow_tpu.serving.router import (CLOSED, HALF_OPEN, OPEN,
+                                         Router)
+from kubeflow_tpu.serving.server import ModelServer
+
+
+def _mean_server() -> ModelServer:
+    repo = ModelRepository()
+    repo.register(load_model("mean", "m"))
+    return ModelServer(repo).start()
+
+
+def _get(url: str, path: str = "/v1/models/m:predict",
+         payload=None, timeout=10.0):
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(payload or {"instances": [[1.0, 3.0]]}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_healthz_and_alive():
+    s = _mean_server()
+    with urllib.request.urlopen(s.url + "/healthz", timeout=5) as r:
+        body = json.loads(r.read())
+    assert body["alive"] and body["uptime_s"] >= 0
+    assert s.alive
+    s.stop()
+    assert not s.alive
+
+
+def test_dead_replica_routed_around_with_zero_client_errors():
+    """Kill one of two replicas: the transport-failure retry plus the
+    circuit breaker must keep every CLIENT response a 200 — the router
+    eats the failure, trips the circuit, and stops picking the corpse."""
+    a, b = _mean_server(), _mean_server()
+    r = Router("t/two", failure_threshold=2, circuit_open_s=60.0)
+    try:
+        r.set_backends([a.port, b.port])
+        for _ in range(4):
+            code, body, _ = _get(r.url)
+            assert code == 200 and body["predictions"] == [2.0]
+        b.stop()
+        statuses = [_get(r.url)[0] for _ in range(20)]
+        assert statuses == [200] * 20
+        assert r.circuit_states()[b.port] == OPEN
+        assert r.circuit_states()[a.port] == CLOSED
+    finally:
+        r.stop()
+        a.stop()
+
+
+def test_all_circuits_open_returns_503_with_retry_after():
+    a, b = _mean_server(), _mean_server()
+    r = Router("t/dead", failure_threshold=1, circuit_open_s=60.0)
+    try:
+        r.set_backends([a.port, b.port])
+        a.stop()
+        b.stop()
+        code, body, _ = _get(r.url)   # trips both circuits via retries
+        assert code in (502, 503)
+        code, body, headers = _get(r.url)
+        assert code == 503
+        assert "circuit open" in body["error"]
+        assert int(headers.get("Retry-After", "0")) >= 1
+        assert r.breaker_rejected >= 1
+    finally:
+        r.stop()
+
+
+def test_partition_heals_through_half_open_probe():
+    """An injected router↔backend partition opens the circuit; once the
+    window passes and the hold-off expires, ONE half-open probe closes
+    it again — no restart involved, the backend was healthy all along."""
+    a = _mean_server()
+    script = generate_fault_script(FaultScriptConfig(
+        seed=7, duration_s=10.0,
+        faults=(FaultSpec("partition", 1, (0.0, 0.0), (0.6, 0.6)),)),
+        name="part")
+    inj = FaultInjector(script)
+    r = Router("t/part", failure_threshold=1, circuit_open_s=0.2)
+    try:
+        r.set_backends(a.port)
+        r.set_fault_injector(inj)
+        inj.start()
+        code, body, _ = _get(r.url)
+        assert code == 502   # partitioned, single backend: surfaced
+        assert r.circuit_states()[a.port] == OPEN
+        # while open: immediate 503 + Retry-After, no connection attempt
+        code, _, headers = _get(r.url)
+        assert code == 503 and "Retry-After" in headers
+        time.sleep(0.75)   # partition over AND hold-off expired
+        assert r.circuit_states()[a.port] == HALF_OPEN
+        code, body, _ = _get(r.url)   # the probe
+        assert code == 200 and body["predictions"] == [2.0]
+        assert r.circuit_states()[a.port] == CLOSED
+        assert inj.log() and inj.log()[0]["kind"] == "partition"
+    finally:
+        r.stop()
+        a.stop()
+
+
+def test_failed_probe_reopens_with_doubled_holdoff():
+    a = _mean_server()
+    r = Router("t/re", failure_threshold=1, circuit_open_s=0.1)
+    try:
+        r.set_backends(a.port)
+        a.stop()
+        _get(r.url)                       # trip: open_s = 0.1
+        time.sleep(0.15)
+        assert r.circuit_states()[a.port] == HALF_OPEN
+        code, _, _ = _get(r.url)          # failed probe
+        assert code == 502
+        c = r._circuits[a.port]
+        assert c.state == OPEN and c.open_s == pytest.approx(0.2)
+    finally:
+        r.stop()
+
+
+# -- controller crash restart -------------------------------------------------
+
+def _cond(status, ctype):
+    for c in status.get("conditions", ()):
+        if c["type"] == ctype and c["status"] == "True":
+            return c
+    return None
+
+def _mk_isvc(c, name, **predictor_extra):
+    spec = {"predictor": {"model": {"modelFormat": "mean"},
+                          **predictor_extra}}
+    c.store.create(new_resource(serving.ISVC_KIND, name, spec=spec))
+    return c.wait_for(
+        serving.ISVC_KIND, name,
+        lambda o: has_condition(o["status"], "Ready"), timeout=30)
+
+
+def test_controller_restarts_crashed_predictor():
+    c = Cluster(n_devices=8)
+    ctrl = c.add(serving.InferenceServiceController)
+    with c:
+        isvc = _mk_isvc(c, "boom")
+        url = isvc["status"]["url"]
+        path = "/v1/models/boom:predict"
+        assert _get(url, path)[0] == 200
+        # the pod dies (server stops serving without the controller's
+        # consent) — the reconcile loop must notice and restart it
+        inst = ctrl._instances[("default", "boom", "predictor")][0]
+        old_port = inst.server.port
+        inst.server.stop()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with ctrl._lock:
+                insts = ctrl._instances.get(
+                    ("default", "boom", "predictor"), [])
+            if insts and insts[0].server.alive \
+                    and insts[0].server.port != old_port:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("crashed predictor was never restarted")
+        # traffic flows again through the router (backends re-pointed)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _get(url, path)[0] == 200:
+                break
+            time.sleep(0.1)
+        assert _get(url, path)[0] == 200
+        with ctrl._lock:
+            cb = ctrl._crash_backoff[("default", "boom", "predictor")]
+        assert cb["count"] >= 1
+
+
+def test_restart_policy_never_fails_loudly():
+    c = Cluster(n_devices=8)
+    ctrl = c.add(serving.InferenceServiceController)
+    with c:
+        _mk_isvc(c, "once", restartPolicy="Never")
+        inst = ctrl._instances[("default", "once", "predictor")][0]
+        inst.server.stop()
+        isvc = c.wait_for(
+            serving.ISVC_KIND, "once",
+            lambda o: has_condition(o["status"], "Failed"), timeout=20)
+        cond = _cond(isvc["status"], "Failed")
+        assert cond["reason"] == "RestartPolicyNever"
+        with ctrl._lock:
+            assert not ctrl._instances.get(
+                ("default", "once", "predictor"))
+
+
+def test_backoff_limit_exhaustion_is_crashloopbackoff():
+    c = Cluster(n_devices=8)
+    ctrl = c.add(serving.InferenceServiceController)
+    with c:
+        _mk_isvc(c, "loopy", backoffLimit=1)
+        key = ("default", "loopy", "predictor")
+        # crash it repeatedly: each restart gets killed again until the
+        # limit (1) is exhausted → CrashLoopBackOff, no further restarts
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with ctrl._lock:
+                insts = list(ctrl._instances.get(key, []))
+            for inst in insts:
+                if inst.server.alive:
+                    inst.server.stop()
+            isvc = c.store.get(serving.ISVC_KIND, "loopy")
+            cond = _cond(isvc["status"], "Failed")
+            if cond and cond["reason"] == "CrashLoopBackOff":
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("CrashLoopBackOff never reported")
+        with ctrl._lock:
+            assert ctrl._crash_backoff[key]["count"] >= 2
