@@ -25,6 +25,7 @@ memory evidence).
 from __future__ import annotations
 
 import json
+import math
 import os
 import tempfile
 import time
@@ -279,6 +280,13 @@ def main() -> None:
             extras["serving_chaos"] = serving_chaos_bench(on_tpu, budget)
         except Exception as e:
             extras["serving_chaos_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "serving_prefix_cache"):
+        try:
+            extras["serving_prefix_cache"] = serving_prefix_cache_bench(
+                on_tpu, budget)
+        except Exception as e:
+            extras["serving_prefix_cache_error"] = \
+                f"{type(e).__name__}: {e}"
     extras["budget"] = {"total_s": budget.total_s,
                         "used_s": round(budget.elapsed(), 1),
                         "env": BUDGET_ENV}
@@ -304,10 +312,11 @@ def main() -> None:
                                      "BENCH_EXTRAS.cpu.json"))
     with open(extras_path, "w") as f:
         # schema 2 = the record carries serving_scenarios; schema 3 adds
-        # rl_anakin; schema 4 adds serving_chaos. The floor gate only
-        # demands a section's metrics from records new enough to know
-        # about it (older committed records stay valid under --check).
-        json.dump({"schema": 4, "headline": headline, "extras": extras},
+        # rl_anakin; schema 4 adds serving_chaos; schema 5 adds
+        # serving_prefix_cache. The floor gate only demands a section's
+        # metrics from records new enough to know about it (older
+        # committed records stay valid under --check).
+        json.dump({"schema": 5, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -373,6 +382,19 @@ PERF_FLOORS = {
     # guards against total collapse (zero goodput under fault); raise it
     # once the first hardware record lands.
     "chaos_crash_goodput_retained": 0.02,
+    # serving_prefix_cache (r10): enforced only on schema>=5 records.
+    # The shared_prefix_chat scenario is built so that most admissions
+    # extend a cached chain (turn >= 2 always should; turn-1 hits ride
+    # template popularity), so a hit rate under 0.5 means the radix
+    # path broke, not that traffic got unlucky.
+    "prefix_cache_hit_rate": 0.5,
+    # fraction of offered prefill tokens served from reused KV
+    # (saved / (saved + computed)); conservative — the scenario's
+    # template-to-turn ratio puts the expected value well above this.
+    "prefix_prefill_saved_frac": 0.2,
+    # EXACT contract, not a perf number: greedy tokens through the
+    # cached path must be byte-identical to the cold engine's.
+    "prefix_greedy_parity": 1.0,
 }
 
 
@@ -424,6 +446,15 @@ def check_floors(path: str) -> list[str]:
         checks.append(("chaos_crash_goodput_retained",
                        get(ex, "serving_chaos", "crash_midstream",
                            "goodput_retained")))
+    if rec.get("schema", 1) >= 5:
+        checks.append(("prefix_cache_hit_rate",
+                       get(ex, "serving_prefix_cache", "hit_rate")))
+        checks.append(("prefix_prefill_saved_frac",
+                       get(ex, "serving_prefix_cache",
+                           "prefill_saved_frac")))
+        parity = get(ex, "serving_prefix_cache", "greedy_parity")
+        checks.append(("prefix_greedy_parity",
+                       None if parity is None else float(parity)))
     failures = []
     for name, got in checks:
         floor = PERF_FLOORS[name]
@@ -1610,6 +1641,153 @@ def serving_chaos_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
     # in the committed script) but consumed by the router tests instead
     out["note"] = ("partition events are router-level — exercised by "
                    "tests/test_router_health.py, not this replay")
+    return out
+
+
+def serving_prefix_cache_bench(on_tpu: bool,
+                               budget: Budget | None = None) -> dict:
+    """Prefix-KV reuse record (ISSUE 11, the kvcache tentpole): replay
+    the committed `shared_prefix_chat` scenario twice against the same
+    model — once through an engine running the radix prefix cache, once
+    through a cache-disabled engine — and commit:
+
+    - hit_rate: admissions served from a cached chain / eligible
+      admissions (floor 0.5: the scenario is BUILT to hit — every
+      turn >= 2 extends a cached prompt);
+    - prefill_saved_frac + prefill tokens per request cached vs cold —
+      the compute the cache actually removed from the prefill path;
+    - ttft_p50_ms cached vs cold (the step-change claim; recorded, not
+      floored — at CPU toy dims the prefill delta sits inside timer
+      noise, on TPU it is the headline);
+    - greedy_parity: a shared-prefix probe set generated on BOTH
+      engines must be byte-identical (the cached path replays the same
+      math over reused KV — an exact contract, floor 1.0).
+
+    Both runs replay the identical byte-pinned trace (sha recorded), so
+    the comparison is between engines, never between workloads."""
+    from kubeflow_tpu.loadgen import (generate_trace, load_scenario,
+                                      miniature, run_scenario,
+                                      trace_sha256)
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=3584, max_seq_len=1024, remat=False)
+        eng_kw = dict(n_slots=8, max_len=512, buckets=(64, 128, 256),
+                      decode_chunk=8)
+        # warm_cont_pairs=None: pre-compile the WHOLE continuation menu
+        # so the replayed TTFTs measure the cache, not mid-run XLA
+        # compiles (warmup_s absorbs the cost, as everywhere else)
+        cache_kw = dict(prefix_cache=True, prefix_cache_blocks=256,
+                        warm_cont_pairs=None)
+        mini = None
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=8,
+            n_kv_heads=4, d_ff=128, max_seq_len=256)
+        eng_kw = dict(n_slots=4, max_len=160, buckets=(8, 16, 32),
+                      decode_chunk=8)
+        cache_kw = dict(prefix_cache=True, prefix_cache_blocks=128,
+                        warm_cont_pairs=None)
+        mini = dict(vocab=cfg.vocab_size, max_prompt_len=60,
+                    duration_s=4.0, rate_rps=4.0)
+    params = llama.init(jax.random.key(0), cfg)
+    scenario = load_scenario("shared_prefix_chat")
+    if mini is not None:
+        scenario = miniature(scenario, **mini)
+    trace = generate_trace(scenario.trace)
+    out: dict = {
+        "engine": {"model": (f"d{cfg.d_model}xL{cfg.n_layers}" if on_tpu
+                             else "llama-tiny(cpu)"),
+                   "n_slots": eng_kw["n_slots"],
+                   "buckets": eng_kw["buckets"],
+                   "max_len": eng_kw["max_len"],
+                   "block_tokens": math.gcd(*eng_kw["buckets"]),
+                   "capacity_blocks": cache_kw["prefix_cache_blocks"]},
+        "scenario": scenario.name,
+        "trace_sha256": trace_sha256(trace),
+        "n_requests": len(trace.requests),
+    }
+
+    def one_run(label: str, **extra_kw) -> dict | None:
+        if budget is not None and budget.expired():
+            out.setdefault("skipped_for_budget", []).append(label)
+            return None
+        engine = LLMEngine(params, cfg, **eng_kw, **extra_kw)
+        try:
+            t0 = time.perf_counter()
+            engine.warmup()
+            warmup_s = round(time.perf_counter() - t0, 1)
+            wall = scenario.trace.duration_s * 4.0 + 60.0
+            if budget is not None:
+                wall = max(5.0, min(wall, budget.remaining()))
+            res = run_scenario(engine, scenario, max_wall_s=wall)
+            m = engine.metrics()
+            done = max(1, m["completed"])
+            return {
+                "warmup_s": warmup_s,
+                "ttft_p50_ms": res["aggregate"]["ttft_p50_ms"],
+                "ttft_p95_ms": res["aggregate"].get("ttft_p95_ms"),
+                "slo_attainment": res["aggregate"]["slo_attainment"],
+                "timed_out": res["timed_out"],
+                "completed": m["completed"],
+                "prefill_tokens_computed": m["prefill_tokens_computed"],
+                "prefill_tokens_per_request": round(
+                    m["prefill_tokens_computed"] / done, 2),
+                "prefix_cache": m.get("prefix_cache"),
+            }
+        finally:
+            engine.close()
+            del engine
+
+    cached = one_run("cached", **cache_kw)
+    cold = one_run("cold")
+    if cached is not None:
+        out["cached"] = cached
+        pc = cached["prefix_cache"] or {}
+        out["hit_rate"] = pc.get("request_hit_rate")
+        saved = pc.get("prefill_tokens_saved", 0)
+        computed = pc.get("prefill_tokens_computed", 0)
+        out["prefill_saved_frac"] = (round(saved / (saved + computed), 4)
+                                     if saved + computed else None)
+    if cold is not None:
+        out["cold"] = cold
+    if cached is not None and cold is not None:
+        out["prefill_tokens_per_request_cached"] = \
+            cached["prefill_tokens_per_request"]
+        out["prefill_tokens_per_request_cold"] = \
+            cold["prefill_tokens_per_request"]
+        if cached["ttft_p50_ms"] and cold["ttft_p50_ms"]:
+            out["ttft_p50_speedup"] = round(
+                cold["ttft_p50_ms"] / cached["ttft_p50_ms"], 3)
+    # greedy parity: a fresh pair of engines (the scenario runs above
+    # decode different mixes of arrival timing, so parity needs its own
+    # controlled probe): shared template + distinct tails, generated on
+    # the cached engine twice (miss then hit) and on a cold engine
+    if budget is None or not budget.expired():
+        parity_eng = LLMEngine(params, cfg, **eng_kw, **cache_kw)
+        plain_eng = LLMEngine(params, cfg, **eng_kw)
+        try:
+            parity_eng.warmup()
+            plain_eng.warmup()
+            # the shared prefix must span >= 2 BLOCKS at this engine's
+            # geometry or the probe can never hit (block = bucket gcd:
+            # 8 on the CPU engine, 64 on the TPU engine)
+            bt = parity_eng.prefix_block_tokens
+            shared = [(i * 7) % (cfg.vocab_size - 1) + 1
+                      for i in range(2 * bt + bt // 2)]
+            parity = True
+            for tail in ([17, 23, 5], [101, 9], [55, 56, 57, 58]):
+                want = plain_eng.generate(shared + tail, 12)
+                got = parity_eng.generate(shared + tail, 12)
+                parity = parity and (got == want)
+            hits = parity_eng.metrics()["prefix_hits"]
+            out["greedy_parity"] = bool(parity and hits >= 2)
+            out["parity_probe_hits"] = hits
+        finally:
+            parity_eng.close()
+            plain_eng.close()
     return out
 
 

@@ -1,0 +1,17 @@
+"""Fleet-scale prefix-KV reuse (ROADMAP #4).
+
+`kvcache.radix` is the index: a radix/block-trie over token sequences
+mapping to ref-counted, fixed-size KV blocks with LRU eviction that
+never reclaims in-use blocks, plus per-tenant reuse accounting. The
+serving engine (`serving/llm.py`) owns the device side — extracting
+block payloads after prefill, materializing matched chains into the
+continuation programs' prefix arrays — and the router
+(`serving/router.py`) owns placement: rendezvous-hashed session
+affinity so repeat traffic lands on the replica that already holds its
+prefix. The loadgen `shared_prefix` trace family measures the whole
+loop honestly.
+"""
+
+from kubeflow_tpu.kvcache.radix import (Block, MatchResult, RadixKVCache)
+
+__all__ = ["Block", "MatchResult", "RadixKVCache"]

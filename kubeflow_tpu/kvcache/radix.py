@@ -1,0 +1,355 @@
+"""Radix-tree prefix index over token sequences → ref-counted KV blocks.
+
+The fleet-scale KV-reuse core (ROADMAP #4, the SGLang RadixAttention /
+vLLM prefix-caching idea, TPU-shaped): at production scale most traffic
+shares prefixes — system prompts, few-shot templates, multi-turn chat
+context — so the KV a prefill computes for one request is the KV the
+next request with the same prefix needs. This module is the INDEX over
+that sharing:
+
+  - token sequences are paged into fixed-size blocks of `block_tokens`
+    tokens; a cached prefix is a root-to-node chain of blocks in a trie
+    whose edges are exact token tuples of one block each (fixed-size
+    blocks make every edge the same length, so the "radix" tree
+    degenerates into a block-trie — the static-shape form the serving
+    engine's compiled-program menu wants);
+  - each node owns ONE block payload (opaque to this module: the engine
+    stores device KV arrays, tests store anything) plus a reference
+    count and an LRU tick;
+  - `match()` returns the longest cached block-aligned prefix and PINS
+    its chain (ref+1 per block) so eviction can never reclaim KV an
+    in-flight prefill is about to consume — the caller releases after
+    the dispatch;
+  - `insert()` extends chains block-by-block, deduplicating against
+    what is already cached (inserting a prompt whose template is cached
+    stores only the new suffix blocks), evicting LRU *leaves* with
+    refs == 0 to stay under `capacity_blocks` — interior nodes are
+    never evicted (that would orphan their descendants' chains), pinned
+    nodes are never evicted (the in-use invariant), and when nothing is
+    evictable the insert simply stops caching (a cache must degrade,
+    never corrupt);
+  - per-tenant accounting (hits, misses, reused tokens, inserted /
+    evicted blocks) is recorded by explicit `record_hit`/`record_miss`
+    calls, NOT inside match(): the engine may match a prefix and then
+    find no legal continuation program for it, and that must not count
+    as a hit in the committed record.
+
+Deliberately jax-free: payloads are opaque, so the structure and its
+invariants are testable in the fast lane with plain Python objects,
+and the module is importable by routing/analysis code that never
+touches a device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+
+class Block:
+    """One cached block: `payload` is opaque (the engine stores device
+    KV arrays — [L, 1, B, kv, hd] slices, quantized when the cache is
+    int8), `refs` counts live pins, `tick` is the LRU clock."""
+
+    __slots__ = ("payload", "refs", "tick")
+
+    def __init__(self, payload: Any, tick: int):
+        self.payload = payload
+        self.refs = 0
+        self.tick = tick
+
+
+class _Node:
+    __slots__ = ("key", "parent", "children", "block")
+
+    def __init__(self, key: tuple | None, parent: "_Node | None",
+                 block: Block | None):
+        self.key = key                      # edge label from parent
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}
+        self.block = block
+
+
+class MatchResult:
+    """A pinned longest-cached-prefix: `tokens` matched (a multiple of
+    block_tokens), `payloads` in chain order. Hold it across the window
+    where the payloads must stay alive; `RadixKVCache.release()` unpins.
+    Truncating consumption to fewer blocks than matched is fine — the
+    pin covers the whole chain either way."""
+
+    __slots__ = ("tokens", "payloads", "_nodes", "_released")
+
+    def __init__(self, tokens: int, payloads: list[Any],
+                 nodes: list[_Node]):
+        self.tokens = tokens
+        self.payloads = payloads
+        self._nodes = nodes
+        self._released = False
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._nodes)
+
+
+class RadixKVCache:
+    """Thread-safe block-granular prefix KV index. See module docstring
+    for the invariants; `check_invariants()` asserts them (the property
+    tests drive it after every operation)."""
+
+    def __init__(self, block_tokens: int, capacity_blocks: int):
+        if block_tokens < 1:
+            raise ValueError("block_tokens must be >= 1")
+        if capacity_blocks < 1:
+            raise ValueError("capacity_blocks must be >= 1")
+        self.block_tokens = int(block_tokens)
+        self.capacity_blocks = int(capacity_blocks)
+        # one root per namespace: the engine namespaces by adapter id —
+        # a prefix prefilled through adapter X is WRONG KV for adapter Y
+        # even at identical tokens, so the chains must never collide.
+        # Capacity and eviction are shared across namespaces.
+        self._roots: dict[Any, _Node] = {}
+        self._n_blocks = 0
+        self._tick = 0
+        self._lock = threading.RLock()
+        # global + per-tenant accounting; tenant None aggregates under
+        # the anonymous "" row so the committed record never carries a
+        # null key
+        self._acct: dict[str, dict[str, int]] = {}
+        self._evicted_blocks = 0
+        self._inserted_blocks = 0
+
+    # -- structure -----------------------------------------------------------
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.block.tick = self._tick
+
+    def _root_for(self, namespace: Any, create: bool) -> "_Node | None":
+        root = self._roots.get(namespace)
+        if root is None and create:
+            root = _Node(None, None, None)
+            self._roots[namespace] = root
+        return root
+
+    def match(self, tokens: Sequence[int], *,
+              max_tokens: int | None = None,
+              namespace: Any = None) -> MatchResult:
+        """Longest cached block-aligned prefix of `tokens`, capped at
+        `max_tokens` (the engine passes len(prompt) - 1: at least one
+        tail token must remain to produce next-token logits). Pins every
+        block on the returned chain and LRU-touches it; ALWAYS pair with
+        release(), even for 0-token matches (a no-op there)."""
+        bt = self.block_tokens
+        limit = len(tokens) if max_tokens is None else min(len(tokens),
+                                                           max_tokens)
+        with self._lock:
+            node = self._root_for(namespace, create=False)
+            if node is None:
+                return MatchResult(0, [], [])
+            nodes: list[_Node] = []
+            pos = 0
+            while pos + bt <= limit:
+                key = tuple(tokens[pos:pos + bt])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                nodes.append(child)
+                node = child
+                pos += bt
+            for n in nodes:
+                n.block.refs += 1
+                self._touch(n)
+            return MatchResult(pos, [n.block.payload for n in nodes],
+                               nodes)
+
+    def release(self, m: MatchResult) -> None:
+        """Unpin a match (idempotent)."""
+        with self._lock:
+            if m._released:
+                return
+            m._released = True
+            for n in m._nodes:
+                n.block.refs -= 1
+
+    def cached_prefix_len(self, tokens: Sequence[int], *,
+                          max_tokens: int | None = None,
+                          namespace: Any = None) -> int:
+        """Unpinned probe: how many leading tokens a match() would
+        return right now. Does NOT touch LRU order — probes (submit-time
+        reporting, skip-extract checks) must not keep dead entries
+        warm."""
+        bt = self.block_tokens
+        limit = len(tokens) if max_tokens is None else min(len(tokens),
+                                                           max_tokens)
+        with self._lock:
+            node = self._root_for(namespace, create=False)
+            if node is None:
+                return 0
+            pos = 0
+            while pos + bt <= limit:
+                child = node.children.get(tuple(tokens[pos:pos + bt]))
+                if child is None:
+                    break
+                node = child
+                pos += bt
+            return pos
+
+    def insert(self, tokens: Sequence[int],
+               payload_fn: Callable[[int, int, int], Any], *,
+               max_tokens: int | None = None,
+               tenant: str | None = None,
+               namespace: Any = None) -> int:
+        """Cache the block-aligned prefix of `tokens` (up to
+        `max_tokens`), extending whatever chain already exists.
+        `payload_fn(block_index, start, end)` is called ONLY for blocks
+        not already cached — the engine slices device KV lazily, so a
+        fully-cached prompt costs zero extraction. Returns the number of
+        NEW blocks stored. Stops early (still a valid chain — a prefix
+        of a prefix is a prefix) when capacity is exhausted and nothing
+        is evictable."""
+        bt = self.block_tokens
+        limit = len(tokens) if max_tokens is None else min(len(tokens),
+                                                           max_tokens)
+        new_blocks = 0
+        with self._lock:
+            node = self._root_for(namespace, create=True)
+            path: set[int] = {id(node)}
+            pos = 0
+            while pos + bt <= limit:
+                key = tuple(tokens[pos:pos + bt])
+                child = node.children.get(key)
+                if child is None:
+                    if self._n_blocks >= self.capacity_blocks \
+                            and not self._evict_one(path):
+                        break
+                    self._tick += 1
+                    block = Block(payload_fn(pos // bt, pos, pos + bt),
+                                  self._tick)
+                    child = _Node(key, node, block)
+                    node.children[key] = child
+                    self._n_blocks += 1
+                    self._inserted_blocks += 1
+                    new_blocks += 1
+                    self._row(tenant)["inserted_blocks"] += 1
+                else:
+                    self._touch(child)
+                node = child
+                path.add(id(node))
+                pos += bt
+        return new_blocks
+
+    def _evict_one(self, protect: set[int]) -> bool:
+        """Reclaim the LRU evictable leaf: refs == 0, no children, not
+        on the current insertion path. O(n) scan — capacities are
+        hundreds of blocks, and insert is never on the decode hot
+        path. Returns False when nothing is evictable (everything
+        pinned or interior): the caller degrades to not caching."""
+        victim: _Node | None = None
+        stack = list(self._roots.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if (n.block is not None and not n.children
+                    and n.block.refs == 0 and id(n) not in protect
+                    and (victim is None or n.block.tick
+                         < victim.block.tick)):
+                victim = n
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        victim.block.payload = None   # drop the device arrays NOW
+        self._n_blocks -= 1
+        self._evicted_blocks += 1
+        return True
+
+    # -- accounting ----------------------------------------------------------
+
+    def _row(self, tenant: str | None) -> dict[str, int]:
+        key = tenant if tenant is not None else ""
+        row = self._acct.get(key)
+        if row is None:
+            row = {"hits": 0, "misses": 0, "reused_tokens": 0,
+                   "inserted_blocks": 0}
+            self._acct[key] = row
+        return row
+
+    def record_hit(self, tenant: str | None, reused_tokens: int) -> None:
+        """One admission reused `reused_tokens` of cached prefix KV.
+        Called by the engine AFTER it committed to the continuation
+        dispatch — a match the engine could not use is a miss."""
+        with self._lock:
+            row = self._row(tenant)
+            row["hits"] += 1
+            row["reused_tokens"] += reused_tokens
+
+    def record_miss(self, tenant: str | None) -> None:
+        with self._lock:
+            self._row(tenant)["misses"] += 1
+
+    @property
+    def n_blocks(self) -> int:
+        with self._lock:
+            return self._n_blocks
+
+    def stats(self) -> dict[str, Any]:
+        """The committed-record shape: global counters + per-tenant
+        rows. hit_rate is over recorded hits+misses (admissions the
+        engine considered), not raw match calls."""
+        with self._lock:
+            hits = sum(r["hits"] for r in self._acct.values())
+            misses = sum(r["misses"] for r in self._acct.values())
+            reused = sum(r["reused_tokens"] for r in self._acct.values())
+            return {
+                "block_tokens": self.block_tokens,
+                "capacity_blocks": self.capacity_blocks,
+                "blocks": self._n_blocks,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (round(hits / (hits + misses), 4)
+                             if hits + misses else None),
+                "reused_tokens": reused,
+                "inserted_blocks": self._inserted_blocks,
+                "evicted_blocks": self._evicted_blocks,
+                "per_tenant": {k: dict(v)
+                               for k, v in sorted(self._acct.items())},
+            }
+
+    def clear(self) -> None:
+        """Drop every unpinned block (close()/reset path). Pinned blocks
+        survive — their chains re-root under a fresh tree is NOT
+        attempted; callers must have released all matches first."""
+        with self._lock:
+            pinned = sum(self._pinned_count(r)
+                         for r in self._roots.values())
+            if pinned:
+                raise RuntimeError(
+                    f"clear() with {pinned} pinned blocks outstanding")
+            self._roots = {}
+            self._n_blocks = 0
+
+    def _pinned_count(self, node: _Node) -> int:
+        n = (1 if node.block is not None and node.block.refs > 0 else 0)
+        return n + sum(self._pinned_count(c)
+                       for c in node.children.values())
+
+    # -- invariants (property tests drive this after every op) ---------------
+
+    def check_invariants(self) -> None:
+        with self._lock:
+            count = 0
+            roots = set(map(id, self._roots.values()))
+            stack = list(self._roots.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if id(n) in roots:
+                    assert n.block is None
+                    continue
+                count += 1
+                assert n.block is not None and n.block.refs >= 0
+                assert n.block.payload is not None, \
+                    "evicted block still reachable"
+                assert len(n.key) == self.block_tokens
+                assert n.parent.children[n.key] is n
+            assert count == self._n_blocks, (count, self._n_blocks)
+            assert count <= self.capacity_blocks

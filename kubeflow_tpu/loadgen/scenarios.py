@@ -101,7 +101,16 @@ def miniature(scenario: Scenario, *, vocab: int, max_prompt_len: int,
     period scaled with the window so the trace still sees full cycles.
     Used by the fast lane and the CPU bench path."""
     t = scenario.trace
-    orig_max = max(hi for _, hi, _ in t.prompt_len_mix)
+    if t.n_templates:
+        # shared_prefix family: the effective longest prompt is the
+        # longest template plus every turn's user chunk — that is what
+        # must fit max_prompt_len, and template/turn lengths scale
+        # together so the share-vs-fresh ratio (what the cache-hit
+        # numbers mean) survives the shrink
+        orig_max = (t.template_len[1]
+                    + t.turns[1] * t.turn_user_len[1])
+    else:
+        orig_max = max(hi for _, hi, _ in t.prompt_len_mix)
     scale = max_prompt_len / orig_max
     mix = tuple((max(1, int(lo * scale)),
                  max(1, int(hi * scale)), w)
@@ -120,6 +129,16 @@ def miniature(scenario: Scenario, *, vocab: int, max_prompt_len: int,
                         max(t.cancel_after_s[0] * dur_scale,
                             t.cancel_after_s[1] * dur_scale)),
     )
+    if t.n_templates:
+        mini = mini.replace(
+            template_len=(max(1, int(t.template_len[0] * scale)),
+                          max(1, int(t.template_len[1] * scale))),
+            turn_user_len=(max(1, int(t.turn_user_len[0] * scale)),
+                           max(1, int(t.turn_user_len[1] * scale))),
+            turn_gap_s=(t.turn_gap_s[0] * dur_scale,
+                        max(t.turn_gap_s[0] * dur_scale,
+                            t.turn_gap_s[1] * dur_scale)),
+        )
     return scenario.replace(trace=mini,
                             control_interval_s=max(
                                 0.5, scenario.control_interval_s

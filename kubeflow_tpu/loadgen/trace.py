@@ -85,7 +85,14 @@ class _SplitMix:
 
 @dataclasses.dataclass(frozen=True)
 class TraceRequest:
-    """One replayable client request."""
+    """One replayable client request. `session` is the stable affinity
+    key of the multi-turn conversation this request belongs to (the
+    shared_prefix family sets it; None elsewhere). The in-process
+    scenario runner drives a single engine, where reuse is purely
+    content-based — session rides the trace for HTTP-fleet replays
+    (set it as the request's `session` body field / X-Session-Key so
+    the router's rendezvous affinity sees the grouping; the affinity
+    path itself is exercised by tests/test_router_health.py)."""
     index: int
     arrival_s: float            # offset from trace start
     tenant: str
@@ -93,18 +100,24 @@ class TraceRequest:
     prompt: tuple[int, ...]
     max_new_tokens: int
     cancel_after_s: float | None  # client disconnect delay; None = stays
+    session: str | None = None
 
     def to_json(self) -> dict[str, Any]:
-        return {"i": self.index, "t": self.arrival_s, "tenant": self.tenant,
-                "adapter": self.adapter, "prompt": list(self.prompt),
-                "max_new": self.max_new_tokens,
-                "cancel_after": self.cancel_after_s}
+        d = {"i": self.index, "t": self.arrival_s, "tenant": self.tenant,
+             "adapter": self.adapter, "prompt": list(self.prompt),
+             "max_new": self.max_new_tokens,
+             "cancel_after": self.cancel_after_s}
+        if self.session is not None:
+            # emitted only when set: traces predating the shared_prefix
+            # family keep their committed byte-identity (sha256 pins)
+            d["session"] = self.session
+        return d
 
     @staticmethod
     def from_json(d: dict[str, Any]) -> "TraceRequest":
         return TraceRequest(d["i"], d["t"], d["tenant"], d["adapter"],
                             tuple(d["prompt"]), d["max_new"],
-                            d["cancel_after"])
+                            d["cancel_after"], d.get("session"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +145,29 @@ class TraceConfig:
     cancel_after_s: tuple[float, float] = (0.2, 2.0)
     ttft_slo_ms: float = 2000.0      # SLO targets the accounting applies
     tpot_slo_ms: float = 500.0
+    # -- shared_prefix / multi-turn chat family (the kvcache tentpole's
+    # honest workload): n_templates > 0 switches arrivals to SESSIONS —
+    # each arrival picks a conversation template (Zipf-skewed over
+    # n_templates pre-drawn token sequences of template_len tokens: the
+    # system-prompt / few-shot preamble every turn shares), then runs
+    # `turns` chat turns. Turn k's prompt is template ++ the
+    # accumulated per-turn context (turn_user_len tokens each — the
+    # client resending its conversation history), so every later turn
+    # extends an earlier prompt exactly the way a radix prefix cache
+    # can reuse; turns within a session are spaced by turn_gap_s.
+    # Requests carry session="s<arrival_index>" for affinity routing.
+    n_templates: int = 0
+    template_len: tuple[int, int] = (32, 96)
+    template_skew: float = 1.1
+    turns: tuple[int, int] = (1, 1)
+    turn_user_len: tuple[int, int] = (8, 32)
+    turn_gap_s: tuple[float, float] = (0.5, 2.0)
+
+    #: shared_prefix-family fields, emitted in to_json only when the
+    #: family is enabled: configs (and thus traces) predating it keep
+    #: their committed byte-identity / sha256 pins
+    _FAMILY_FIELDS = ("n_templates", "template_len", "template_skew",
+                      "turns", "turn_user_len", "turn_gap_s")
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -139,6 +175,14 @@ class TraceConfig:
         d["prompt_len_mix"] = [list(m) for m in self.prompt_len_mix]
         d["output_len"] = list(self.output_len)
         d["cancel_after_s"] = list(self.cancel_after_s)
+        if self.n_templates > 0:
+            d["template_len"] = list(self.template_len)
+            d["turns"] = list(self.turns)
+            d["turn_user_len"] = list(self.turn_user_len)
+            d["turn_gap_s"] = list(self.turn_gap_s)
+        else:
+            for k in self._FAMILY_FIELDS:
+                d.pop(k, None)
         return d
 
     @staticmethod
@@ -149,6 +193,9 @@ class TraceConfig:
             tuple(m) for m in kw["prompt_len_mix"])
         kw["output_len"] = tuple(kw["output_len"])
         kw["cancel_after_s"] = tuple(kw["cancel_after_s"])
+        for k in ("template_len", "turns", "turn_user_len", "turn_gap_s"):
+            if k in kw:
+                kw[k] = tuple(kw[k])
         return TraceConfig(**kw)
 
     def replace(self, **kw) -> "TraceConfig":
@@ -218,6 +265,15 @@ def generate_trace(cfg: TraceConfig) -> Trace:
     for lo, hi, w in cfg.prompt_len_mix:
         if not (1 <= lo <= hi) or w < 0:
             raise ValueError(f"bad prompt_len_mix entry {(lo, hi, w)}")
+    if cfg.n_templates < 0:
+        raise ValueError("n_templates must be >= 0")
+    if cfg.n_templates:
+        for name in ("template_len", "turns", "turn_user_len"):
+            lo, hi = getattr(cfg, name)
+            if not 1 <= lo <= hi:
+                raise ValueError(f"bad {name} range {(lo, hi)}")
+        if not 0 <= cfg.turn_gap_s[0] <= cfg.turn_gap_s[1]:
+            raise ValueError(f"bad turn_gap_s range {cfg.turn_gap_s}")
     rng = _SplitMix(cfg.seed)
 
     # -- arrivals: Lewis-Shedler thinning against the peak rate
@@ -247,6 +303,10 @@ def generate_trace(cfg: TraceConfig) -> Trace:
                    if cfg.adapters else None)
     mix_cum = _cum_weights([w for _, _, w in cfg.prompt_len_mix])
 
+    if cfg.n_templates:
+        return Trace(cfg, _shared_prefix_requests(
+            cfg, rng, arrivals, tenant_cum, adapter_cum))
+
     requests = []
     for i, at in enumerate(arrivals):
         tenant = f"t{rng.choice(tenant_cum)}"
@@ -273,6 +333,63 @@ def generate_trace(cfg: TraceConfig) -> Trace:
         requests.append(TraceRequest(i, _round6(at), tenant, adapter,
                                      prompt, max_new, cancel))
     return Trace(cfg, tuple(requests))
+
+
+def _shared_prefix_requests(cfg: TraceConfig, rng: _SplitMix,
+                            arrivals: list[float], tenant_cum,
+                            adapter_cum) -> tuple[TraceRequest, ...]:
+    """The shared_prefix / multi-turn chat family. Draw order (part of
+    the byte-identity format — never reorder without bumping the trace
+    version): first the n_templates template token sequences, then per
+    SESSION (one per Poisson arrival) tenant → adapter pair → template →
+    n_turns → per turn (user tokens, max_new, cancel pair, gap). Turn
+    k's prompt is the template plus all k user chunks so far, so within
+    a session every later prompt is a strict extension of the previous
+    one — the property a radix prefix cache reuses and the
+    session-affinity router preserves across replicas. Requests are
+    globally re-sorted by arrival (sessions interleave) and re-indexed;
+    ties keep session order, so arrivals stay sorted and deterministic."""
+    templates: list[tuple[int, ...]] = []
+    for _ in range(cfg.n_templates):
+        tlen = rng.integers(cfg.template_len[0], cfg.template_len[1] + 1)
+        templates.append(tuple(rng.integers(1, cfg.vocab)
+                               for _ in range(tlen)))
+    template_cum = _zipf_cum(cfg.n_templates, cfg.template_skew)
+    rows: list[tuple] = []   # (arrival, order, ...request fields)
+    order = 0
+    for s_idx, at in enumerate(arrivals):
+        tenant = f"t{rng.choice(tenant_cum)}"
+        adapter = None
+        if cfg.adapters:
+            # same stream-alignment rule as the base family: both draws
+            # always happen, whatever the outcome
+            use_adapter = rng.random() >= cfg.adapter_none_frac
+            a_idx = rng.choice(adapter_cum)
+            if use_adapter:
+                adapter = cfg.adapters[a_idx]
+        ctx = list(templates[rng.choice(template_cum)])
+        n_turns = rng.integers(cfg.turns[0], cfg.turns[1] + 1)
+        t_turn = at
+        for _ in range(n_turns):
+            ulen = rng.integers(cfg.turn_user_len[0],
+                                cfg.turn_user_len[1] + 1)
+            ctx.extend(rng.integers(1, cfg.vocab) for _ in range(ulen))
+            prompt = tuple(ctx)
+            max_new = rng.integers(cfg.output_len[0],
+                                   cfg.output_len[1] + 1)
+            will_cancel = rng.random() < cfg.cancel_frac
+            c_delay = rng.uniform(*cfg.cancel_after_s)
+            cancel = _round6(c_delay) if will_cancel else None
+            rows.append((_round6(t_turn), order, tenant, adapter, prompt,
+                         max_new, cancel, f"s{s_idx}"))
+            order += 1
+            t_turn += rng.uniform(*cfg.turn_gap_s)
+    rows.sort(key=lambda r: (r[0], r[1]))
+    return tuple(
+        TraceRequest(i, at, tenant, adapter, prompt, max_new, cancel,
+                     session)
+        for i, (at, _o, tenant, adapter, prompt, max_new, cancel,
+                session) in enumerate(rows))
 
 
 def trace_bytes(trace: Trace) -> bytes:

@@ -663,12 +663,33 @@ class EngineSupervisor:
             return list(e.chain) if e else []
 
     def request_timing(self, rid: int) -> dict[str, Any]:
+        cached = self.cached_tokens(rid)
         with self._lock:
             e = self._journal[rid]
             return {"submit_s": e.submit_s,
                     "first_token_s": e.first_token_s,
                     "finish_s": e.finish_s, "tenant": e.tenant,
-                    "n_tokens": len(e.base_tokens) + len(e.tokens)}
+                    "n_tokens": len(e.base_tokens) + len(e.tokens),
+                    "prompt_len": len(e.prompt),
+                    "cached_prefix_len": cached,
+                    "prefill_tokens": len(e.prompt) - cached}
+
+    def cached_tokens(self, rid: int) -> int:
+        """Prefix-KV tokens the CURRENT engine reused for this request.
+        Conservative across restarts: a replayed request re-prefills on
+        the fresh engine (whose cache starts cold), so the journal never
+        fabricates reuse the replacement engine didn't do."""
+        with self._lock:
+            e = self._journal.get(rid)
+            erid = e.engine_rid if e is not None else None
+            eng = self.engine
+        if eng is None or erid is None:
+            return 0
+        fn = getattr(eng, "cached_tokens", None)
+        try:
+            return int(fn(erid)) if fn is not None else 0
+        except Exception:   # engine swapped/released under us: 0, not 500
+            return 0
 
     def release(self, rid: int) -> None:
         with self._lock:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import math
 import threading
 import time
 from typing import Any, Sequence
@@ -29,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.kvcache import RadixKVCache
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.serving.scheduler import (DecodeAction, PrefillAction,
                                             PromptTooLong, make_scheduler)
@@ -163,6 +165,7 @@ class LLMEngine:
                  prefer_native: bool = True, decode_chunk: int = 8,
                  mesh=None, sample_seed: int = 0,
                  prefix_cache: bool = False, max_prefixes: int = 4,
+                 prefix_cache_blocks: int | None = None,
                  quantize: str | None = None,
                  warm_cont_pairs: int | None = 4,
                  kv_quantize: str | None = None,
@@ -360,27 +363,52 @@ class LLMEngine:
         self._submit_lock = threading.Lock()
         self._prefill_fns: dict[tuple[int, int], Any] = {}
         self._decode_fns: dict[int, Any] = {}
-        # -- prefix KV cache (vLLM-style shared-prompt reuse, TPU-shaped):
-        # device-resident KV for bucket-length prompt PREFIXES, keyed by the
-        # exact token tuple; a hit skips the prefix's prefill compute and
-        # runs a continuation program over the tail only. Bucket granularity
-        # keeps every program shape static (the TPU constraint everything
-        # here bends around).
+        # -- prefix KV reuse (the kvcache tentpole, vLLM/SGLang-style and
+        # TPU-shaped): a radix/block-trie index (kvcache.RadixKVCache)
+        # over token sequences maps to ref-counted device KV blocks of
+        # `prefix_block_tokens` tokens each (gcd of the buckets, so every
+        # bucket is a whole number of blocks). On admission the engine
+        # takes the LONGEST cached block-aligned prefix, skips its
+        # prefill compute, and runs a continuation program over the tail
+        # only; after any prefill the prompt's aligned prefix is banked
+        # block-by-block (deduplicated — a multi-turn session stores only
+        # each turn's new suffix blocks). Blocks stay quantized when the
+        # cache is int8 (half the residency); LRU eviction never reclaims
+        # a block pinned by an in-flight admission.
         self.prefix_cache_enabled = prefix_cache
         self.max_prefixes = max_prefixes
-        # COLD-START COST: with prefix_cache on, the continuation menu is
-        # |buckets|² × log2(n_slots) full-model programs — quadratic in
-        # buckets. warmup() therefore pre-compiles only the first
-        # `warm_cont_pairs` (prefix, tail) pairs (None = all); colder pairs
-        # compile lazily on their first hit (that one wave pays ~seconds of
-        # XLA compile, subsequent hits are warm).
+        # COLD-START COST: the continuation-program menu is (block-
+        # multiple prefix) × (tail bucket) × log2(n_slots) full-model
+        # programs. warmup() pre-compiles only the first `warm_cont_pairs`
+        # (prefix, tail) pairs (None = all); colder pairs compile lazily
+        # on their first hit (that one wave pays ~seconds of XLA compile,
+        # subsequent hits are warm).
         self.warm_cont_pairs = warm_cont_pairs
-        self._prefix_store: "collections.OrderedDict[tuple, dict]" = \
-            collections.OrderedDict()
+        self.prefix_block_tokens = 0
+        self.kvcache: RadixKVCache | None = None
+        if prefix_cache:
+            bt = math.gcd(*self.buckets)
+            self.prefix_block_tokens = bt
+            if prefix_cache_blocks is None:
+                # legacy sizing: max_prefixes was "whole largest-bucket
+                # prefixes"; the block pool holds the same token volume
+                prefix_cache_blocks = max(1, max_prefixes) \
+                    * (self.buckets[-1] // bt)
+            self.kvcache = RadixKVCache(bt, prefix_cache_blocks)
         self._prefix_hits = 0
         self._prefix_misses = 0
+        # rid -> reused prefix length, set at prefill dispatch (the
+        # cached_tokens / request_timing surface); rid -> prompt length
+        # survives the prompt pop at finish for the same surface
+        self._cached_prefix: dict[int, int] = {}
+        self._req_plen: dict[int, int] = {}
+        # prefill-compute accounting (tracked with or without the cache:
+        # the cold bench run needs the denominator too)
+        self._prefill_computed_tokens = 0
+        self._prefill_reused_tokens = 0
         self._cont_fns: dict[tuple[int, int], Any] = {}
         self._extract_fns: dict[int, Any] = {}
+        self._extract_raw_fns: dict[int, Any] = {}
 
     def _samp_reset(self) -> np.ndarray:
         """Idle per-slot sampling state: all-zero except the seed column's
@@ -834,6 +862,21 @@ class LLMEngine:
             v = llama.dequantize_kv(v, vsc, self.cfg.dtype)
         return k, v
 
+    def _extract_prefix_raw(self, cache, slot, *, p: int):
+        """Raw-layout twin of _extract_prefix for the radix block store:
+        returns the slot's first `p` KV rows WITHOUT dequantizing —
+        (k, v) in cache dtype, or (kq, k_scale, vq, v_scale) when the
+        cache is int8 — so stored blocks keep the int8 residency win.
+        All arrays are [L, 1, p, ...]; the block insert slices them
+        along the token axis (axis 2)."""
+        def take(name):
+            return jax.lax.dynamic_index_in_dim(
+                cache[name], slot, axis=1, keepdims=False)[:, :p][:, None]
+
+        if self.kv_quantize == "int8":
+            return take("k"), take("k_s"), take("v"), take("v_s")
+        return take("k"), take("v")
+
     def _decode(self, params, cache, lengths, last_tokens, samp, key,
                 active, lora=None, *, steps: int, span: int | None = None,
                 sample: bool = True):
@@ -1039,32 +1082,67 @@ class LLMEngine:
                 functools.partial(self._extract_prefix, p=p))
         return self._extract_fns[p]
 
-    def _prefix_len_for(self, prompt_len: int) -> int | None:
-        """Largest bucket STRICTLY shorter than the prompt (>=1 tail token
-        must remain to produce the next-token logits)."""
-        cands = [b for b in self.buckets if b < prompt_len]
-        return max(cands) if cands else None
+    def _extract_raw_fn(self, p: int):
+        if p not in self._extract_raw_fns:
+            self._extract_raw_fns[p] = jax.jit(
+                functools.partial(self._extract_prefix_raw, p=p))
+        return self._extract_raw_fns[p]
 
     def _tail_bucket(self, tail_len: int) -> int | None:
         cands = [b for b in self.buckets if b >= tail_len]
         return min(cands) if cands else None
 
     def _prefix_lookup(self, action):
-        """(key, p, t, entry) when the action's prompt hits the prefix
-        store and the tail fits a bucket within the cache; else None."""
+        """(match, p, t) when the prompt's longest cached block chain
+        yields a legal continuation dispatch (>= 1 tail token must
+        remain to produce next-token logits, and the tail must fit a
+        bucket inside max_len — shrinking the reused prefix block by
+        block when the full match would overflow the cache). None on a
+        miss. The returned match is PINNED: eviction cannot reclaim its
+        blocks until the caller releases it after the dispatch."""
         prompt = self._prompts[action.req_id]
-        p = self._prefix_len_for(len(prompt))
-        if p is None:
+        bt = self.prefix_block_tokens
+        if len(prompt) - 1 < bt:
+            return None   # too short to carry even one block: not an
+            # eligible admission, so neither a hit nor a miss
+        tenant = self._req_tenant.get(action.req_id)
+        m = self.kvcache.match(prompt, max_tokens=len(prompt) - 1,
+                               namespace=self._req_aids.get(
+                                   action.req_id, 0))
+        p = m.tokens
+        t = None
+        while p > 0:
+            t = self._tail_bucket(len(prompt) - p)
+            if t is None:   # tail over the largest bucket: shrinking p
+                p = 0       # only grows it — the chunked path owns this
+                break
+            if p + t <= self.max_len:
+                break
+            p -= bt
+        if p <= 0:
+            self.kvcache.release(m)
+            self.kvcache.record_miss(tenant)
+            self._prefix_misses += 1
             return None
-        key = self._prefix_key(action.req_id, prompt[:p])
-        entry = self._prefix_store.get(key)
-        if entry is None:
-            return None
-        t = self._tail_bucket(len(prompt) - p)
-        if t is None or p + t > self.max_len:
-            return None
-        self._prefix_store.move_to_end(key)  # LRU touch
-        return key, p, t, entry
+        return m, p, t
+
+    def _materialize_prefix(self, payloads: list):
+        """Matched block chain → the continuation program's (k, v)
+        prefix arrays [L, 1, P, kv, hd] in model dtype: concatenate
+        along the token axis, dequantizing int8 blocks at the last
+        moment (the store keeps them int8 — half the residency).
+        Device-to-device only; nothing crosses the host."""
+        if self.kv_quantize == "int8":
+            kq = jnp.concatenate([b[0] for b in payloads], axis=2)
+            ks = jnp.concatenate([b[1] for b in payloads], axis=2)
+            vq = jnp.concatenate([b[2] for b in payloads], axis=2)
+            vs = jnp.concatenate([b[3] for b in payloads], axis=2)
+            return (llama.dequantize_kv(kq, ks, self.cfg.dtype),
+                    llama.dequantize_kv(vq, vs, self.cfg.dtype))
+        if len(payloads) == 1:
+            return payloads[0]
+        return (jnp.concatenate([b[0] for b in payloads], axis=2),
+                jnp.concatenate([b[1] for b in payloads], axis=2))
 
     def _decode_fn(self, steps: int, span: int | None = None):
         """One compiled program per (chunk length, attention span) pair —
@@ -1248,6 +1326,7 @@ class LLMEngine:
                 self._deadlines[req_id] = time.monotonic() + deadline_s
             if aid:
                 self._req_aids[req_id] = aid
+            self._req_plen[req_id] = len(prompt)
             self._submit_t[req_id] = time.monotonic()
         return req_id
 
@@ -1359,21 +1438,26 @@ class LLMEngine:
         for a in actions:  # one-pass, identity-safe partition
             (chunked if len(self._prompts.get(a.req_id, ())) > a.bucket_len
              else short).append(a)
-        cont: list[tuple[PrefillAction, tuple]] = []
+        cont: list[tuple] = []   # (action, match, p, t)
         normal: list[PrefillAction] = []
         if self.prefix_cache_enabled:
             for a in short:
                 hit = self._prefix_lookup(a)
-                (cont.append((a, hit)) if hit is not None
+                (cont.append((a,) + hit) if hit is not None
                  else normal.append(a))
         else:
             normal = short
         groups: dict[int, list[PrefillAction]] = {}
         for a in normal:
             groups.setdefault(a.bucket_len, []).append(a)
+        bt = self.prefix_block_tokens
         cont_groups: dict[tuple[int, int], list] = {}
-        for a, (_key, p, t, entry) in cont:
-            cont_groups.setdefault((p, t), []).append((a, entry))
+        for a, m, p, t in cont:
+            # materialize the pinned chain into the program's prefix
+            # arrays (truncated to p when the legality clamp shortened
+            # the match); the pin holds until after the dispatch below
+            cont_groups.setdefault((p, t), []).append(
+                (a, self._materialize_prefix(m.payloads[:p // bt])))
         dispatched = [(wave, self._dispatch_prefill_wave(bucket, wave))
                       for bucket, wave in groups.items()]
         dispatched += [([a for a, _ in pairs],
@@ -1381,16 +1465,27 @@ class LLMEngine:
                        for (p, t), pairs in cont_groups.items()]
         dispatched += [([a], self._dispatch_chunked_prefill(a))
                        for a in chunked]
-        self._prefix_hits += len(cont)
+        # hit bookkeeping + unpin AFTER every dispatch went out: the
+        # committed accounting records only reuse that actually rode a
+        # continuation program
+        for a, m, p, t in cont:
+            self._prefix_hits += 1
+            self._cached_prefix[a.req_id] = p
+            self.kvcache.record_hit(self._req_tenant.get(a.req_id), p)
+            self._prefill_reused_tokens += p
+            self._prefill_computed_tokens += \
+                len(self._prompts[a.req_id]) - p
+            self.kvcache.release(m)
         if self.prefix_cache_enabled:
-            # store fresh prefixes BEFORE the fetch loop: recording a
+            # bank fresh prefix blocks BEFORE the fetch loop: recording a
             # request's final token pops its prompt, and extraction only
             # needs the (device-ordered) prefill to have been dispatched.
-            # (Chunked requests banked theirs inside the chain, reusing
-            # the boundary-1 extract.)
-            for wave, _ in dispatched[:len(groups)]:
+            # Continuation hits bank too — a multi-turn session's new
+            # suffix blocks extend the cached chain (dedup skips the
+            # already-cached prefix).
+            for wave, _ in dispatched:
                 for a in wave:
-                    self._maybe_store_prefix(a)
+                    self._bank_prefix_blocks(a)
         for wave, out in dispatched:
             out_np = np.asarray(out)   # one fetch per wave [W, out_cols]
             for i, a in enumerate(wave):
@@ -1406,31 +1501,71 @@ class LLMEngine:
                                    first_token=True)
         return True
 
+    def _chunk_plan_from(self, n: int, start: int
+                         ) -> list[tuple[int, int]] | None:
+        """Chunk schedule for the UNCOVERED tokens [start, n) of a long
+        prompt: [(chunk_len, program_len), ...] — full largest-bucket
+        chunks, then a tail rounded up to a bucket. None when some
+        boundary's continuation (p = tokens done so far) cannot fit
+        inside max_len."""
+        big = self.buckets[-1]
+        plan = []
+        done = start
+        while n - done > big:
+            if done + big > self.max_len:
+                return None
+            plan.append((big, big))
+            done += big
+        t = self._tail_bucket(n - done)
+        if t is None or done + t > self.max_len:
+            return None
+        plan.append((n - done, t))
+        return plan
+
     def _dispatch_chunked_prefill(self, action) -> Any:
         """Chained prefill for a prompt longer than the largest bucket:
-        the first chunk runs the ordinary bucket prefill, then each further
-        chunk extracts the accumulated slot KV and runs a continuation
-        program against it (the prefix-cache machinery, aimed at the
-        slot's own rows). One request = len(plan) dispatches; the chain's
-        programs ((extract p, cont (p, t, 1)) per boundary) compile lazily
-        on the first long prompt — a cold start the docstring of warmup()
-        points at. Returns the next-token device array [1]."""
+        the first chunk runs the ordinary bucket prefill, then each
+        further chunk extracts the accumulated slot KV and runs a
+        continuation program against it (the prefix-reuse machinery,
+        aimed at the slot's own rows). Radix composition: when the
+        prompt's leading blocks are cached (the shared-system-prompt
+        case) the chain STARTS at the longest reusable prefix instead of
+        token 0 — possibly replacing the full first prefill and several
+        chain links at once. One request = len(plan)+1 dispatches; the
+        chain's programs compile lazily on the first long prompt — a
+        cold start the docstring of warmup() points at. Returns the
+        next-token device array [1]."""
         prompt = self._prompts[action.req_id]
-        plan = self._chunk_plan(len(prompt))
+        n = len(prompt)
         slot = action.slot
         tail = self._row_tail(action.req_id)
         big = self.buckets[-1]
-        # prefix-cache composition: a banked largest-bucket prefix (the
-        # shared-system-prompt case) replaces the first full prefill — the
-        # chain starts at the first continuation instead
-        big_key = self._prefix_key(action.req_id, prompt[:big])
-        hit = None
-        if self.prefix_cache_enabled:
-            hit = self._prefix_store.get(big_key)
-            if hit is not None:
-                self._prefix_store.move_to_end(big_key)
+        bt = self.prefix_block_tokens
+        tenant = self._req_tenant.get(action.req_id)
+        done = 0
+        pending = None
+        if self.prefix_cache_enabled and n - 1 >= bt:
+            m = self.kvcache.match(
+                prompt, max_tokens=n - 1,
+                namespace=self._req_aids.get(action.req_id, 0))
+            done = m.tokens
+            # shrink the reused prefix until the remaining chain is
+            # schedulable (every boundary fits inside max_len)
+            while done > 0 and self._chunk_plan_from(n, done) is None:
+                done -= bt
+            if done > 0:
+                pending = self._materialize_prefix(
+                    m.payloads[:done // bt])
                 self._prefix_hits += 1
-        if hit is None:
+                self._cached_prefix[action.req_id] = done
+                self.kvcache.record_hit(tenant, done)
+                self._prefill_reused_tokens += done
+            else:
+                self.kvcache.record_miss(tenant)
+                self._prefix_misses += 1
+            self.kvcache.release(m)
+        self._prefill_computed_tokens += n - done
+        if done == 0:
             packed = self._pack_rows(1, big,
                                      [(prompt[:big], slot, big) + tail])
             (self.cache, self.lengths, self.last_tokens, self.samp,
@@ -1438,15 +1573,11 @@ class LLMEngine:
                 self.params, self.cache, self.lengths, self.last_tokens,
                 self.samp, self.rng_key, self._put(packed),
                 *self._extra())
-        done = big
-        pending = None if hit is None else (hit["k"], hit["v"])
-        for chunk_len, t in plan[1:]:
+            done = big
+        plan = self._chunk_plan_from(n, done) or []
+        for chunk_len, t in plan:
             ek, ev = (pending if pending is not None
                       else self._extract_fn(done)(self.cache, slot))
-            if (done == big and hit is None and self.prefix_cache_enabled):
-                # bank the largest-bucket prefix from the boundary-1
-                # extract we just ran — no second extract dispatch
-                self._store_prefix_entry(big_key, ek, ev)
             pending = None
             # the chain boundary is a continuation with the request's OWN
             # prefix (p == done), so the row layout comes from the same
@@ -1498,19 +1629,25 @@ class LLMEngine:
                     break
                 width *= 2
         if self.prefix_cache_enabled:
-            # continuation menu: (prefix bucket, tail bucket, width) pairs,
-            # plus the per-prefix extract programs. buckets[-1] is excluded
-            # as a prefix HERE because short-prompt hits can't reach it
-            # (_prefix_len_for needs p < prompt_len <= largest bucket);
-            # chunked-prefill requests DO compile (p=buckets[-1], t, 1)
-            # continuation programs — lazily, like the rest of the chain.
-            # Only the first `warm_cont_pairs` pairs are pre-compiled (the
-            # menu grows quadratically in buckets — see __init__); colder
-            # pairs compile lazily on first hit.
-            pairs = [(p, t) for p in self.buckets[:-1] for t in self.buckets
-                     if p + t <= self.max_len]
+            # continuation menu: (block-multiple prefix, tail bucket,
+            # width) combos, plus the per-prefix extract programs. Radix
+            # hits reuse ANY block multiple up to the largest bucket
+            # (longer reused prefixes belong to the chunked chain and
+            # compile lazily like the rest of it). Only the first
+            # `warm_cont_pairs` pairs are pre-compiled (the menu grows
+            # with buckets[-1]/block — see __init__); colder pairs
+            # compile lazily on their first hit.
+            bt = self.prefix_block_tokens
+            pairs = [(p, t) for p in range(bt, self.buckets[-1] + 1, bt)
+                     for t in self.buckets if p + t <= self.max_len]
             if self.warm_cont_pairs is not None:
                 pairs = pairs[:self.warm_cont_pairs]
+            # the banking path's raw-extract programs are cheap slice
+            # jits, but a cold one still stalls the engine thread
+            # mid-replay — warm every block multiple the banker can ask
+            # for (aligned prompt prefixes up to max_len)
+            for p in range(bt, self.max_len, bt):
+                self._extract_raw_fn(p)(self.cache, 0)
             extracts = {}
             for p, t in pairs:
                 if p not in extracts:
@@ -1599,9 +1736,10 @@ class LLMEngine:
         import gc
 
         for d in (self._prefill_fns, self._decode_fns, self._spec_fns,
-                  self._cont_fns, self._extract_fns):
+                  self._cont_fns, self._extract_fns,
+                  self._extract_raw_fns):
             d.clear()
-        self._prefix_store.clear()
+        self.kvcache = None   # block payloads hold the only device refs
         self._pending = None
         self._active_dev = None
         self._active_host = None
@@ -1660,6 +1798,8 @@ class LLMEngine:
         self._finish_t.pop(req_id, None)
         self._finish_reasons.pop(req_id, None)
         self._req_tenant.pop(req_id, None)
+        self._cached_prefix.pop(req_id, None)
+        self._req_plen.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
@@ -1681,15 +1821,30 @@ class LLMEngine:
     def request_timing(self, req_id: int) -> dict[str, Any]:
         """Wall-clock record for one request (the loadgen runner's SLO
         input): submit / first-token / finish instants (time.monotonic;
-        None until they happen), tenant, and tokens delivered so far.
-        Read BEFORE release() — release drops all of it."""
+        None until they happen), tenant, tokens delivered so far, and
+        the prefix-reuse fields — prompt_len, cached_prefix_len (KV
+        tokens reused from the radix cache; 0 until the prefill lands or
+        with the cache off) and prefill_tokens (what was actually
+        computed). Read BEFORE release() — release drops all of it."""
+        plen = self._req_plen.get(req_id)
+        cached = self._cached_prefix.get(req_id, 0)
         return {
             "submit_s": self._submit_t.get(req_id),
             "first_token_s": self._first_token_t.get(req_id),
             "finish_s": self._finish_t.get(req_id),
             "tenant": self._req_tenant.get(req_id),
             "n_tokens": len(self._results.get(req_id, ())),
+            "prompt_len": plen,
+            "cached_prefix_len": cached,
+            "prefill_tokens": (plen - cached if plen is not None
+                               else None),
         }
+
+    def cached_tokens(self, req_id: int) -> int:
+        """Prompt tokens whose KV was reused from the prefix cache for
+        this request (the OpenAI usage `cached_tokens` surface). 0 until
+        the prefill lands, with the cache off, or on a miss."""
+        return self._cached_prefix.get(req_id, 0)
 
     def set_tenant_limits(self, max_active_per_tenant: int = 0,
                           max_queued_per_tenant: int = 0) -> None:
@@ -1730,10 +1885,22 @@ class LLMEngine:
                "completed": s.completed, "rejected": s.rejected,
                "cancelled": self._cancelled_count,
                "decode_chunk": self.decode_chunk}
-        if self.prefix_cache_enabled:
+        out["prefill_tokens_computed"] = self._prefill_computed_tokens
+        if self.prefix_cache_enabled and self.kvcache is not None:
+            st = self.kvcache.stats()
             out["prefix_hits"] = self._prefix_hits
             out["prefix_misses"] = self._prefix_misses
-            out["prefix_entries"] = len(self._prefix_store)
+            out["prefix_entries"] = st["blocks"]
+            looked = self._prefix_hits + self._prefix_misses
+            out["prefix_cache"] = {
+                **st,
+                "request_hits": self._prefix_hits,
+                "request_misses": self._prefix_misses,
+                "request_hit_rate": (round(self._prefix_hits / looked, 4)
+                                     if looked else None),
+                "prefill_tokens_computed": self._prefill_computed_tokens,
+                "prefill_tokens_saved": self._prefill_reused_tokens,
+            }
         if self.adapters is not None:
             out["adapters_loaded"] = sorted(self._adapter_idx)
         if self._tenant_idx:
@@ -1763,14 +1930,6 @@ class LLMEngine:
         """Trailing program args: the adapter stack rides as an explicit
         argument (a closure would inline it into the HLO as constants)."""
         return () if self.adapters is None else (self.adapters,)
-
-    def _prefix_key(self, req_id: int, toks) -> tuple:
-        """Prefix-store key. Under multi-adapter serving the adapter id is
-        part of the key: a prefix prefilled through adapter X is WRONG KV
-        for adapter Y even at identical tokens."""
-        if self.adapters is None:
-            return tuple(toks)
-        return (self._req_aids.get(req_id, 0),) + tuple(toks)
 
     @staticmethod
     def _pack_temp(temp: float) -> int:
@@ -1839,9 +1998,10 @@ class LLMEngine:
 
     def _dispatch_prefill_cont_wave(self, p: int, t: int, pairs):
         """Dispatch ONE batched continuation prefill for all hits sharing
-        (prefix bucket, tail bucket) — a shared-prefix burst costs one
+        (prefix length, tail bucket) — a shared-prefix burst costs one
         packed transfer + one dispatch, mirroring _dispatch_prefill_wave.
-        pairs: list of (action, store entry); returns [W] device tokens."""
+        pairs: list of (action, materialized (k, v) prefix); returns [W]
+        device tokens."""
         width = 1
         while width < len(pairs):
             width *= 2
@@ -1850,8 +2010,8 @@ class LLMEngine:
                  a.slot, a.prompt_len) + self._row_tail(a.req_id)
                 for a, _ in padded]
         packed = self._pack_rows(width, t + (p if self.spec else 0), rows)
-        k_prefix = jnp.concatenate([e["k"] for _, e in padded], axis=1)
-        v_prefix = jnp.concatenate([e["v"] for _, e in padded], axis=1)
+        k_prefix = jnp.concatenate([e[0] for _, e in padded], axis=1)
+        v_prefix = jnp.concatenate([e[1] for _, e in padded], axis=1)
         (self.cache, self.lengths, self.last_tokens, self.samp,
          self.rng_key, out) = self._cont_fn(p, t, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
@@ -1859,26 +2019,32 @@ class LLMEngine:
             k_prefix, v_prefix, *self._extra())
         return out
 
-    def _store_prefix_entry(self, key: tuple, k, v) -> None:
-        self._prefix_misses += 1
-        self._prefix_store[key] = {"k": k, "v": v}
-        while len(self._prefix_store) > self.max_prefixes:
-            self._prefix_store.popitem(last=False)  # LRU eviction
-
-    def _maybe_store_prefix(self, action) -> None:
-        """After a FULL prefill, bank the slot's bucket-length prefix KV
-        (device-to-device slice; nothing crosses the host)."""
+    def _bank_prefix_blocks(self, action) -> None:
+        """After a prefill (full, continuation, or chunked chain), cache
+        the slot's block-aligned prompt-prefix KV. Probe first — a chain
+        already cached end-to-end costs zero extraction — then extract
+        the aligned prefix ONCE (device-to-device slice; nothing crosses
+        the host) and hand the radix insert lazy per-block slices: only
+        NEW blocks are sliced and stored."""
         prompt = self._prompts.get(action.req_id)
         if prompt is None:
             return
-        p = self._prefix_len_for(len(prompt))
-        if p is None:
+        bt = self.prefix_block_tokens
+        aligned = (len(prompt) // bt) * bt
+        ns = self._req_aids.get(action.req_id, 0)
+        if aligned <= 0:
             return
-        key = self._prefix_key(action.req_id, prompt[:p])
-        if key in self._prefix_store:
+        if self.kvcache.cached_prefix_len(
+                prompt, max_tokens=aligned, namespace=ns) >= aligned:
             return
-        k, v = self._extract_fn(p)(self.cache, action.slot)
-        self._store_prefix_entry(key, k, v)
+        parts = self._extract_raw_fn(aligned)(self.cache, action.slot)
+
+        def payload(_i, s, e):
+            return tuple(a[:, :, s:e] for a in parts)
+
+        self.kvcache.insert(prompt, payload, max_tokens=aligned,
+                            tenant=self._req_tenant.get(action.req_id),
+                            namespace=ns)
 
     def _dispatch_prefill_wave(self, bucket: int,
                                wave: list[PrefillAction]):
@@ -1894,6 +2060,8 @@ class LLMEngine:
         # columns] per row (a tunneled device pays ~an RTT per transfer)
         rows = [(self._prompts[a.req_id], a.slot, a.prompt_len)
                 + self._row_tail(a.req_id) for a in wave]
+        self._prefill_computed_tokens += sum(
+            len(self._prompts[a.req_id]) for a in wave)
         packed = self._pack_rows(width, bucket, rows)
         (self.cache, self.lengths, self.last_tokens, self.samp,
          self.rng_key, out) = self._prefill_fn(bucket, width)(

@@ -42,6 +42,7 @@ class LLMModel(Model):
                  mesh: dict[str, int] | None = None,
                  tokenizer: str | None = None,
                  prefix_cache: bool = False, max_prefixes: int = 4,
+                 prefix_cache_blocks: int | None = None,
                  decode_chunk: int = 8,
                  quantize: str | None = None,
                  kv_quantize: str | None = None,
@@ -71,6 +72,9 @@ class LLMModel(Model):
         self._checkpoint = checkpoint or uri
         self._prefix_cache = prefix_cache
         self._max_prefixes = max_prefixes
+        # config.prefix_cache_blocks: radix KV-reuse block-pool capacity
+        # (None derives from max_prefixes — see LLMEngine)
+        self._prefix_cache_blocks = prefix_cache_blocks
         self._decode_chunk = decode_chunk
         self._quantize = quantize
         self._kv_quantize = kv_quantize
@@ -175,6 +179,7 @@ class LLMModel(Model):
                                  decode_chunk=self._decode_chunk,
                                  prefix_cache=self._prefix_cache,
                                  max_prefixes=self._max_prefixes,
+                                 prefix_cache_blocks=self._prefix_cache_blocks,
                                  quantize=self._quantize,
                                  kv_quantize=self._kv_quantize,
                                  speculative=self._speculative,
@@ -402,13 +407,16 @@ class LLMModel(Model):
             raise TimeoutError(
                 f"generation timed out after {self._timeout_s}s")
 
-    def stream(self, payload: Any, on_finish=None):
+    def stream(self, payload: Any, on_finish=None, info: dict | None = None):
         """(token_id, logprob) stream for the SSE-completions backend.
         Submits EAGERLY (not a generator itself) so unservable requests —
         PromptTooLong, QueueFull — raise before the caller commits an
         HTTP status; returns the generator that drains the engine.
         `on_finish(reason)` fires before release with the OpenAI
-        finish_reason ("stop" | "length" | "cancelled").
+        finish_reason ("stop" | "length" | "cancelled"). `info`, when
+        given, is filled at finish time with per-request accounting the
+        final SSE usage chunk carries (currently `cached_tokens` — KV
+        tokens the prefix cache reused).
 
         With stop sequences, the last max(len(stop))-many tokens are held
         back until the request finishes: a stop match truncates the
@@ -420,9 +428,20 @@ class LLMModel(Model):
             payload = dict(payload, stop=stops)   # _encode_stops unchanged
         rid = self._submit(payload)
         hold = max((len(s) for s in stops), default=0)
-        return self._stream_from(rid, on_finish, hold)
+        return self._stream_from(rid, on_finish, hold, info)
 
-    def _stream_from(self, rid: int, on_finish=None, hold: int = 0):
+    def _cached_tokens(self, rid: int) -> int | None:
+        """None when the engine runs no prefix cache (the usage object
+        then omits cached_tokens entirely); 0 on a cache-on miss."""
+        eng = self._engine
+        if getattr(eng, "kvcache", None) is None \
+                and not getattr(eng, "prefix_cache_enabled", False):
+            return None
+        fn = getattr(eng, "cached_tokens", None)
+        return int(fn(rid)) if fn is not None else None
+
+    def _stream_from(self, rid: int, on_finish=None, hold: int = 0,
+                     info: dict | None = None):
         deadline = time.monotonic() + self._timeout_s
         sent = 0
         try:
@@ -452,6 +471,10 @@ class LLMModel(Model):
             self._engine.cancel(rid)
             self._abandoned.add(rid)
             raise
+        if info is not None:
+            cached = self._cached_tokens(rid)
+            if cached is not None:
+                info["cached_tokens"] = cached
         if on_finish is not None:
             on_finish(self._engine.finish_reason(rid))
         self._engine.release(rid)
@@ -483,6 +506,12 @@ class LLMModel(Model):
         reason = self._engine.finish_reason(rid)
         result = {"token_ids": out, "finish_reason": reason,
                   "logprobs": self._engine.result_logprobs(rid)}
+        cached = self._cached_tokens(rid)
+        if cached is not None:
+            # prompt tokens whose KV the prefix cache reused (0 on a
+            # miss); absent entirely when the engine runs no cache, so
+            # cache-off deployments keep their exact usage shape
+            result["cached_tokens"] = cached
         if self._logprobs_topk:
             result["top_logprobs"] = self._engine.result_top_logprobs(rid)
         self._engine.release(rid)  # long-lived server: drop request state

@@ -15,11 +15,24 @@ One Router per InferenceService: an HTTP reverse proxy that
     closes again. When EVERY circuit in the eligible pool is open the
     router answers 503 with a Retry-After header pointing at the soonest
     half-open instant — back-pressure with a schedule, not a dropped
-    connection.
+    connection;
+  - pins sessions to replicas by RENDEZVOUS HASHING (the kvcache
+    tentpole's placement half): a request carrying a stable session key
+    (`X-Session-Key` header, else the JSON body's `session`, else the
+    OpenAI `user` field) ranks the scheduled pool by
+    hash(session_key, port) and takes the highest-ranked ADMITTING
+    backend — so repeat traffic from one session/tenant lands where its
+    prefix KV already lives and the radix cache actually hits. The
+    affinity is stateless: when the affine replica's circuit opens, the
+    next-ranked healthy replica takes over (no 503 while capacity
+    remains), and the moment the circuit closes again the original
+    ranking — and the pin — restores itself. Keyless requests keep the
+    round-robin spread.
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import math
@@ -29,6 +42,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+def _rendezvous_rank(pool: list[int], session_key: str) -> list[int]:
+    """Highest-random-weight ordering of `pool` for one session key:
+    every router ranks identically (blake2b is stable across processes
+    and platforms), each key gets an independent pseudo-random
+    permutation (load spreads across sessions), and removing a backend
+    only moves the sessions that were pinned to it — the minimal-
+    disruption property consistent placement exists for."""
+    def weight(port: int) -> int:
+        h = hashlib.blake2b(f"{session_key}|{port}".encode(),
+                            digest_size=8)
+        return int.from_bytes(h.digest(), "big")
+
+    return sorted(pool, key=weight, reverse=True)
 
 
 class _Circuit:
@@ -116,6 +144,11 @@ class Router:
         self.canary_count = 0
         self.total_count = 0
         self.breaker_rejected = 0     # 503s served with every circuit open
+        # session-affinity accounting: keyed requests that landed on
+        # their rendezvous-first replica vs ones that failed over to a
+        # lower-ranked healthy replica (circuit open / partition)
+        self.affinity_hits = 0
+        self.affinity_failovers = 0
         self.last_request_time: float = 0.0
         # optional chaos injector: an active "partition" event makes the
         # target backend unreachable from THIS router (the fault is in the
@@ -136,8 +169,9 @@ class Router:
             def _proxy(self):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
-                code, body, extra = router.forward(self.command, self.path,
-                                                   raw)
+                code, body, extra = router.forward(
+                    self.command, self.path, raw,
+                    headers=dict(self.headers))
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -217,16 +251,23 @@ class Router:
         i = cursor % len(pool)
         return pool[i:] + pool[:i]
 
-    def _route(self) -> tuple[list[int], bool, float | None]:
+    def _route(self, session_key: str | None = None
+               ) -> tuple[list[int], bool, float | None, int | None]:
         """ONE client request's routing decision (the canary schedule
         advances exactly once per request, never per retry attempt):
         returns (candidates, is_canary, retry_in_s). Candidates are the
-        ADMITTING backends of the scheduled pool in round-robin order,
-        followed by the other pool's admitting backends — a pool whose
-        circuits are all open falls back to the healthy pool instead of
-        serving 503s while capacity idles. Empty candidates with
-        retry_in set means EVERY circuit is open; with retry_in None the
-        service has no backends at all (scale-to-zero)."""
+        ADMITTING backends of the scheduled pool — rendezvous-ranked by
+        `session_key` when the request carries one (affinity: the top-
+        ranked admitting replica is where this session's prefix KV
+        lives), round-robin otherwise — followed by the other pool's
+        admitting backends: a pool whose circuits are all open falls
+        back to the healthy pool instead of serving 503s while capacity
+        idles. Empty candidates with retry_in set means EVERY circuit is
+        open; with retry_in None the service has no backends at all
+        (scale-to-zero). The 4th element is the session's AFFINE port
+        (rendezvous-first of the scheduled pool, admitting or not;
+        None for keyless requests) — forward() scores affinity against
+        the port that actually served."""
         now = time.monotonic()
         with self._lock:
             self._count += 1
@@ -236,23 +277,30 @@ class Router:
             prim = self._canary_ports if use_canary else self._default_ports
             sec = self._default_ports if use_canary else self._canary_ports
             if not prim and not sec:
-                return [], use_canary, None
-            if use_canary:
-                self._rr_canary += 1
-                cursor = self._rr_canary
+                return [], use_canary, None, None
+            affine = None
+            if session_key is not None:
+                order_p = _rendezvous_rank(prim, session_key)
+                order_s = _rendezvous_rank(sec, session_key)
+                affine = order_p[0] if order_p else None
             else:
-                self._rr_default += 1
-                cursor = self._rr_default
-            cand = [p for p in self._rotate(prim, cursor)
-                    if self._circuits[p].admits(now)]
-            cand += [p for p in self._rotate(sec, cursor)
+                if use_canary:
+                    self._rr_canary += 1
+                    cursor = self._rr_canary
+                else:
+                    self._rr_default += 1
+                    cursor = self._rr_default
+                order_p = self._rotate(prim, cursor)
+                order_s = self._rotate(sec, cursor)
+            cand = [p for p in order_p if self._circuits[p].admits(now)]
+            cand += [p for p in order_s
                      if p not in cand and self._circuits[p].admits(now)]
             if not cand:
                 retry = min(self._circuits[p].retry_in(now)
                             for p in prim + sec)
                 self.breaker_rejected += 1
-                return [], use_canary, retry
-            return cand, use_canary, None
+                return [], use_canary, retry, affine
+            return cand, use_canary, None, affine
 
     def _record(self, port: int, ok: bool) -> None:
         with self._lock:
@@ -264,7 +312,32 @@ class Router:
             else:
                 c.on_failure(time.monotonic())
 
-    def forward(self, method: str, path: str, body: bytes
+    @staticmethod
+    def _session_key_of(headers: dict[str, str] | None,
+                        body: bytes) -> str | None:
+        """Stable session key for affinity: the `X-Session-Key` header
+        wins (explicit client intent), else the JSON body's `session`
+        field, else the OpenAI `user` field (one end user = one
+        conversation's worth of shared prefixes). Body sniffing is
+        bounded and best-effort — a non-JSON or huge body just routes
+        keyless."""
+        if headers:
+            for k, v in headers.items():
+                if k.lower() == "x-session-key" and v:
+                    return str(v)
+        if body and len(body) <= 1 << 20 and body.lstrip()[:1] == b"{":
+            try:
+                d = json.loads(body)
+            except ValueError:
+                return None
+            for field in ("session", "user"):
+                v = d.get(field) if isinstance(d, dict) else None
+                if isinstance(v, str) and v:
+                    return v
+        return None
+
+    def forward(self, method: str, path: str, body: bytes,
+                headers: dict[str, str] | None = None
                 ) -> tuple[int, bytes, dict[str, str] | None]:
         """Proxy one request. Only CONNECT-phase failures (refused,
         injected partition — the backend provably never saw the request)
@@ -274,9 +347,13 @@ class Router:
         reset mid-response) is NOT retried — the backend may have
         executed it, and replaying a non-idempotent generation would
         silently duplicate it. Every failure feeds its backend's
-        circuit."""
+        circuit. Requests carrying a session key route by rendezvous
+        affinity (see _route) — the candidate order IS the failover
+        order, so a pinned session degrades to the next healthy replica
+        and re-pins by itself once the affine circuit closes."""
         self.last_request_time = time.time()
-        candidates, is_canary, retry_in = self._route()
+        session_key = self._session_key_of(headers, body)
+        candidates, is_canary, retry_in, affine = self._route(session_key)
         if not candidates and retry_in is not None:
             # every backend's circuit is open: schedule the retry instead
             # of hammering dead ports (503 + Retry-After, the chaos
@@ -345,6 +422,15 @@ class Router:
                         {"error": f"backend failed mid-request: {e}"}
                     ).encode(), None
                 self._record(port, True)
+                if session_key is not None:
+                    # scored on the port that actually SERVED (a
+                    # connect-retry onto a lower-ranked replica is a
+                    # failover even though routing ranked it)
+                    with self._lock:
+                        if port == affine:
+                            self.affinity_hits += 1
+                        else:
+                            self.affinity_failovers += 1
                 return resp.status, data, None
             return 502, json.dumps(
                 {"error": f"backend unreachable: {last_err}"}

@@ -177,10 +177,27 @@ class ModelServer:
         if path == "/healthz":
             # the liveness probe (chaos tentpole): cheap, model-free —
             # answering at all means the serving thread is alive; the
-            # payload carries uptime so flap detectors can spot restarts
-            return 200, {"alive": True, "name": self.name,
-                         "uptime_s": round(
-                             time.monotonic() - self._t_start, 3)}
+            # payload carries uptime so flap detectors can spot restarts.
+            # Models running a prefix KV cache additionally report their
+            # reuse counters here (the kvcache operator surface: hit
+            # rate, blocks resident, tokens saved) — the router/fleet
+            # tooling reads this without a model round-trip.
+            body: dict[str, Any] = {
+                "alive": True, "name": self.name,
+                "uptime_s": round(time.monotonic() - self._t_start, 3)}
+            caches: dict[str, Any] = {}
+            for mname in self.repository.names():
+                try:
+                    mm = self.repository.get(mname).metrics()
+                except Exception:
+                    continue   # liveness must answer even if a model is
+                    # mid-load/broken — health first, detail best-effort
+                pc = (mm or {}).get("prefix_cache")
+                if pc:
+                    caches[mname] = pc
+            if caches:
+                body["kv_cache"] = caches
+            return 200, body
         if path in ("/", "/v2"):
             return 200, {"name": self.name, "version": "2",
                          "extensions": ["health", "models", "metrics"]}
@@ -477,6 +494,19 @@ class ModelServer:
                  "completion_tokens": gen_tokens,
                  "total_tokens":
                      len(payload["prompt_tokens"]) + gen_tokens}
+        # prompt tokens served from the prefix KV cache: the OpenAI
+        # `cached_tokens` surface, mirrored under prompt_tokens_details
+        # for clients reading the modern nested shape. One prompt, one
+        # number — n/best_of candidates share the prompt, so the field
+        # is the MAX any candidate reused (summing would exceed
+        # prompt_tokens and break clients computing the uncached
+        # remainder), never above prompt_tokens itself.
+        if any("cached_tokens" in r for r in results):
+            cached = min(usage["prompt_tokens"],
+                         max(r.get("cached_tokens") or 0
+                             for r in results))
+            usage["cached_tokens"] = cached
+            usage["prompt_tokens_details"] = {"cached_tokens": cached}
         # cancelled terminal state (deadline / disconnect): count over the
         # RETURNED choices only — a discarded best_of candidate that was
         # cancelled must not flag a fully-delivered answer as partial
@@ -512,8 +542,11 @@ class ModelServer:
                 raise ProtocolError(
                     "streaming supports n=1 / best_of=1 only")
             # m.stream submits eagerly: PromptTooLong/QueueFull raise HERE,
-            # before the 200 + SSE headers are committed
-            token_iter = m.stream(payload, on_finish=finish.append)
+            # before the 200 + SSE headers are committed. stream_info is
+            # filled at finish time (cached_tokens for the usage chunk).
+            stream_info: dict[str, Any] = {}
+            token_iter = m.stream(payload, on_finish=finish.append,
+                                  info=stream_info)
         except self._completion_exceptions() as e:
             return handler._send(*self._completion_error(e))
         t0 = time.perf_counter()
@@ -590,6 +623,10 @@ class ModelServer:
                 usage = {"prompt_tokens": n_prompt,
                          "completion_tokens": n_sent,
                          "total_tokens": n_prompt + n_sent}
+                if "cached_tokens" in stream_info:
+                    usage["cached_tokens"] = stream_info["cached_tokens"]
+                    usage["prompt_tokens_details"] = {
+                        "cached_tokens": stream_info["cached_tokens"]}
                 if reason == "cancelled":
                     # same type as the buffered path: a COUNT of
                     # cancelled returned choices (a stream has one)

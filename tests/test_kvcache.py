@@ -1,0 +1,228 @@
+"""Radix prefix-KV index (kvcache.radix): the structure under the
+engine's KV reuse, tested jax-free in the fast lane.
+
+Two heavyweight guarantees:
+  - DIFFERENTIAL: the radix longest-cached-prefix must equal a
+    brute-force reference (dict of every inserted sequence, scan for
+    the longest block-aligned common prefix) over thousands of
+    randomized insert/match interleavings;
+  - PROPERTY: under capacity pressure with live pins, eviction must
+    never reclaim a pinned block, never orphan a chain interior, never
+    exceed capacity, and the tree must stay exactly consistent
+    (check_invariants after every operation).
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.kvcache import RadixKVCache
+
+
+def _payload(tag):
+    def fn(i, s, e):
+        return (tag, i, s, e)
+    return fn
+
+
+class BruteForce:
+    """Reference model: remembers every block-aligned prefix ever
+    successfully cached, per namespace. Longest-common-prefix lookup by
+    linear scan — obviously correct, hopelessly slow."""
+
+    def __init__(self, block_tokens: int):
+        self.bt = block_tokens
+        self.seqs: dict[object, list[tuple]] = {}
+
+    def insert(self, tokens, n_blocks_stored_through, namespace=None):
+        # the radix may stop early under pressure; the reference mirrors
+        # the actually-stored aligned length, handed back by the caller
+        if n_blocks_stored_through:
+            self.seqs.setdefault(namespace, []).append(
+                tuple(tokens[:n_blocks_stored_through * self.bt]))
+
+    def match_len(self, tokens, max_tokens=None, namespace=None):
+        limit = len(tokens) if max_tokens is None else min(len(tokens),
+                                                           max_tokens)
+        best = 0
+        for seq in self.seqs.get(namespace, ()):
+            common = 0
+            for a, b in zip(seq, tokens):
+                if a != b:
+                    break
+                common += 1
+            common = min(common, limit)
+            best = max(best, (common // self.bt) * self.bt)
+        return best
+
+
+def test_differential_against_brute_force_lcp():
+    """Randomized insert/match interleavings: radix match length ==
+    brute-force longest block-aligned common prefix, always. Capacity is
+    large so eviction never desyncs the reference (eviction behavior has
+    its own property test below)."""
+    rng = random.Random(7)
+    cache = RadixKVCache(block_tokens=4, capacity_blocks=10_000)
+    ref = BruteForce(4)
+    alphabet = [1, 2, 3]   # tiny vocab → dense prefix sharing
+    pool: list[list[int]] = []
+    for step in range(3000):
+        op = rng.random()
+        if op < 0.4 or not pool:
+            seq = [rng.choice(alphabet) for _ in range(rng.randint(1, 40))]
+            pool.append(seq)
+        elif op < 0.6:
+            # extend an existing sequence (the multi-turn shape)
+            seq = list(rng.choice(pool))
+            seq.extend(rng.choice(alphabet)
+                       for _ in range(rng.randint(1, 12)))
+            pool.append(seq)
+        else:
+            seq = rng.choice(pool)
+        if rng.random() < 0.5:
+            stored = cache.insert(seq, _payload(step))
+            covered = cache.cached_prefix_len(seq)
+            assert covered % 4 == 0
+            ref.insert(seq, covered // 4)
+        cap = rng.choice([None, len(seq) - 1, rng.randint(0, len(seq))])
+        m = cache.match(seq, max_tokens=cap)
+        try:
+            want = ref.match_len(seq, max_tokens=cap)
+            assert m.tokens == want, (step, seq, cap, m.tokens, want)
+            assert len(m.payloads) == m.tokens // 4
+        finally:
+            cache.release(m)
+        if step % 100 == 0:
+            cache.check_invariants()
+    cache.check_invariants()
+
+
+def test_differential_with_namespaces():
+    """Chains in different namespaces (the engine's adapter ids) never
+    cross-match even at identical tokens."""
+    cache = RadixKVCache(block_tokens=2, capacity_blocks=100_000)
+    ref = BruteForce(2)
+    rng = random.Random(3)
+    for step in range(400):
+        ns = rng.choice([0, 1, 2])
+        seq = [rng.choice([5, 6]) for _ in range(rng.randint(1, 14))]
+        cache.insert(seq, _payload(step), namespace=ns)
+        ref.insert(seq, cache.cached_prefix_len(seq, namespace=ns) // 2,
+                   namespace=ns)
+        for probe_ns in (0, 1, 2):
+            m = cache.match(seq, namespace=probe_ns)
+            assert m.tokens == ref.match_len(seq, namespace=probe_ns)
+            cache.release(m)
+    cache.check_invariants()
+
+
+def test_eviction_under_pressure_property():
+    """Random ops against a tiny pool with live pins: the in-use
+    invariant (pinned never reclaimed), the capacity bound, and tree
+    consistency hold after EVERY operation; pinned chains stay
+    matchable in full while pinned."""
+    rng = random.Random(11)
+    cache = RadixKVCache(block_tokens=2, capacity_blocks=12)
+    live: list = []   # (MatchResult, expected token tuple)
+    for step in range(2000):
+        seq = [rng.randint(1, 4) for _ in range(rng.randint(2, 20))]
+        op = rng.random()
+        if op < 0.5:
+            cache.insert(seq, _payload(step))
+        elif op < 0.75 or not live:
+            m = cache.match(seq)
+            if m.tokens and rng.random() < 0.6 and len(live) < 6:
+                live.append((m, tuple(seq[:m.tokens])))
+            else:
+                cache.release(m)
+        else:
+            m, _ = live.pop(rng.randrange(len(live)))
+            cache.release(m)
+        cache.check_invariants()
+        assert cache.n_blocks <= 12
+        # every pinned chain must still be fully cached: eviction can
+        # not have taken any of its blocks
+        for m, toks in live:
+            assert cache.cached_prefix_len(toks) == len(toks), step
+            assert all(p is not None for p in m.payloads)
+    for m, _ in live:
+        cache.release(m)
+    cache.check_invariants()
+
+
+def test_all_pinned_insert_degrades_without_eviction():
+    """Capacity full of pinned blocks: insert stores nothing (returns
+    0), the pinned chains survive, and nothing raises."""
+    cache = RadixKVCache(block_tokens=2, capacity_blocks=3)
+    cache.insert([1, 1, 2, 2, 3, 3], _payload("a"))
+    m = cache.match([1, 1, 2, 2, 3, 3])
+    assert m.tokens == 6 and cache.n_blocks == 3
+    assert cache.insert([9, 9, 8, 8], _payload("b")) == 0
+    assert cache.cached_prefix_len([1, 1, 2, 2, 3, 3]) == 6
+    cache.check_invariants()
+    cache.release(m)
+    # unpinned now: the LRU leaf gives way
+    assert cache.insert([9, 9, 8, 8], _payload("b")) == 2
+    assert cache.n_blocks == 3
+    assert cache.cached_prefix_len([9, 9, 8, 8]) == 4
+    # the old chain lost its leaf first (LRU from the tail), never an
+    # interior before its children
+    assert cache.cached_prefix_len([1, 1, 2, 2, 3, 3]) in (2, 4)
+    cache.check_invariants()
+
+
+def test_interior_nodes_never_evicted_before_leaves():
+    """A shared interior block with a live descendant chain is not
+    evictable — only leaves go, so no chain is ever orphaned."""
+    cache = RadixKVCache(block_tokens=1, capacity_blocks=4)
+    cache.insert([1, 2, 3, 4], _payload("chain"))   # 1→2→3→4
+    # pin the LEAF: the whole chain is now structurally unevictable
+    # (interiors have children, the leaf has refs)
+    m = cache.match([1, 2, 3, 4])
+    assert m.tokens == 4
+    assert cache.insert([7, 8], _payload("other")) == 0
+    assert cache.cached_prefix_len([1, 2, 3, 4]) == 4
+    cache.release(m)
+    cache.check_invariants()
+
+
+def test_accounting_per_tenant():
+    cache = RadixKVCache(block_tokens=2, capacity_blocks=10)
+    cache.insert([1, 2, 3, 4], _payload("x"), tenant="alice")
+    cache.record_hit("alice", 4)
+    cache.record_hit("alice", 2)
+    cache.record_miss("bob")
+    st = cache.stats()
+    assert st["hits"] == 2 and st["misses"] == 1
+    assert st["hit_rate"] == pytest.approx(2 / 3, abs=1e-4)
+    assert st["reused_tokens"] == 6
+    assert st["per_tenant"]["alice"]["hits"] == 2
+    assert st["per_tenant"]["alice"]["reused_tokens"] == 6
+    assert st["per_tenant"]["alice"]["inserted_blocks"] == 2
+    assert st["per_tenant"]["bob"]["misses"] == 1
+    assert st["blocks"] == 2 and st["block_tokens"] == 2
+
+
+def test_match_respects_max_tokens():
+    """max_tokens = len(prompt) - 1 is the engine's ">= 1 tail token"
+    clamp: a fully-cached prompt must still leave a tail."""
+    cache = RadixKVCache(block_tokens=2, capacity_blocks=10)
+    cache.insert([5, 6, 7, 8], _payload("x"))
+    m = cache.match([5, 6, 7, 8], max_tokens=3)
+    assert m.tokens == 2
+    cache.release(m)
+    m = cache.match([5, 6, 7, 8])
+    assert m.tokens == 4
+    cache.release(m)
+
+
+def test_clear_refuses_with_pins_outstanding():
+    cache = RadixKVCache(block_tokens=1, capacity_blocks=4)
+    cache.insert([1, 2], _payload("x"))
+    m = cache.match([1, 2])
+    with pytest.raises(RuntimeError):
+        cache.clear()
+    cache.release(m)
+    cache.clear()
+    assert cache.n_blocks == 0
+    assert cache.cached_prefix_len([1, 2]) == 0

@@ -818,3 +818,77 @@ def test_compile_cache_config_cold_start_lever(tiny, tmp_path):
             compilation_cache as _cc)
 
         _cc.reset_cache()   # rebind to the restored dir for later tests
+
+
+@pytest.mark.slow
+def test_usage_cached_tokens_and_healthz_cache_section(tiny):
+    """kvcache counters end-to-end over HTTP: the OpenAI usage object
+    carries cached_tokens (0 on the cold request, the reused prefix on
+    the hit — buffered AND streaming), and GET /healthz exposes the
+    model's prefix_cache section for fleet tooling."""
+    import http.client
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.server import ModelServer
+
+    _, cfg = tiny
+    m = LLMModel("llm-pc", model={k: getattr(cfg, k) for k in
+                                  ("vocab_size", "d_model", "n_layers",
+                                   "n_heads", "n_kv_heads", "d_ff",
+                                   "max_seq_len", "attention_impl",
+                                   "remat")},
+                 n_slots=2, max_len=64, buckets=(8, 16, 32), seed=0,
+                 prefix_cache=True)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    try:
+        prompt_ids = list(range(2, 23))   # 21 tokens -> 16 reusable
+
+        def post(body):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=60)
+            conn.request("POST", "/openai/v1/completions",
+                         body=_json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            conn.close()
+            return resp.status, raw
+
+        body = {"model": "llm-pc", "prompt": prompt_ids, "max_tokens": 4}
+        code, raw = post(body)
+        out = _json.loads(raw)
+        assert code == 200, out
+        assert out["usage"]["cached_tokens"] == 0
+        assert out["usage"]["prompt_tokens_details"] == {
+            "cached_tokens": 0}
+        code, raw = post(body)
+        out = _json.loads(raw)
+        assert code == 200, out
+        assert out["usage"]["cached_tokens"] == 16, out["usage"]
+        assert out["usage"]["total_tokens"] == 21 + 4
+
+        # streaming: the final usage chunk carries the same field
+        code, raw = post(dict(body, stream=True))
+        assert code == 200
+        usages = [_json.loads(line[len("data: "):])
+                  for line in raw.decode().splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"
+                  and "usage" in line]
+        assert usages and usages[-1]["usage"]["cached_tokens"] == 16
+
+        # healthz: liveness payload + the kv_cache operator section
+        with urllib.request.urlopen(server.url + "/healthz",
+                                    timeout=5) as r:
+            hz = _json.loads(r.read())
+        assert hz["alive"]
+        pc = hz["kv_cache"]["llm-pc"]
+        assert pc["request_hits"] >= 2 and pc["blocks"] >= 2
+        assert pc["prefill_tokens_saved"] >= 32
+    finally:
+        server.stop()
+        m.unload()

@@ -174,3 +174,110 @@ def test_miniature_preserves_shape():
 def test_unknown_scenario_raises():
     with pytest.raises(KeyError):
         scenarios.load_scenario("nope")
+
+
+# -- shared_prefix / multi-turn chat family (the kvcache tentpole) -----------
+
+SP_CFG = TraceConfig(seed=11, duration_s=12.0, base_rate_rps=1.5,
+                     n_tenants=3, vocab=512, n_templates=4,
+                     template_len=(16, 30), template_skew=1.2,
+                     turns=(2, 4), turn_user_len=(4, 10),
+                     turn_gap_s=(0.2, 1.0), output_len=(4, 8))
+
+
+def test_shared_prefix_family_deterministic_and_round_trips():
+    a, b = generate_trace(SP_CFG), generate_trace(SP_CFG)
+    assert trace_bytes(a) == trace_bytes(b)
+    assert Trace.from_json(json.loads(trace_bytes(a))) == a
+    assert TraceConfig.from_json(
+        json.loads(json.dumps(SP_CFG.to_json()))) == SP_CFG
+
+
+def test_shared_prefix_sha_pins_across_processes():
+    """The new family's byte-identity holds in a FRESH interpreter (the
+    committed-scenario contract, extended to the r10 family)."""
+    prog = (
+        "from kubeflow_tpu.loadgen.trace import *\n"
+        f"cfg = TraceConfig.from_json({SP_CFG.to_json()!r})\n"
+        "print(trace_sha256(generate_trace(cfg)))\n")
+    out = subprocess.run([sys.executable, "-c", prog],
+                        capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == trace_sha256(generate_trace(SP_CFG))
+
+
+def test_family_fields_absent_keeps_old_traces_byte_identical():
+    """Configs predating the family serialize WITHOUT the new fields, so
+    every committed pre-r10 trace sha (and the BENCH records carrying
+    them) stays valid."""
+    d = CFG.to_json()
+    assert "n_templates" not in d and "turns" not in d
+    sp = SP_CFG.to_json()
+    assert sp["n_templates"] == 4 and sp["turns"] == [2, 4]
+    # and old-family requests carry no session key in their bytes
+    tr = generate_trace(CFG)
+    assert b'"session"' not in trace_bytes(tr)
+
+
+def test_sessions_extend_prefixes_and_sort_order():
+    """The property the radix cache reuses: within a session, turn k's
+    prompt is a strict extension of turn k-1's; arrivals stay globally
+    sorted; every request carries its session key."""
+    tr = generate_trace(SP_CFG)
+    ts = [r.arrival_s for r in tr.requests]
+    assert ts == sorted(ts)
+    assert [r.index for r in tr.requests] == list(range(len(ts)))
+    by_sess = {}
+    for r in tr.requests:
+        assert r.session is not None and r.session.startswith("s")
+        by_sess.setdefault(r.session, []).append(r)
+    multi = 0
+    for rs in by_sess.values():
+        rs.sort(key=lambda r: len(r.prompt))
+        for a, b in zip(rs, rs[1:]):
+            assert b.prompt[:len(a.prompt)] == a.prompt
+            multi += 1
+    assert multi > 0   # the window must actually contain multi-turn
+
+
+def test_templates_shared_across_sessions():
+    """Zipf over few templates: distinct sessions must collide on the
+    popular templates (that is the cross-session reuse the cache-hit
+    floor measures)."""
+    tr = generate_trace(SP_CFG.replace(duration_s=40.0))
+    first_prompts = {}
+    for r in tr.requests:
+        first_prompts.setdefault(r.session, r.prompt)
+    # group session-opening prompts by their first 16 tokens (the
+    # minimum template length): >= 2 sessions share a template
+    heads = {}
+    for p in first_prompts.values():
+        heads[p[:16]] = heads.get(p[:16], 0) + 1
+    assert len(heads) <= SP_CFG.n_templates
+    assert max(heads.values()) >= 2
+
+
+def test_shared_prefix_scenario_committed_and_miniatures():
+    s = scenarios.load_scenario("shared_prefix_chat")
+    assert s.trace.n_templates > 0
+    tr = generate_trace(s.trace)
+    assert trace_sha256(tr) == trace_sha256(generate_trace(s.trace))
+    # prompts must fit the d1024 bench engine (max_len 512 minus output)
+    assert max(len(r.prompt) for r in tr.requests) \
+        + s.trace.output_len[1] <= 512
+    m = scenarios.miniature(s, vocab=128, max_prompt_len=40,
+                            duration_s=3.0, rate_rps=4.0)
+    tm = generate_trace(m.trace)
+    assert all(len(r.prompt) <= 40 for r in tm.requests)
+    assert all(t < 128 for r in tm.requests for t in r.prompt)
+    # the family survives the shrink: sessions still multi-turn
+    assert any(r.session == r2.session and r is not r2
+               for r in tm.requests for r2 in tm.requests)
+
+
+def test_family_validation():
+    with pytest.raises(ValueError):
+        generate_trace(SP_CFG.replace(template_len=(0, 4)))
+    with pytest.raises(ValueError):
+        generate_trace(SP_CFG.replace(turns=(3, 2)))
+    with pytest.raises(ValueError):
+        generate_trace(SP_CFG.replace(turn_gap_s=(-1.0, 1.0)))

@@ -89,3 +89,39 @@ def test_chaos_floors_gated_on_schema_4(tmp_path):
     p.write_text(json.dumps(rec4))
     assert any(f.startswith("chaos_crash_terminal_frac")
                for f in bench.check_floors(str(p)))
+
+
+def test_prefix_floors_gated_on_schema_5(tmp_path):
+    """serving_prefix_cache floors (r10) only bind records new enough to
+    carry the section: the committed pre-r10 record stays valid, a
+    schema-5 record missing the section fails loudly, and a schema-5
+    record holding its floors is green — including the exact greedy-
+    parity contract."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 5   # committed record predates kvcache
+    assert not any("prefix" in f for f in bench.check_floors(_RECORD))
+
+    rec5 = json.loads(json.dumps(rec))
+    rec5["schema"] = 5
+    p = tmp_path / "rec5.json"
+    p.write_text(json.dumps(rec5))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("prefix_cache_hit_rate") for f in fails)
+    assert any(f.startswith("prefix_prefill_saved_frac") for f in fails)
+    assert any(f.startswith("prefix_greedy_parity") for f in fails)
+
+    rec5["extras"]["serving_prefix_cache"] = {
+        "hit_rate": 0.78, "prefill_saved_frac": 0.6,
+        "greedy_parity": True}
+    p.write_text(json.dumps(rec5))
+    assert not any("prefix" in f for f in bench.check_floors(str(p)))
+
+    # greedy parity is an EXACT contract: False fails no matter how
+    # good the hit rate is
+    rec5["extras"]["serving_prefix_cache"]["greedy_parity"] = False
+    p.write_text(json.dumps(rec5))
+    assert any(f.startswith("prefix_greedy_parity")
+               for f in bench.check_floors(str(p)))

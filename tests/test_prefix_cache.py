@@ -1,11 +1,13 @@
-"""Prefix KV caching in the continuous-batching engine (the vLLM-style
-shared-system-prompt optimization, TPU-shaped: bucket-granular prefixes so
-every program stays static-shaped).
+"""Radix prefix-KV reuse in the continuous-batching engine (the kvcache
+tentpole, TPU-shaped: fixed-size blocks = gcd of the buckets, so every
+continuation program stays static-shaped).
 
-The contract under test: a prefix-cache hit must produce EXACTLY the tokens
-a cache-less engine produces (the continuation program replays the same
-math over prefix KV + tail), hits/misses are accounted, and the LRU bound
-holds.
+The contract under test: reuse must produce EXACTLY the tokens a
+cache-less engine produces (greedy byte-parity — the continuation
+program replays the same math over reused block KV + tail), multi-turn
+prompts extend cached chains instead of re-storing them, the block pool
+honors its capacity with ref-count-safe LRU eviction, and the
+per-request/per-tenant accounting is what the bench commits.
 """
 
 import numpy as np
@@ -15,7 +17,8 @@ import jax
 
 # every test spins up at least one fully-warmed engine (~1 min of CPU
 # compiles): slow lane (the fast lane still covers the engine through
-# test_llm_serving's unmarked tests)
+# test_llm_serving's unmarked tests, and the radix structure itself
+# through test_kvcache)
 pytestmark = pytest.mark.slow
 
 from kubeflow_tpu.models import llama
@@ -37,8 +40,14 @@ def make_engine(tiny, prefix_cache, **kw):
     return eng
 
 
+def test_block_size_is_bucket_gcd(tiny):
+    eng = make_engine(tiny, prefix_cache=True)
+    assert eng.prefix_block_tokens == 8
+    assert eng.kvcache is not None
+
+
 def test_prefix_hit_matches_uncached_engine(tiny):
-    shared = list(range(1, 18))            # 17 tokens -> prefix bucket 16
+    shared = list(range(1, 18))            # 17 tokens -> 2 blocks cached
     tail_a, tail_b = [100, 101, 102], [200, 201]
     plain = make_engine(tiny, prefix_cache=False)
     cached = make_engine(tiny, prefix_cache=True)
@@ -48,49 +57,79 @@ def test_prefix_hit_matches_uncached_engine(tiny):
         got = cached.generate(prompt, 8)
         assert got == want, (got, want)
     m = cached.metrics()
-    # first prompt stored the prefix (miss), second hit it
+    # first prompt banked the blocks (miss), second reused 16 tokens
     assert m["prefix_misses"] == 1 and m["prefix_hits"] == 1, m
+    assert m["prefix_cache"]["reused_tokens"] == 16
+    assert m["prefix_cache"]["prefill_tokens_saved"] == 16
+
+
+def test_multi_turn_chain_extends_and_reuses(tiny):
+    """The multi-turn chat shape: turn k's prompt extends turn k-1's.
+    Every turn past the first must hit, reuse grows with the chain, and
+    greedy outputs stay byte-identical to the cold engine."""
+    plain = make_engine(tiny, prefix_cache=False)
+    eng = make_engine(tiny, prefix_cache=True)
+    ctx = list(range(1, 13))               # 12 tokens
+    reused = []
+    for turn in range(3):
+        want = plain.generate(list(ctx), 4)
+        got = eng.generate(list(ctx), 4)
+        assert got == want, turn
+        reused.append(eng.metrics()["prefix_cache"]["reused_tokens"])
+        ctx += [40 + turn, 41 + turn, 42 + turn, 43 + turn,
+                44 + turn, 45 + turn, 46 + turn]
+    m = eng.metrics()
+    assert m["prefix_hits"] == 2 and m["prefix_misses"] == 1, m
+    # each turn reused the previous turn's aligned chain: 8 then +16
+    assert reused == [0, 8, 24], reused
 
 
 def test_identical_prompt_twice_hits(tiny):
     eng = make_engine(tiny, prefix_cache=True)
-    prompt = list(range(3, 24))            # 21 tokens -> prefix bucket 16
+    prompt = list(range(3, 24))            # 21 tokens -> 2 blocks usable
     first = eng.generate(prompt, 6)
     second = eng.generate(prompt, 6)
     assert first == second
     m = eng.metrics()
-    assert m["prefix_hits"] == 1 and m["prefix_entries"] == 1, m
+    assert m["prefix_hits"] == 1
+    # 21 tokens bank 2 blocks (16 aligned); the hit reused them all
+    assert m["prefix_cache"]["reused_tokens"] == 16
 
 
 def test_short_prompts_bypass_the_cache(tiny):
     eng = make_engine(tiny, prefix_cache=True)
-    out = eng.generate([5, 6, 7], 4)       # 3 tokens < smallest bucket
+    out = eng.generate([5, 6, 7], 4)       # 3 tokens < one block
     assert len(out) == 4
     m = eng.metrics()
     assert m["prefix_hits"] == 0 and m["prefix_misses"] == 0
 
 
-def test_lru_eviction_bound(tiny):
-    eng = make_engine(tiny, prefix_cache=True, max_prefixes=1)
+def test_block_pool_capacity_and_eviction(tiny):
+    """capacity 2 blocks: a second distinct prompt's blocks evict the
+    first's (LRU), so the first misses again on return — and the pool
+    never exceeds its bound."""
+    eng = make_engine(tiny, prefix_cache=True, prefix_cache_blocks=2)
     p1 = list(range(1, 18))
     p2 = list(range(30, 47))
-    eng.generate(p1, 4)                    # stores prefix(p1)
-    eng.generate(p2, 4)                    # stores prefix(p2), evicts p1
+    eng.generate(p1, 4)                    # banks p1's 2 blocks
+    assert eng.metrics()["prefix_entries"] == 2
+    eng.generate(p2, 4)                    # banks p2, evicting p1
     m = eng.metrics()
-    assert m["prefix_entries"] == 1
-    eng.generate(p1 + [9], 4)              # p1 evicted -> miss again
+    assert m["prefix_entries"] <= 2
+    assert m["prefix_cache"]["evicted_blocks"] >= 1
+    eng.generate(p1 + [9], 4)              # p1 gone -> miss again
     m = eng.metrics()
     assert m["prefix_hits"] == 0 and m["prefix_misses"] == 3
 
 
 def test_shared_prefix_burst_batches_one_wave(tiny):
-    """A burst of hits sharing (prefix bucket, tail bucket) dispatches as
-    ONE batched continuation wave (the workload prefix caching exists for),
-    and every request still matches the uncached engine exactly."""
+    """A burst of hits sharing (prefix len, tail bucket) dispatches as
+    ONE batched continuation wave (the workload prefix caching exists
+    for), and every request still matches the uncached engine exactly."""
     shared = list(range(1, 18))
     plain = make_engine(tiny, prefix_cache=False)
-    eng = make_engine(tiny, prefix_cache=True, max_prefixes=2)
-    eng.generate(shared + [99], 2)         # seed the store (miss)
+    eng = make_engine(tiny, prefix_cache=True)
+    eng.generate(shared + [99], 2)         # seed the chain (miss)
     rids = [eng.submit(shared + [100 + i], 4) for i in range(4)]
     eng.run_until_idle()
     for i, rid in enumerate(rids):
@@ -100,11 +139,71 @@ def test_shared_prefix_burst_batches_one_wave(tiny):
     assert m["prefix_hits"] == 4 and m["prefix_misses"] == 1, m
 
 
+def test_chunked_long_prompt_composes_with_radix(tiny):
+    """A prompt longer than the largest bucket whose leading blocks are
+    cached starts its chunked chain at the reused prefix — byte-parity
+    with the cold engine, reuse recorded."""
+    plain = make_engine(tiny, prefix_cache=False)
+    eng = make_engine(tiny, prefix_cache=True)
+    shared = list(range(1, 18))            # banks 2 blocks
+    eng.generate(shared + [99], 2)
+    long = shared + list(range(300, 335))  # 52 tokens > bucket 32
+    want = plain.generate(long, 4)
+    got = eng.generate(long, 4)
+    assert got == want, (got, want)
+    m = eng.metrics()
+    assert m["prefix_hits"] >= 1
+    assert m["prefix_cache"]["reused_tokens"] >= 16
+
+
+def test_int8_kv_blocks_stay_quantized_and_match(tiny):
+    """int8 KV cache: blocks are stored quantized (int8 payload dtype)
+    and a hit still reproduces the int8 engine's own cold output
+    byte-for-byte (dequantize-at-materialize is the same math the
+    continuation would have seen from a fresh prefill extract)."""
+    cold = make_engine(tiny, prefix_cache=False, kv_quantize="int8")
+    eng = make_engine(tiny, prefix_cache=True, kv_quantize="int8")
+    shared = list(range(2, 19))
+    for tail in ([70, 71, 72], [80, 81]):
+        want = cold.generate(shared + tail, 6)
+        got = eng.generate(shared + tail, 6)
+        assert got == want, (got, want)
+    assert eng.metrics()["prefix_hits"] == 1
+    # reach into the store: payloads must be int8 + f32 scales, not
+    # dequantized copies (the residency half of the int8-aware contract)
+    root = eng.kvcache._roots[0]
+    node = next(iter(root.children.values()))
+    kq, ks, vq, vs = node.block.payload
+    assert kq.dtype == np.int8 and vq.dtype == np.int8
+    assert ks.dtype == np.float32 and vs.dtype == np.float32
+
+
+def test_cached_tokens_and_request_timing_fields(tiny):
+    eng = make_engine(tiny, prefix_cache=True)
+    prompt = list(range(5, 26))            # 21 tokens
+    rid = eng.submit(prompt, 4, tenant="acme")
+    eng.run_until_idle()
+    assert eng.cached_tokens(rid) == 0     # cold
+    tm = eng.request_timing(rid)
+    assert tm["prompt_len"] == 21 and tm["cached_prefix_len"] == 0
+    assert tm["prefill_tokens"] == 21
+    eng.release(rid)
+    rid = eng.submit(prompt, 4, tenant="acme")
+    eng.run_until_idle()
+    assert eng.cached_tokens(rid) == 16    # 2 blocks reused
+    tm = eng.request_timing(rid)
+    assert tm["cached_prefix_len"] == 16 and tm["prefill_tokens"] == 5
+    eng.release(rid)
+    per_tenant = eng.metrics()["prefix_cache"]["per_tenant"]
+    assert per_tenant["acme"]["hits"] == 1
+    assert per_tenant["acme"]["reused_tokens"] == 16
+
+
 def test_sampled_requests_through_continuation_path(tiny):
-    """Temperature sampling composes with the continuation program: a hit
-    still yields valid in-vocab tokens from the program-threaded PRNG (the
-    stream position depends on dispatch history, so only the mechanism —
-    not a cross-engine replay — is assertable)."""
+    """Temperature sampling composes with the continuation program: a
+    hit still yields valid in-vocab tokens from the program-threaded
+    PRNG (the stream position depends on dispatch history, so only the
+    mechanism — not a cross-engine replay — is assertable)."""
     _, cfg = tiny
     eng = make_engine(tiny, prefix_cache=True)
     prompt = list(range(2, 20))

@@ -160,27 +160,28 @@ def test_kv_int8_engine_generates(tiny):
 
 @pytest.mark.slow
 def test_kv_int8_prefix_cache_hit_deterministic(tiny):
-    """Under kv int8 the prefix STORE stays stable (requantization is
-    idempotent, so hit-path cache rows equal miss-path rows byte for byte)
-    and repeated hits are deterministic. Token equality with the miss path
-    is NOT guaranteed: the hit's tail attends over int8-roundtripped prefix
-    KV while the miss's full prefill attended over exact KV, so near-tied
-    logits may resolve differently — the bounded-int8-error trade."""
+    """Under kv int8 the radix store keeps blocks QUANTIZED (int8 rows +
+    f32 scales, the residency half of the int8-aware contract), hits are
+    deterministic, and requantizing a stored block is idempotent — the
+    continuation's re-quantize-on-write reproduces the identical int8
+    rows the miss path wrote, which is why the hit path stays exact."""
     from kubeflow_tpu.serving.llm import LLMEngine
     params, cfg = tiny
     eng = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8, 16),
                     prefix_cache=True, kv_quantize="int8")
-    prompt = [3, 17, 42, 9, 55, 2, 8, 13, 21, 34]  # prefix 8 + tail 2
+    prompt = [3, 17, 42, 9, 55, 2, 8, 13, 21, 34]  # 10 tokens: 1 block
     eng.generate(prompt, max_new_tokens=5)
     assert eng.metrics()["prefix_misses"] >= 1
     hit1 = eng.generate(prompt, max_new_tokens=5)
     assert eng.metrics()["prefix_hits"] >= 1
     hit2 = eng.generate(prompt, max_new_tokens=5)
     assert hit1 == hit2  # hits are deterministic
-    # the stored prefix entry is byte-stable: re-quantizing what the hit
-    # path wrote reproduces the identical int8 rows
-    (key_, entry), = list(eng._prefix_store.items())
-    kq1, ks1 = llama.quantize_kv(entry["k"])
+    # the stored block is int8 and byte-stable: re-quantizing its
+    # dequantized rows reproduces the identical int8 payload
+    root = eng.kvcache._roots[0]
+    node = next(iter(root.children.values()))
+    kq1, ks1, _vq, _vs = node.block.payload
+    assert kq1.dtype == jnp.int8
     kq2, ks2 = llama.quantize_kv(
         llama.dequantize_kv(kq1, ks1, jnp.float32))
     np.testing.assert_array_equal(np.asarray(kq1), np.asarray(kq2))

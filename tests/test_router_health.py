@@ -31,16 +31,25 @@ def _mean_server() -> ModelServer:
 
 
 def _get(url: str, path: str = "/v1/models/m:predict",
-         payload=None, timeout=10.0):
+         payload=None, timeout=10.0, session: str | None = None):
+    body = payload or {"instances": [[1.0, 3.0]]}
+    if session is not None:
+        body = dict(body, session=session)
     req = urllib.request.Request(
         url + path,
-        data=json.dumps(payload or {"instances": [[1.0, 3.0]]}).encode(),
+        data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"}, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read()), dict(r.headers)
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _served_count(server: ModelServer) -> int:
+    with urllib.request.urlopen(server.url + "/metrics", timeout=5) as r:
+        m = json.loads(r.read())
+    return sum(m["request_count"].values())
 
 
 def test_healthz_and_alive():
@@ -139,6 +148,118 @@ def test_failed_probe_reopens_with_doubled_holdoff():
         assert c.state == OPEN and c.open_s == pytest.approx(0.2)
     finally:
         r.stop()
+
+
+# -- session affinity (kvcache tentpole: placement half) ----------------------
+
+def test_session_affinity_pins_and_spreads():
+    """Requests carrying one session key all land on ONE replica (where
+    that session's prefix KV lives); many distinct keys spread across
+    the pool; keyless traffic keeps the round-robin spread."""
+    servers = [_mean_server() for _ in range(3)]
+    r = Router("t/aff")
+    try:
+        r.set_backends([s.port for s in servers])
+        for _ in range(8):
+            assert _get(r.url, session="sess-A")[0] == 200
+        counts = [_served_count(s) for s in servers]
+        assert sorted(counts) == [0, 0, 8], counts
+        assert r.affinity_hits == 8 and r.affinity_failovers == 0
+        # distinct sessions hash across the pool (rendezvous is a
+        # per-key permutation: 24 keys on 3 replicas miss one with
+        # probability (2/3)^24 ≈ 6e-5)
+        for i in range(24):
+            assert _get(r.url, session=f"other-{i}")[0] == 200
+        spread = [_served_count(s) for s in servers]
+        assert all(c > 0 for c in spread), spread
+        # keyless requests keep round-robin: 6 requests, 3 replicas,
+        # everyone serves exactly 2 more
+        base = [_served_count(s) for s in servers]
+        for _ in range(6):
+            assert _get(r.url)[0] == 200
+        deltas = [_served_count(s) - b for s, b in zip(servers, base)]
+        assert deltas == [2, 2, 2], deltas
+    finally:
+        r.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_session_header_beats_body_and_user_field_works():
+    a, b = _mean_server(), _mean_server()
+    r = Router("t/key")
+    try:
+        r.set_backends([a.port, b.port])
+        # the OpenAI `user` field is a valid session key on its own
+        for _ in range(5):
+            code, _, _ = _get(r.url, payload={
+                "instances": [[1.0, 3.0]], "user": "u-42"})
+            assert code == 200
+        counts = sorted([_served_count(a), _served_count(b)])
+        assert counts == [0, 5], counts
+        # an explicit X-Session-Key header overrides the body fields
+        req = urllib.request.Request(
+            r.url + "/v1/models/m:predict",
+            data=json.dumps({"instances": [[1.0, 3.0]],
+                             "user": "u-42"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Session-Key": "pinned-elsewhere-7"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        r.stop()
+        a.stop()
+        b.stop()
+
+
+def test_pinned_session_fails_over_without_503_and_repins():
+    """The satellite contract: a session pinned to a replica whose
+    circuit opens must keep getting 200s from another replica (no 503
+    while capacity remains), and once the affine replica's circuit
+    closes again the session re-pins to it — rendezvous is stateless,
+    so recovery IS re-pinning."""
+    servers = [_mean_server() for _ in range(3)]
+    ports = [s.port for s in servers]
+    r = Router("t/failover", failure_threshold=1, circuit_open_s=0.4)
+    try:
+        r.set_backends(ports)
+        for _ in range(4):
+            assert _get(r.url, session="sticky")[0] == 200
+        pinned = next(s for s in servers if _served_count(s) == 4)
+        # cut the path to the PINNED replica via an injected partition
+        # (the backend stays healthy — so it can RECOVER, unlike a
+        # stopped HTTP server); targeted at exactly that port
+        script = generate_fault_script(FaultScriptConfig(
+            seed=3, duration_s=30.0,
+            faults=(FaultSpec("partition", 1, (0.0, 0.0), (0.5, 0.5),
+                              target=str(pinned.port)),)),
+            name="aff-part")
+        inj = FaultInjector(script)
+        r.set_fault_injector(inj)
+        inj.start()
+        # while the partition window is live: every request still 200,
+        # served by a NON-affine replica (failover, not 503)
+        t_end = time.monotonic() + 0.9
+        statuses = []
+        while time.monotonic() < t_end:
+            statuses.append(_get(r.url, session="sticky")[0])
+        assert statuses and all(c == 200 for c in statuses), statuses
+        assert r.affinity_failovers >= 1
+        # partition over + hold-off expired: the half-open probe closes
+        # the circuit and the session re-pins to its affine replica
+        time.sleep(0.6)
+        before = _served_count(pinned)
+        repin_statuses = [_get(r.url, session="sticky")[0]
+                          for _ in range(6)]
+        assert all(c == 200 for c in repin_statuses)
+        assert _served_count(pinned) >= before + 5   # the probe request
+        # may have gone elsewhere once; after it, the pin is back
+        assert r.circuit_states()[pinned.port] == CLOSED
+    finally:
+        r.stop()
+        for s in servers:
+            s.stop()
 
 
 # -- controller crash restart -------------------------------------------------
