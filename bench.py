@@ -313,10 +313,12 @@ def main() -> None:
     with open(extras_path, "w") as f:
         # schema 2 = the record carries serving_scenarios; schema 3 adds
         # rl_anakin; schema 4 adds serving_chaos; schema 5 adds
-        # serving_prefix_cache. The floor gate only demands a section's
-        # metrics from records new enough to know about it (older
-        # committed records stay valid under --check).
-        json.dump({"schema": 5, "headline": headline, "extras": extras},
+        # serving_prefix_cache; schema 6 adds the HTTP-path chaos
+        # measurement (serving_chaos.http — real socket clients). The
+        # floor gate only demands a section's metrics from records new
+        # enough to know about it (older committed records stay valid
+        # under --check).
+        json.dump({"schema": 6, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -382,6 +384,17 @@ PERF_FLOORS = {
     # guards against total collapse (zero goodput under fault); raise it
     # once the first hardware record lands.
     "chaos_crash_goodput_retained": 0.02,
+    # serving_chaos.http (r11): enforced only on schema>=6 records.
+    # stream_completion_frac is the streaming zero-duplicate/zero-lost
+    # CONTRACT measured at a real socket — every SSE stream through a
+    # mid-window engine crash delivers a complete response byte-identical
+    # to the uncrashed run, with exactly one [DONE] and one usage object —
+    # so its floor is exactly 1.0 (deterministic, no noise headroom).
+    "chaos_http_stream_completion": 1.0,
+    # conservative, same rationale as chaos_crash_goodput_retained: the
+    # crash costs restart backoff (+ full rewarm on TPU) measured at the
+    # socket; the floor only guards against total collapse.
+    "chaos_http_goodput_retained": 0.02,
     # serving_prefix_cache (r10): enforced only on schema>=5 records.
     # The shared_prefix_chat scenario is built so that most admissions
     # extend a cached chain (turn >= 2 always should; turn-1 hits ride
@@ -445,6 +458,13 @@ def check_floors(path: str) -> list[str]:
                            "terminal_frac")))
         checks.append(("chaos_crash_goodput_retained",
                        get(ex, "serving_chaos", "crash_midstream",
+                           "goodput_retained")))
+    if rec.get("schema", 1) >= 6:
+        checks.append(("chaos_http_stream_completion",
+                       get(ex, "serving_chaos", "http",
+                           "stream_completion_frac")))
+        checks.append(("chaos_http_goodput_retained",
+                       get(ex, "serving_chaos", "http",
                            "goodput_retained")))
     if rec.get("schema", 1) >= 5:
         checks.append(("prefix_cache_hit_rate",
@@ -1641,7 +1661,132 @@ def serving_chaos_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
     # in the committed script) but consumed by the router tests instead
     out["note"] = ("partition events are router-level — exercised by "
                    "tests/test_router_health.py, not this replay")
+    # -- HTTP-path chaos (ISSUE 12, schema 6): the same crash measured
+    # through a REAL socket client instead of the in-process engine
+    if budget is not None and budget.expired():
+        out.setdefault("skipped_for_budget", []).append("http")
+    else:
+        try:
+            out["http"] = _serving_chaos_http(on_tpu, cfg, budget)
+        except Exception as e:
+            out["http_error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+def _serving_chaos_http(on_tpu: bool, cfg,
+                        budget: Budget | None = None) -> dict:
+    """HTTP-path chaos measurement (ISSUE 12): a supervised LLMModel
+    behind ModelServer + Router, driven by REAL socket SSE clients while
+    the committed `crash_midstream` script kills the engine mid-window.
+    Committed metrics:
+
+    - stream_completion_frac: streams that delivered a complete,
+      BYTE-IDENTICAL response (vs the same request on the uncrashed
+      server) with exactly one [DONE] and one usage object — the
+      zero-duplicate/zero-lost streaming contract, floor exactly 1.0;
+    - goodput_retained: delivered tok/s under fault / clean tok/s
+      (includes restart backoff + replay, measured at the socket);
+    - mttr_s / restarts / keepalives: the recovery the client actually
+      rode through (keepalive comments are what held the connections).
+    """
+    import concurrent.futures
+
+    import numpy as np
+
+    from kubeflow_tpu.chaos import load_fault_script, script_sha256
+    from kubeflow_tpu.loadgen import stream_completion
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.model import ModelRepository
+    from kubeflow_tpu.serving.router import Router
+    from kubeflow_tpu.serving.server import ModelServer
+
+    model_cfg = {k: getattr(cfg, k) for k in
+                 ("vocab_size", "d_model", "n_layers", "n_heads",
+                  "n_kv_heads", "d_ff", "max_seq_len")}
+    if on_tpu:
+        eng_kw = dict(n_slots=8, max_len=512, buckets=(64, 128, 256),
+                      decode_chunk=8)
+        sup_cfg = dict(stall_timeout_s=5.0, backoff_base_s=0.1,
+                       backoff_cap_s=2.0)   # rewarm default True: MTTR
+        # includes the full program-menu warmup, the honest number
+        n_req, max_tokens, lens = 16, 64, (48, 96, 200)
+    else:
+        eng_kw = dict(n_slots=4, max_len=128, buckets=(16, 32),
+                      decode_chunk=8)
+        sup_cfg = dict(stall_timeout_s=5.0, backoff_base_s=0.02,
+                       backoff_cap_s=0.2, rewarm=False)
+        n_req, max_tokens, lens = 8, 24, (6, 12, 24)
+    rng = np.random.default_rng(7)
+    prompts = [[int(t) for t in
+                rng.integers(1, cfg.vocab_size,
+                             int(lens[i % len(lens)]))]
+               for i in range(n_req)]
+    m = LLMModel("llm", model=model_cfg, seed=0,
+                 supervisor=sup_cfg, sse_keepalive_s=0.25, **eng_kw)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    router = Router("bench/chaos-http")
+    router.set_backends(server.port)
+
+    def drive(min_wall: float) -> tuple[float, list[tuple[int, dict]]]:
+        """Waves of concurrent SSE streams (prompt index attached) until
+        `min_wall` elapses — so a fault scheduled inside the window
+        provably fires while streams are live, on CPU dims too."""
+        t0 = time.monotonic()
+        res: list[tuple[int, dict]] = []
+        while True:
+            with concurrent.futures.ThreadPoolExecutor(4) as ex:
+                res.extend(ex.map(
+                    lambda ip: (ip[0], stream_completion(
+                        router.port,
+                        {"model": "llm", "prompt": ip[1],
+                         "max_tokens": max_tokens, "temperature": 0.0},
+                        timeout_s=300.0)),
+                    enumerate(prompts)))
+            if time.monotonic() - t0 >= min_wall:
+                return time.monotonic() - t0, res
+
+    # the committed script places the crash at ~0.4 of its window; the
+    # drive runs past 0.6×window so the crash provably lands while
+    # streams are in flight, and the run drains every stream it opened
+    window = 30.0 if on_tpu else 2.0
+    try:
+        clean_wall, clean = drive(0.0)   # one wave: the byte oracle
+        ref = {i: r["token_ids"] for i, r in clean}
+        clean_toks = sum(len(r["token_ids"]) for _, r in clean)
+        script = load_fault_script("crash_midstream", duration_s=window)
+        m.supervisor.arm_faults(script)
+        crash_wall, crash = drive(0.6 * window)
+        crash_toks = sum(len(r["token_ids"]) for _, r in crash)
+        acc = m.supervisor.accounting()
+        ok = [r["token_ids"] == ref[i] and r["done_count"] == 1
+              and r["usage_count"] == 1 and not r["errors"]
+              and r["finish_reason"] in ("stop", "length")
+              for i, r in crash]
+        return {
+            "n_streams": len(crash),
+            "max_tokens": max_tokens,
+            "script_sha256": script_sha256(script),
+            "events_fired": m.supervisor.injector.log(),
+            "crash_fired": bool(m.supervisor.injector.log()),
+            "clean": {"wall_s": round(clean_wall, 3),
+                      "tok_per_s": round(clean_toks / clean_wall, 2)},
+            "crash": {"wall_s": round(crash_wall, 3),
+                      "tok_per_s": round(crash_toks / crash_wall, 2),
+                      "keepalives": sum(r["keepalives"] for _, r in crash),
+                      "restarts": acc["restarts"],
+                      "mttr_s": acc["mttr_s"],
+                      "lost": acc["lost"]},
+            "stream_completion_frac": round(sum(ok) / len(ok), 4),
+            "goodput_retained": (round(
+                (crash_toks / crash_wall) / (clean_toks / clean_wall), 4)
+                if clean_toks else None),
+        }
+    finally:
+        router.stop()
+        server.stop()
+        m.unload()
 
 
 def serving_prefix_cache_bench(on_tpu: bool,

@@ -7,7 +7,9 @@ the SERVING plane the same story, as committed, replayable artifacts:
 
 - `chaos.script`   — seeded byte-deterministic fault scripts (same
   splitmix64 + sha256-pin contract as `loadgen/trace.py`); committed
-  configs in `chaos/configs/` (`crash_midstream`, `stall_and_partition`).
+  configs in `chaos/configs/` (`crash_midstream`, `stall_and_partition`,
+  `zone_outage` — the r11 fleet drill: a whole zone of replicas
+  unreachable at once).
 - `chaos.injector` — the runtime poll-side: components ask "is this
   fault due for me now"; fired events are logged for the bench record.
   Also the process-global I/O fault hook `training/checkpoint.py`'s

@@ -38,8 +38,13 @@ CONFIG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 #: the injectable fault vocabulary. One-shot kinds fire once at their
 #: instant; windowed kinds are ACTIVE for [at_s, at_s + duration_s).
+#: `zone_outage` (r11, fleet chaos) is a windowed fault whose target
+#: names a ZONE: every backend the router maps into that zone becomes
+#: unreachable for the window — many circuits open at once (target None
+#: = every zone, the full-fleet drill).
 ONE_SHOT_KINDS = ("backend_crash", "ckpt_io_fail")
-WINDOWED_KINDS = ("decode_stall", "heartbeat_drop", "partition")
+WINDOWED_KINDS = ("decode_stall", "heartbeat_drop", "partition",
+                  "zone_outage")
 FAULT_KINDS = ONE_SHOT_KINDS + WINDOWED_KINDS
 
 

@@ -17,6 +17,8 @@ disconnects, and SLO attainment under all of it.
 """
 
 from kubeflow_tpu.loadgen.control import SLOController, pick_decode_chunk
+from kubeflow_tpu.loadgen.http_client import (run_trace_http,
+                                              stream_completion)
 from kubeflow_tpu.loadgen.runner import run_scenario, run_trace
 from kubeflow_tpu.loadgen.scenarios import (SCENARIOS, Scenario,
                                             load_scenario, miniature)
@@ -28,6 +30,7 @@ from kubeflow_tpu.loadgen.trace import (Trace, TraceConfig, TraceRequest,
 __all__ = [
     "Trace", "TraceConfig", "TraceRequest", "generate_trace",
     "trace_bytes", "trace_sha256", "RequestRecord", "summarize",
-    "run_scenario", "run_trace", "SLOController", "pick_decode_chunk",
+    "run_scenario", "run_trace", "run_trace_http", "stream_completion",
+    "SLOController", "pick_decode_chunk",
     "SCENARIOS", "Scenario", "load_scenario", "miniature",
 ]

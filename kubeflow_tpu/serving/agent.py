@@ -258,9 +258,17 @@ class _Journaled:
     first_token_s: float | None = None
     finish_s: float | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
+    #: per-token logprobs paired 1:1 with `tokens` (the streaming HTTP
+    #: path emits (token, logprob) pairs; they must survive a restart
+    #: together or the resumed stream fabricates values)
+    lps: list[float] = dataclasses.field(default_factory=list)
     #: tokens delivered by PREVIOUS engine generations (the journaled
     #: prefix an unseeded continuation resumes over)
     base_tokens: list[int] = dataclasses.field(default_factory=list)
+    base_lps: list[float] = dataclasses.field(default_factory=list)
+    #: top-N alternatives captured at completion (None until then; the
+    #: resumed-tail positions of an unseeded retry pad with {})
+    top_lps: list[dict] | None = None
     engine_rid: int | None = None
     #: tokens seen from the CURRENT engine generation (watchdog signal:
     #: a replay regenerating its old prefix is progress even though the
@@ -523,6 +531,8 @@ class EngineSupervisor:
                     self._counts["replayed"] += 1
                 e.base_tokens = []
                 e.tokens = list(e.verify_prefix or ())
+                e.lps = list(e.base_lps) + list(e.lps)
+                e.base_lps = []
                 e.engine_seen = 0
                 e.engine_rid = self.engine.submit(
                     list(e.prompt), e.max_new, **e.kw)
@@ -532,12 +542,16 @@ class EngineSupervisor:
                 if remaining <= 0:
                     e.tokens = done
                     e.base_tokens = []
+                    e.lps = list(e.base_lps) + list(e.lps)
+                    e.base_lps = []
                     self._finalize(e, "length", time.monotonic())
                     return
                 e.chain += ["cancelled", "retried"]
                 self._counts["retried"] += 1
                 e.base_tokens = done
                 e.tokens = []
+                e.base_lps = list(e.base_lps) + list(e.lps)
+                e.lps = []
                 e.engine_seen = 0
                 e.engine_rid = self.engine.submit(
                     list(e.prompt) + done, remaining, **e.kw)
@@ -564,8 +578,18 @@ class EngineSupervisor:
                 if len(part) > e.engine_seen:
                     e.engine_seen = len(part)
                     self._last_progress = now
-                if len(part) > len(e.tokens):
-                    e.tokens = list(part)
+                # tokens and logprobs advance TOGETHER, to the length
+                # both have reached: if the engine's append of token B's
+                # logprob is ever observed mid-flight, token B is held
+                # back one poll rather than journaled with a fabricated
+                # pair — a crash at that instant must not freeze a
+                # misaligned (base_tokens, base_lps) prefix into the
+                # unseeded-retry path
+                part_lp = self.engine.partial_logprobs(e.engine_rid)
+                n = min(len(part), len(part_lp))
+                if n > len(e.tokens):
+                    e.tokens = list(part[:n])
+                    e.lps = list(part_lp[:n])
                     if e.first_token_s is None:
                         e.first_token_s = now
                 if self.engine.is_done(e.engine_rid):
@@ -579,6 +603,15 @@ class EngineSupervisor:
                                      else "replay_mismatch"] += 1
                         e.verify_prefix = None
                     e.tokens = list(result)
+                    e.lps = list(self.engine.partial_logprobs(
+                        e.engine_rid))[:len(result)]
+                    try:
+                        e.top_lps = list(
+                            self.engine.result_top_logprobs(e.engine_rid))
+                    except (ValueError, KeyError):
+                        # engine built with logprobs_topk=0, or cancelled
+                        # before completion: no alternatives to keep
+                        e.top_lps = None
                     self.engine.release(e.engine_rid)
                     e.engine_rid = None
                     self._finalize(e, reason, now)
@@ -649,6 +682,34 @@ class EngineSupervisor:
                 return []
             return list(e.base_tokens) + list(e.tokens)
 
+    def partial_logprobs(self, rid: int) -> list[float]:
+        """Logprobs of partial_result(rid), journaled alongside the
+        tokens — never longer than the token list, so the SSE pairing
+        guard in llm_runtime keeps working through a restart."""
+        with self._lock:
+            e = self._journal.get(rid)
+            if e is None:
+                return []
+            return list(e.base_lps) + list(e.lps)
+
+    def result_logprobs(self, rid: int) -> list[float]:
+        with self._lock:
+            e = self._journal[rid]
+            if not e.terminal:
+                raise KeyError(f"request {rid} not finished")
+            return list(e.base_lps) + list(e.lps)
+
+    def result_top_logprobs(self, rid: int) -> list[dict[int, float]]:
+        """Top-N alternatives. An unseeded resume pads the pre-crash
+        prefix positions with {} — the original generation's
+        alternatives died with the engine that sampled them."""
+        with self._lock:
+            e = self._journal[rid]
+            if not e.terminal:
+                raise KeyError(f"request {rid} not finished")
+            return ([{} for _ in e.base_tokens]
+                    + [dict(d) for d in (e.top_lps or ())])
+
     def finish_reason(self, rid: int) -> str:
         with self._lock:
             e = self._journal.get(rid)
@@ -707,6 +768,25 @@ class EngineSupervisor:
             return self.engine.decode_chunk
         return self._chunk or 0
 
+    @property
+    def decode_chunk_max(self) -> int:
+        if self.engine is not None:
+            return self.engine.decode_chunk_max
+        return self._chunk or 1
+
+    # cache-introspection passthroughs: llm_runtime sniffs these to decide
+    # whether the usage object carries cached_tokens at all (engine down
+    # reads as cache-off — conservative, never fabricated)
+    @property
+    def kvcache(self):
+        return (getattr(self.engine, "kvcache", None)
+                if self.engine is not None else None)
+
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        return bool(getattr(self.engine, "prefix_cache_enabled", False)
+                    if self.engine is not None else False)
+
     def set_decode_chunk(self, chunk: int) -> int:
         self._chunk = chunk
         if self.engine is not None:
@@ -738,6 +818,7 @@ class EngineSupervisor:
             c = dict(self._counts)
             inflight = sum(1 for e in self._journal.values()
                            if not e.terminal)
+            journal_depth = len(self._journal)
         terminal = c["completed"] + c["cancelled"] + c["rejected"]
         mttrs = [o["mttr_s"] for o in self.outages
                  if o.get("mttr_s") is not None]
@@ -749,4 +830,10 @@ class EngineSupervisor:
             "outages": [dict(o) for o in self.outages],
             "mttr_s": (round(sum(mttrs) / len(mttrs), 4)
                        if mttrs else None),
+            # the /healthz supervisor section (dataplane tentpole): the
+            # controller's dead-replica pruning and fleet tooling read
+            # these without a model round-trip
+            "permanent_failed": self.failed,
+            "last_mttr_s": mttrs[-1] if mttrs else None,
+            "journal_depth": journal_depth,
         }

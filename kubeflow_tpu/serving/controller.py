@@ -377,16 +377,35 @@ class InferenceServiceController(Controller):
     _BACKOFF_CAP_S = 30.0
     _CRASH_RESET_S = 60.0
 
+    @staticmethod
+    def _replica_healthy(inst: _Instance) -> bool:
+        """The pruning probe reads the replica's /healthz payload (the
+        in-process `ModelServer.health()` — byte-identical to the HTTP
+        probe), not just the serving thread's liveness bit: a replica
+        whose HTTP thread still answers but whose EngineSupervisor has
+        permanently failed (restart budget exhausted) can never serve
+        again and must be pruned/restarted the same as a dead pod — a
+        fresh instance gets a fresh supervisor with a fresh budget."""
+        try:
+            h = inst.server.health()
+        except Exception:
+            return False   # a health probe that errors IS unhealthy
+        if not h.get("alive"):
+            return False
+        return not any(s.get("permanent_failed")
+                       for s in (h.get("supervisor") or {}).values())
+
     def _prune_crashed(self, key: tuple[str, str, str],
                        replicas: list[_Instance]) -> list[_Instance]:
-        """Drop replicas whose server died (the pod-crash analog) and
+        """Drop replicas whose /healthz probe fails — the server thread
+        died (pod crash) or its supervisor permanently failed — and
         advance the component's crash-backoff state."""
-        dead = [i for i in replicas if not i.server.alive]
+        dead = [i for i in replicas if not self._replica_healthy(i)]
         if not dead:
             return replicas
         with self._lock:
             kept = [i for i in self._instances.get(key, [])
-                    if i.server.alive]
+                    if self._replica_healthy(i)]
             self._instances[key] = kept
             cb = self._crash_backoff.setdefault(
                 key, {"count": 0, "next_t": 0.0, "last": 0.0})

@@ -146,7 +146,17 @@ class GrpcInferenceServer:
         return pb.ServerLiveResponse(live=True)
 
     def _server_ready(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # the HTTP /v2/health/ready contract, same gate: LLM models
+        # serve through their EngineSupervisor (the gRPC dataplane
+        # shares the Model with HTTP, so every ModelInfer already
+        # submits through it), and a supervisor whose restart budget is
+        # exhausted makes this replica permanently not-ready —
+        # ModelRepository.permanently_failed is the ONE definition both
+        # frontends consult
+        ready = all(self.repository.ready(n)
+                    for n in self.repository.names()) \
+            and not self.repository.permanently_failed()
+        return pb.ServerReadyResponse(ready=ready)
 
     def _model_ready(self, request, context):
         return pb.ModelReadyResponse(

@@ -18,6 +18,15 @@ V1/V2 payload: {"prompt_tokens": [...], "max_new_tokens": N} (or a list of
 those). The engine thread runs continuous batching underneath, so
 concurrent HTTP requests share decode steps; per-request TTFT lands in
 Model.metrics() for the KServe-TTFT baseline metric (config #5).
+
+Unified dataplane (ISSUE 12): by default the engine sits behind an
+`EngineSupervisor` — every HTTP/SSE/gRPC/predict submission is
+journaled, a mid-stream engine crash or stall triggers
+journal→restart→idempotent replay while the SSE connection stays open
+(keepalive comments during the restart window), and token emission
+resumes from the journaled prefix with zero duplicate and zero lost
+tokens. Greedy/seeded output through a crash is byte-identical to an
+uncrashed run (the supervisor verifies the replayed prefix).
 """
 
 from __future__ import annotations
@@ -56,6 +65,9 @@ class LLMModel(Model):
                  pipeline_decode: bool = True,
                  compile_cache: str | None = None,
                  compile_cache_min_secs: float | None = None,
+                 supervised: bool = True,
+                 supervisor: dict[str, Any] | None = None,
+                 sse_keepalive_s: float = 15.0,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -104,6 +116,23 @@ class LLMModel(Model):
         # seconds on a warm cache
         self._compile_cache = compile_cache
         self._compile_cache_min_secs = compile_cache_min_secs
+        # config.supervised (default ON — the unified-dataplane contract):
+        # the engine sits behind serving/agent.EngineSupervisor, so every
+        # HTTP/gRPC/predict submission is journaled and a mid-stream
+        # engine crash replays instead of killing the client connection.
+        # config.supervisor tunes it: {stall_timeout_s, stall_min_steps,
+        # backoff_base_s, backoff_cap_s, max_restarts, stability_s,
+        # rewarm}. rewarm (default True) re-runs the full warmup menu on
+        # every restart — recovery is slower but no live request ever
+        # waits on XLA; rewarm=False restarts cold and lets the replay
+        # compile only the programs it touches (the fast-lane setting).
+        self._supervised = supervised
+        self._sup_cfg = dict(supervisor or {})
+        # config.sse_keepalive_s: max silence on a token stream before a
+        # `: keepalive` SSE comment goes out — during a crash-restart
+        # window the connection stays provably alive instead of tripping
+        # client/proxy read timeouts
+        self._sse_keepalive_s = float(sse_keepalive_s)
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -172,26 +201,54 @@ class LLMModel(Model):
         else:
             cfg = llama.LlamaConfig(**self._cfg_overrides)
             params = self._load_params(cfg)
-        self._engine = LLMEngine(params, cfg, n_slots=self._n_slots,
-                                 max_len=self._max_len,
-                                 buckets=self._buckets, eos_id=self._eos_id,
-                                 mesh=mesh,
-                                 decode_chunk=self._decode_chunk,
-                                 prefix_cache=self._prefix_cache,
-                                 max_prefixes=self._max_prefixes,
-                                 prefix_cache_blocks=self._prefix_cache_blocks,
-                                 quantize=self._quantize,
-                                 kv_quantize=self._kv_quantize,
-                                 speculative=self._speculative,
-                                 spec_ngram=self._spec_ngram,
-                                 spec_adaptive=self._spec_adaptive,
-                                 adapters=self._load_adapters(cfg),
-                                 logprobs_topk=self._logprobs_topk,
-                                 sample_k_max=self._sample_k_max,
-                                 pipeline_decode=self._pipeline_decode)
-        # compile the whole program menu at load (the Knative cold-start
-        # analog): no live request ever waits on XLA
-        self._engine.warmup()
+        engine_kw = dict(n_slots=self._n_slots,
+                         max_len=self._max_len,
+                         buckets=self._buckets, eos_id=self._eos_id,
+                         mesh=mesh,
+                         decode_chunk=self._decode_chunk,
+                         prefix_cache=self._prefix_cache,
+                         max_prefixes=self._max_prefixes,
+                         prefix_cache_blocks=self._prefix_cache_blocks,
+                         quantize=self._quantize,
+                         kv_quantize=self._kv_quantize,
+                         speculative=self._speculative,
+                         spec_ngram=self._spec_ngram,
+                         spec_adaptive=self._spec_adaptive,
+                         adapters=self._load_adapters(cfg),
+                         logprobs_topk=self._logprobs_topk,
+                         sample_k_max=self._sample_k_max,
+                         pipeline_decode=self._pipeline_decode)
+        # read, never pop: a second load() on this instance (unload →
+        # reload is a legal Model lifecycle) must see the same config
+        rewarm = bool(self._sup_cfg.get("rewarm", True))
+        warmed: list[bool] = []
+
+        def engine_factory():
+            # the only sanctioned LLMEngine construction site on the
+            # serving dataplane (scripts/check_dataplane.py enforces
+            # this): engines are born inside a supervisor factory, so a
+            # crash always has a recovery story. The first build always
+            # warms (no live request waits on XLA at load); restarts
+            # rewarm per config.supervisor.rewarm.
+            eng = LLMEngine(params, cfg, **engine_kw)
+            if rewarm or not warmed:
+                eng.warmup()
+                warmed.append(True)
+            return eng
+
+        if self._supervised:
+            from kubeflow_tpu.serving.agent import EngineSupervisor
+
+            # a conservative default stall watchdog for the HTTP path:
+            # the supervisor's own 2 s default is tuned for the bench's
+            # warmed miniature engines, not arbitrary deployments
+            sup_kw = {k: v for k, v in self._sup_cfg.items()
+                      if k != "rewarm"}
+            sup_kw.setdefault("stall_timeout_s", 10.0)
+            self._engine = EngineSupervisor(engine_factory, **sup_kw)
+        else:
+            # escape hatch for benches/tests measuring the bare engine
+            self._engine = engine_factory()
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"llm-engine-{self.name}")
@@ -299,7 +356,22 @@ class LLMModel(Model):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._engine is not None:
+            try:
+                self._engine.close()   # frees device buffers / journal
+            except Exception:
+                pass
         super().unload()
+
+    @property
+    def supervisor(self):
+        """The EngineSupervisor under this model (None on the
+        supervised=False escape hatch) — the chaos harness arms fault
+        scripts here, and healthz reads its accounting."""
+        from kubeflow_tpu.serving.agent import EngineSupervisor
+
+        return (self._engine
+                if isinstance(self._engine, EngineSupervisor) else None)
 
     # -- inference -----------------------------------------------------------
 
@@ -444,6 +516,7 @@ class LLMModel(Model):
                      info: dict | None = None):
         deadline = time.monotonic() + self._timeout_s
         sent = 0
+        last_emit = time.monotonic()
         try:
             while True:
                 done = self._engine.is_done(rid)   # BEFORE the drain: a
@@ -460,8 +533,18 @@ class LLMModel(Model):
                     yield toks[sent], (lps[sent] if sent < len(lps)
                                        else 0.0)
                     sent += 1
+                    last_emit = time.monotonic()
                 if done:
                     break
+                if time.monotonic() - last_emit >= self._sse_keepalive_s:
+                    # silence — typically a crash-restart window (backoff
+                    # + rewarm) with the journal holding this stream: a
+                    # (None, None) sentinel tells the HTTP layer to write
+                    # an SSE keepalive comment so the client connection
+                    # survives until token emission resumes, and gives it
+                    # a beat to probe for client disconnect
+                    yield None, None
+                    last_emit = time.monotonic()
                 self._check_alive(deadline)
                 time.sleep(0.001)
         except BaseException:
@@ -471,12 +554,24 @@ class LLMModel(Model):
             self._engine.cancel(rid)
             self._abandoned.add(rid)
             raise
+        reason = self._engine.finish_reason(rid)
+        if reason == "cancelled" and getattr(self._engine, "failed", False):
+            # supervisor exhausted its restart budget mid-stream: the
+            # client must see a TERMINAL error event, not a silent
+            # "cancelled" that reads like its own disconnect (and never a
+            # hang). The raise reaches _stream_completion's generic
+            # error-chunk path; the abandoned sweep releases the rid.
+            self._abandoned.add(rid)
+            raise RuntimeError(
+                "backend permanently failed (supervisor restart budget "
+                "exhausted) after "
+                f"{len(self._engine.partial_result(rid))} tokens")
         if info is not None:
             cached = self._cached_tokens(rid)
             if cached is not None:
                 info["cached_tokens"] = cached
         if on_finish is not None:
-            on_finish(self._engine.finish_reason(rid))
+            on_finish(reason)
         self._engine.release(rid)
 
     def complete(self, payload: Any) -> dict[str, Any]:

@@ -127,6 +127,22 @@ class ModelRepository:
         except ModelError:
             return False
 
+    def permanently_failed(self) -> bool:
+        """True when any model's EngineSupervisor has exhausted its
+        restart budget — the replica can never serve that model again
+        and must leave rotation. THE one readiness gate both frontends
+        (HTTP /v2/health/ready and gRPC ServerReady) consult, so the two
+        dataplanes cannot drift on what "permanently failed" means."""
+        for name in self.names():
+            try:
+                mm = self.get(name).metrics() or {}
+            except Exception:
+                continue   # a model without metrics is not a verdict
+            sup = mm.get("supervisor")
+            if sup and bool(sup.get("permanent_failed", False)):
+                return True
+        return False
+
 
 # -- serving runtimes ---------------------------------------------------------
 

@@ -27,7 +27,19 @@ One Router per InferenceService: an HTTP reverse proxy that
     next-ranked healthy replica takes over (no 503 while capacity
     remains), and the moment the circuit closes again the original
     ranking — and the pin — restores itself. Keyless requests keep the
-    round-robin spread.
+    round-robin spread;
+  - relays SSE completion streams PROGRESSIVELY (the unified-dataplane
+    tentpole: the streaming path crosses the router too) with
+    stream-aware failover — a backend failure before the first token
+    reached the client retries the same request on the next candidate
+    (affinity order preserved), a failure after first token emits a
+    typed `mid_stream_failure` event carrying `tokens_delivered` so the
+    client can resume, then [DONE];
+  - groups backends into ZONES (`set_zones`) so a scripted
+    `zone_outage` fault window makes a whole zone unreachable at once —
+    the fleet-chaos drill: many circuits open simultaneously, traffic
+    fails over to the surviving zone, and recovery is the breakers'
+    ordinary half-open cycle.
 """
 
 from __future__ import annotations
@@ -152,8 +164,15 @@ class Router:
         self.last_request_time: float = 0.0
         # optional chaos injector: an active "partition" event makes the
         # target backend unreachable from THIS router (the fault is in the
-        # network path, so it must be injected here, not in the backend)
+        # network path, so it must be injected here, not in the backend);
+        # an active "zone_outage" event does the same for every backend
+        # in the targeted zone at once (fleet chaos: many circuits open
+        # simultaneously)
         self.fault_injector = None
+        self._zone_of: dict[int, str] = {}
+        # stream relay accounting (the stream-aware failover surface)
+        self.stream_failovers = 0      # retried before first token
+        self.stream_midfailures = 0    # typed error event after first token
         # concurrency tracking for the autoscaler (Knative queue-proxy
         # reports concurrency; here the router IS the queue-proxy)
         self.inflight = 0
@@ -169,9 +188,12 @@ class Router:
             def _proxy(self):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
-                code, body, extra = router.forward(
+                out = router.forward(
                     self.command, self.path, raw,
-                    headers=dict(self.headers))
+                    headers=dict(self.headers), sink=self)
+                if out is None:
+                    return   # SSE relay already wrote this socket
+                code, body, extra = out
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -218,6 +240,18 @@ class Router:
 
     def set_fault_injector(self, injector) -> None:
         self.fault_injector = injector
+
+    def set_zones(self, zones: dict[str, Any] | None) -> None:
+        """Assign backend ports to named zones (fleet chaos): while a
+        `zone_outage` fault window targeting a zone is active, every
+        port in it is unreachable from this router — the whole zone's
+        circuits trip at once. A script target of None takes out every
+        zone (full-fleet outage)."""
+        with self._lock:
+            self._zone_of = {}
+            for zone, ports in (zones or {}).items():
+                for p in self._ports(ports):
+                    self._zone_of[p] = str(zone)
 
     def circuit_states(self) -> dict[int, str]:
         """Port -> breaker state (metrics / tests)."""
@@ -313,46 +347,153 @@ class Router:
                 c.on_failure(time.monotonic())
 
     @staticmethod
-    def _session_key_of(headers: dict[str, str] | None,
-                        body: bytes) -> str | None:
-        """Stable session key for affinity: the `X-Session-Key` header
-        wins (explicit client intent), else the JSON body's `session`
-        field, else the OpenAI `user` field (one end user = one
-        conversation's worth of shared prefixes). Body sniffing is
-        bounded and best-effort — a non-JSON or huge body just routes
-        keyless."""
-        if headers:
-            for k, v in headers.items():
-                if k.lower() == "x-session-key" and v:
-                    return str(v)
+    def _request_meta(headers: dict[str, str] | None,
+                      body: bytes) -> tuple[str | None, bool]:
+        """ONE bounded, best-effort body sniff per request →
+        (session_key, wants_stream). Session key for affinity: the
+        `X-Session-Key` header wins (explicit client intent), else the
+        JSON body's `session` field, else the OpenAI `user` field (one
+        end user = one conversation's worth of shared prefixes).
+        wants_stream is the OpenAI `stream: true` flag — those requests
+        get the stream-aware failover contract. A non-JSON or huge body
+        routes keyless and buffered."""
+        d = None
         if body and len(body) <= 1 << 20 and body.lstrip()[:1] == b"{":
             try:
                 d = json.loads(body)
             except ValueError:
-                return None
+                d = None
+            if not isinstance(d, dict):
+                d = None
+        wants_stream = bool(d and d.get("stream"))
+        if headers:
+            for k, v in headers.items():
+                if k.lower() == "x-session-key" and v:
+                    return str(v), wants_stream
+        if d:
             for field in ("session", "user"):
-                v = d.get(field) if isinstance(d, dict) else None
+                v = d.get(field)
                 if isinstance(v, str) and v:
-                    return v
-        return None
+                    return v, wants_stream
+        return None, wants_stream
+
+    @staticmethod
+    def _send_stream_headers(sink, status: int = 200) -> None:
+        sink.send_response(status)
+        sink.send_header("Content-Type", "text/event-stream")
+        sink.send_header("Cache-Control", "no-cache")
+        sink.send_header("Connection", "close")
+        sink.end_headers()
+        sink.close_connection = True
+
+    def _stream_error_event(self, sink, port: int, delivered: int,
+                            err: str | None) -> str:
+        """The committed stream cannot be retried: emit the typed
+        mid-stream error event (tokens_delivered = the journaled prefix
+        length the client can resume from) and close it out. Always
+        returns "failed": the BACKEND failed, and that verdict (what the
+        breaker consumes) must not be laundered into "client_gone" just
+        because the client also vanished before the event could be
+        written."""
+        with self._lock:
+            self.stream_midfailures += 1
+        payload = {"error": {
+            "type": "mid_stream_failure",
+            "tokens_delivered": delivered,
+            "message": ("backend connection lost mid-stream"
+                        + (f": {err}" if err else "")),
+            "backend": port}}
+        try:
+            sink.wfile.write(b"data: " + json.dumps(payload).encode()
+                             + b"\n\ndata: [DONE]\n\n")
+            sink.wfile.flush()
+        except OSError:
+            pass   # client gone too; the backend verdict stands
+        return "failed"
+
+    def _relay_stream(self, sink, resp, port: int, headers_sent: bool
+                      ) -> tuple[str, int, bool]:
+        """Relay one SSE response onto the client socket, progressively.
+        The 200 + SSE headers go out on the backend's first line, and
+        COMMENT lines (`: keepalive` — a supervised backend mid-restart)
+        relay immediately so the client connection never starves; but
+        the stream only COMMITS on the first DATA event — a backend
+        dying before any data event is retryable on the next replica
+        ("retry": the client saw no events, and the next attempt simply
+        continues the already-started SSE body without resending
+        headers). After the first data event a backend failure becomes a
+        typed `mid_stream_failure` error event carrying
+        `tokens_delivered` followed by [DONE] ("failed"); a stream that
+        relays through its [DONE] is "done". Returns (outcome,
+        tokens_delivered, headers_sent)."""
+        delivered = 0            # token events relayed to the client
+        committed = False        # a data event reached the client
+        saw_done = False
+        err: str | None = None
+        try:
+            while True:
+                try:
+                    line = resp.readline()
+                except OSError as e:
+                    err = str(e)
+                    break
+                if not line:
+                    break        # backend EOF
+                if not headers_sent:
+                    self._send_stream_headers(sink, resp.status)
+                    headers_sent = True
+                try:
+                    sink.wfile.write(line)
+                    sink.wfile.flush()
+                except OSError:
+                    return "client_gone", delivered, headers_sent
+                if line.startswith(b"data: "):
+                    committed = True
+                    if line.strip() == b"data: [DONE]":
+                        saw_done = True
+                    elif b'"token_id"' in line:
+                        delivered += 1
+        except Exception as e:   # relay must never take the router down
+            err = f"{type(e).__name__}: {e}"
+        if saw_done:
+            return "done", delivered, headers_sent
+        if not committed:
+            return "retry", 0, headers_sent
+        return (self._stream_error_event(sink, port, delivered, err),
+                delivered, headers_sent)
 
     def forward(self, method: str, path: str, body: bytes,
-                headers: dict[str, str] | None = None
-                ) -> tuple[int, bytes, dict[str, str] | None]:
+                headers: dict[str, str] | None = None, sink=None
+                ) -> tuple[int, bytes, dict[str, str] | None] | None:
         """Proxy one request. Only CONNECT-phase failures (refused,
-        injected partition — the backend provably never saw the request)
-        are retried on the next candidate backend: with one healthy
-        replica left, the client sees 200, not the corpse's 502. A
-        failure AFTER the request was sent (timeout mid-generation,
-        reset mid-response) is NOT retried — the backend may have
-        executed it, and replaying a non-idempotent generation would
-        silently duplicate it. Every failure feeds its backend's
-        circuit. Requests carrying a session key route by rendezvous
-        affinity (see _route) — the candidate order IS the failover
-        order, so a pinned session degrades to the next healthy replica
-        and re-pins by itself once the affine circuit closes."""
+        injected partition/zone outage — the backend provably never saw
+        the request) are retried on the next candidate backend: with one
+        healthy replica left, the client sees 200, not the corpse's 502.
+        For BUFFERED requests a failure AFTER the request was sent
+        (timeout mid-generation, reset mid-response) is NOT retried —
+        the backend may have executed it, and replaying a non-idempotent
+        generation would silently duplicate it.
+
+        STREAMING requests (`stream: true`, relayed progressively when
+        `sink` — the client-side handler — is given) get stream-aware
+        failover instead: any failure BEFORE the first token reached the
+        client retries the same request on the next candidate (the
+        client saw nothing, and supervised backends journal their side);
+        a failure AFTER first token emits a typed `mid_stream_failure`
+        event carrying `tokens_delivered` so the client can resume, then
+        [DONE] — never a silently-truncated stream. Returns None when
+        the response was relayed directly onto `sink`.
+
+        Every failure feeds its backend's circuit. Requests carrying a
+        session key route by rendezvous affinity (see _route) — the
+        candidate order IS the failover order, so a pinned session
+        degrades to the next healthy replica and re-pins by itself once
+        the affine circuit closes."""
         self.last_request_time = time.time()
-        session_key = self._session_key_of(headers, body)
+        session_key, wants_stream = self._request_meta(headers, body)
+        wants_stream = wants_stream and sink is not None
+        headers_sent = False   # SSE headers already on the client socket:
+        # retries must continue the body, and errors must be SSE events
         candidates, is_canary, retry_in, affine = self._route(session_key)
         if not candidates and retry_in is not None:
             # every backend's circuit is open: schedule the retry instead
@@ -402,6 +543,13 @@ class Router:
                         raise ConnectionRefusedError(
                             "injected partition: router cannot "
                             f"reach :{port}")
+                    if inj is not None and inj.active(
+                            "zone_outage",
+                            target=self._zone_of.get(port, "")) is not None:
+                        raise ConnectionRefusedError(
+                            "injected zone outage: router cannot reach "
+                            f":{port} (zone "
+                            f"{self._zone_of.get(port, '?')!r})")
                     conn.connect()
                 except OSError as e:   # never reached the backend: retry
                     self._record(port, False)
@@ -412,12 +560,80 @@ class Router:
                                  headers={"Content-Type":
                                           "application/json"})
                     resp = conn.getresponse()
+                except OSError as e:
+                    self._record(port, False)
+                    if wants_stream:
+                        # stream failover, pre-first-token: the client
+                        # saw nothing — retry on the next candidate
+                        with self._lock:
+                            self.stream_failovers += 1
+                        last_err = str(e)
+                        conn.close()
+                        continue
+                    # buffered: the backend may have processed (part of)
+                    # this — surface the failure, do NOT re-execute
+                    return 502, json.dumps(
+                        {"error": f"backend failed mid-request: {e}"}
+                    ).encode(), None
+                ctype = resp.getheader("Content-Type") or ""
+                if wants_stream and ctype.startswith("text/event-stream"):
+                    outcome, delivered, headers_sent = self._relay_stream(
+                        sink, resp, port, headers_sent)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    if outcome == "retry":
+                        self._record(port, False)
+                        with self._lock:
+                            self.stream_failovers += 1
+                        last_err = "backend died before first stream event"
+                        continue
+                    # "client_gone" is the CLIENT's doing — the backend
+                    # was reachable and streaming, so it must not feed
+                    # the breaker as a failure (three tab-closes would
+                    # otherwise open a healthy backend's circuit)
+                    self._record(port, outcome in ("done", "client_gone"))
+                    if session_key is not None:
+                        with self._lock:
+                            if port == affine:
+                                self.affinity_hits += 1
+                            else:
+                                self.affinity_failovers += 1
+                    return None   # the socket is already written
+                if headers_sent:
+                    # the SSE body already started but this retry
+                    # answered with a NON-stream response (e.g. a busy
+                    # replica's 429/503 JSON): a JSON body cannot follow
+                    # SSE headers, but nothing is committed (no data
+                    # event reached the client) — keep trying the
+                    # remaining candidates. The response itself was a
+                    # transport SUCCESS, so it must not feed the breaker
+                    # (a load spike must not open a healthy circuit);
+                    # exhaustion falls through to the terminal error
+                    # event below.
+                    try:
+                        resp.read()
+                        conn.close()
+                    except OSError:
+                        pass
+                    self._record(port, True)
+                    last_err = (f"retry answered non-stream HTTP "
+                                f"{resp.status}")
+                    continue
+                try:
                     data = resp.read()
                     conn.close()
                 except OSError as e:
-                    # the backend may have processed (part of) this —
-                    # surface the failure, do NOT re-execute
                     self._record(port, False)
+                    if wants_stream:
+                        # an SSE request answered with a NON-stream body
+                        # (an error JSON) whose read failed before any
+                        # byte reached the client: still safe to retry
+                        with self._lock:
+                            self.stream_failovers += 1
+                        last_err = str(e)
+                        continue
                     return 502, json.dumps(
                         {"error": f"backend failed mid-request: {e}"}
                     ).encode(), None
@@ -432,6 +648,12 @@ class Router:
                         else:
                             self.affinity_failovers += 1
                 return resp.status, data, None
+            if headers_sent:
+                # candidates exhausted AFTER the SSE body started: the
+                # client must get a terminal event, not a dropped socket
+                self._stream_error_event(
+                    sink, 0, 0, f"all backends unreachable: {last_err}")
+                return None
             return 502, json.dumps(
                 {"error": f"backend unreachable: {last_err}"}
             ).encode(), None

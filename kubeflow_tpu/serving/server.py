@@ -173,39 +173,64 @@ class ModelServer:
 
     # -- routing --------------------------------------------------------------
 
+    def health(self) -> dict[str, Any]:
+        """The /healthz payload, computable in-process (the controller's
+        dead-replica pruning calls this instead of a socket round-trip —
+        same data either way). Cheap and model-free at its core:
+        answering at all means the serving thread is alive; uptime lets
+        flap detectors spot restarts. Models running a prefix KV cache
+        report their reuse counters (the kvcache operator surface), and
+        supervised LLM engines report their crash-recovery state
+        (restarts, permanent_failed, last_mttr_s, journal_depth) — the
+        router/controller/fleet tooling reads both without a model
+        round-trip."""
+        body: dict[str, Any] = {
+            "alive": self.alive, "name": self.name,
+            "uptime_s": round(time.monotonic() - self._t_start, 3)}
+        caches: dict[str, Any] = {}
+        sups: dict[str, Any] = {}
+        for mname in self.repository.names():
+            try:
+                mm = self.repository.get(mname).metrics()
+            except Exception:
+                continue   # liveness must answer even if a model is
+                # mid-load/broken — health first, detail best-effort
+            pc = (mm or {}).get("prefix_cache")
+            if pc:
+                caches[mname] = pc
+            sup = (mm or {}).get("supervisor")
+            if sup:
+                sups[mname] = {
+                    "restarts": sup.get("restarts", 0),
+                    "permanent_failed": bool(
+                        sup.get("permanent_failed", False)),
+                    "last_mttr_s": sup.get("last_mttr_s"),
+                    "journal_depth": sup.get("journal_depth", 0),
+                    "in_flight": sup.get("in_flight", 0),
+                    "degraded_rejections": sup.get("shed", 0),
+                }
+        if caches:
+            body["kv_cache"] = caches
+        if sups:
+            body["supervisor"] = sups
+        return body
+
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:
         if path == "/healthz":
-            # the liveness probe (chaos tentpole): cheap, model-free —
-            # answering at all means the serving thread is alive; the
-            # payload carries uptime so flap detectors can spot restarts.
-            # Models running a prefix KV cache additionally report their
-            # reuse counters here (the kvcache operator surface: hit
-            # rate, blocks resident, tokens saved) — the router/fleet
-            # tooling reads this without a model round-trip.
-            body: dict[str, Any] = {
-                "alive": True, "name": self.name,
-                "uptime_s": round(time.monotonic() - self._t_start, 3)}
-            caches: dict[str, Any] = {}
-            for mname in self.repository.names():
-                try:
-                    mm = self.repository.get(mname).metrics()
-                except Exception:
-                    continue   # liveness must answer even if a model is
-                    # mid-load/broken — health first, detail best-effort
-                pc = (mm or {}).get("prefix_cache")
-                if pc:
-                    caches[mname] = pc
-            if caches:
-                body["kv_cache"] = caches
-            return 200, body
+            return 200, self.health()
         if path in ("/", "/v2"):
             return 200, {"name": self.name, "version": "2",
                          "extensions": ["health", "models", "metrics"]}
         if path == "/v2/health/live":
             return 200, {"live": True}
         if path == "/v2/health/ready":
+            # a permanently-failed supervisor means this replica can
+            # never serve again (restart budget exhausted): readiness
+            # gates it out of rotation even though the HTTP thread
+            # still answers (shared gate: ModelRepository)
             ready = all(self.repository.ready(n)
-                        for n in self.repository.names())
+                        for n in self.repository.names()) \
+                and not self.repository.permanently_failed()
             return (200 if ready else 503), {"ready": ready}
         if path == "/v1/models" or path == "/v2/models":
             return 200, {"models": self.repository.names()}
@@ -531,8 +556,9 @@ class ModelServer:
         the incremental TEXT delta (multi-byte sequences decode across
         chunk boundaries), a final chunk with finish_reason, then
         `data: [DONE]`. Connection: close (progressive writes without
-        chunked framing). NOTE: through an ISVC Router this buffers — the
-        streaming dataplane is the predictor's own port."""
+        chunked framing). An ISVC Router relays this progressively
+        (stream-aware failover, r11) — streaming works through the
+        routed dataplane, not just the predictor's own port."""
         from kubeflow_tpu.serving.tokenizer import StreamDecoder
 
         finish: list[str] = []
@@ -598,6 +624,16 @@ class ModelServer:
                         # to the disconnect path, which closes the
                         # generator and cancels the engine request
                         raise BrokenPipeError("stream client disconnected")
+                    if tok is None:
+                        # keepalive sentinel (a supervised engine mid-
+                        # restart): an SSE comment keeps the connection
+                        # alive without touching the event stream — and
+                        # writing it is itself a disconnect probe, so a
+                        # client that vanished during the outage frees
+                        # its journal slot now, not at the next token
+                        handler.wfile.write(b": keepalive\n\n")
+                        handler.wfile.flush()
+                        continue
                     n_sent += 1
                     handler.wfile.write(chunk_of(
                         decoder.push(tok), token_id=int(tok),

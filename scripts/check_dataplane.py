@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Dataplane lint (ISSUE 12, CI satellite): the unified-dataplane
+invariant — every engine sits behind an `EngineSupervisor` — enforced
+statically, so a future module cannot quietly construct or drive a bare
+`LLMEngine` on the serving path and reopen the crash hole.
+
+Rules (AST, no imports of the checked code):
+
+1. Inside `kubeflow_tpu/` (tests excluded), `LLMEngine(...)` may only be
+   constructed inside a function whose name marks it as a supervisor
+   factory (`factory` in the name) — the closure handed to
+   `EngineSupervisor`. Everything else must take a supervised engine
+   from the outside.
+2. The HTTP/gRPC frontends (`serving/server.py`, `serving/grpc_server.py`)
+   must not reference `LLMEngine` at all — they speak to engines only
+   through the `Model` abstraction, whose engine is the supervisor.
+3. `bench.py` may build bare engines for raw-engine perf points, but its
+   chaos/HTTP dataplane sections must go through `EngineSupervisor` /
+   `LLMModel`; the repo-root bench is therefore out of scope here by
+   path, not by oversight (rule 1's scope is the library package).
+
+Run: `python scripts/check_dataplane.py` — exit 0 clean, 1 with findings
+(one per line). The fast lane runs it via tests/test_dataplane_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "kubeflow_tpu")
+
+#: frontends that must stay engine-blind (rule 2)
+ENGINE_BLIND = (
+    os.path.join("kubeflow_tpu", "serving", "server.py"),
+    os.path.join("kubeflow_tpu", "serving", "grpc_server.py"),
+)
+
+
+def _py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "tests")]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+class _EngineCallVisitor(ast.NodeVisitor):
+    """Collect LLMEngine(...) call sites with their enclosing function
+    names (lexical nesting)."""
+
+    def __init__(self):
+        self.stack: list[str] = []
+        self.calls: list[tuple[int, list[str]]] = []
+
+    def _visit_func(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name == "LLMEngine":
+            self.calls.append((node.lineno, list(self.stack)))
+        self.generic_visit(node)
+
+
+def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
+    findings: list[str] = []
+    # the file defining LLMEngine is allowed to mention itself
+    engine_def = os.path.join("kubeflow_tpu", "serving", "llm.py")
+    for path in sorted(_py_files(pkg_root)):
+        rel = os.path.relpath(path, repo_root)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if rel in ENGINE_BLIND and "LLMEngine" in src:
+            findings.append(
+                f"{rel}: references LLMEngine — frontends must speak "
+                "through the Model abstraction (supervised engine)")
+        if rel == engine_def:
+            continue
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(f"{rel}: unparseable ({e})")
+            continue
+        v = _EngineCallVisitor()
+        v.visit(tree)
+        for lineno, stack in v.calls:
+            if any("factory" in name for name in stack):
+                continue   # the sanctioned pattern: a supervisor factory
+            findings.append(
+                f"{rel}:{lineno}: bare LLMEngine construction outside a "
+                "supervisor factory — wrap it in an EngineSupervisor "
+                "(build it inside a *factory* function handed to one)")
+    return findings
+
+
+def main() -> int:
+    findings = check()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"check_dataplane: {len(findings)} finding(s)")
+        return 1
+    print("check_dataplane: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

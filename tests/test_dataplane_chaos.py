@@ -1,0 +1,431 @@
+"""Unified-dataplane chaos (ISSUE 12): the HTTP/SSE path rides the
+EngineSupervisor, so a streaming client survives a mid-stream engine
+crash END TO END over a real socket — keepalive comments hold the
+connection through the restart window, token emission resumes from the
+journaled prefix with zero duplicate and zero lost tokens, and greedy
+output is byte-identical to an uncrashed run. Plus the restart-window
+edge cases the ISSUE names: crash before first token (silent), crash
+during the final chunk (no duplicate [DONE]/usage), supervisor
+permanent-fail (terminal error event, not a hang), and a client that
+disconnects while its request sits journaled for replay (finalized
+cancelled, journal drained)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.chaos import (FaultScriptConfig, FaultSpec,
+                                generate_fault_script)
+from kubeflow_tpu.loadgen import stream_completion
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.llm_runtime import LLMModel
+from kubeflow_tpu.serving.model import ModelRepository
+from kubeflow_tpu.serving.router import Router
+from kubeflow_tpu.serving.server import ModelServer
+
+PROMPT = [72, 105, 33]          # within the tiny vocab
+MAX_TOKENS = 12
+
+
+def _crash_now(seed: int = 1, count: int = 1):
+    """Crash(es) scheduled at t=0: armed mid-run they fire on the very
+    next supervised step — the test controls WHEN by choosing when to
+    arm (the test_chaos_recovery idiom)."""
+    return generate_fault_script(FaultScriptConfig(
+        seed=seed, duration_s=1.0,
+        faults=(FaultSpec("backend_crash", count, (0.0, 0.0)),)),
+        name="now")
+
+
+@pytest.fixture(scope="module")
+def llm_server():
+    """One supervised LLMModel behind a real ModelServer. Fast-recovery
+    supervisor knobs: rewarm=False (restarts compile lazily — the
+    fast-lane setting), short backoff so a crash costs ~0.3 s, and a
+    50 ms SSE keepalive so restart windows provably emit them."""
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=64, attention_impl="xla",
+                            dtype=jnp.float32, remat=False)
+    m = LLMModel("llm", model={k: getattr(cfg, k) for k in
+                               ("vocab_size", "d_model", "n_layers",
+                                "n_heads", "n_kv_heads", "d_ff",
+                                "max_seq_len", "attention_impl",
+                                "remat")},
+                 n_slots=2, max_len=64, buckets=(8, 16), seed=0,
+                 decode_chunk=2,
+                 supervisor={"stall_timeout_s": 30.0,
+                             "backoff_base_s": 0.3,
+                             "backoff_cap_s": 0.6,
+                             "rewarm": False},
+                 sse_keepalive_s=0.05)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    yield m, server, cfg
+    server.stop()
+    m.unload()
+
+
+def _reference(m, server) -> list[int]:
+    """The uncrashed greedy stream for PROMPT (the byte-parity oracle)."""
+    res = stream_completion(server.port, {
+        "model": "llm", "prompt": PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})
+    assert res["status"] == 200 and res["done_count"] == 1, res
+    assert len(res["token_ids"]) == MAX_TOKENS
+    return res["token_ids"]
+
+
+def _open_stream(port, payload, timeout=120.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/openai/v1/completions",
+                 body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+def _drain(resp, on_token=None) -> dict:
+    """Incremental SSE drain: `on_token(i)` fires after the i-th token
+    event is read — the hook the mid-stream tests use to arm a crash at
+    an exact point in the delivered stream."""
+    out = {"token_ids": [], "done_count": 0, "usage_count": 0,
+           "keepalives": 0, "errors": [], "finish_reason": None}
+    while True:
+        line = resp.readline()
+        if not line:
+            return out
+        if line.startswith(b":"):
+            out["keepalives"] += 1
+            continue
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):].strip()
+        if data == b"[DONE]":
+            out["done_count"] += 1
+            continue    # keep reading: duplicates must COUNT
+        chunk = json.loads(data)
+        if "error" in chunk:
+            out["errors"].append(chunk["error"])
+            continue
+        if chunk.get("usage") is not None:
+            out["usage_count"] += 1
+        for ch in chunk.get("choices", ()):
+            if ch.get("token_id") is not None:
+                out["token_ids"].append(int(ch["token_id"]))
+                if on_token is not None:
+                    on_token(len(out["token_ids"]))
+            if ch.get("finish_reason"):
+                out["finish_reason"] = ch["finish_reason"]
+
+
+def _inflight_tokens(sup) -> int | None:
+    """Server-side truth: generated-so-far token count of the one
+    non-terminal journaled request (None when nothing is in flight)."""
+    with sup._lock:
+        return max((len(e.base_tokens) + len(e.tokens)
+                    for e in sup._journal.values() if not e.terminal),
+                   default=None)
+
+
+def test_crash_before_first_token_is_silent(llm_server):
+    """A crash before the first token: the request is submitted while
+    the engine is DOWN (the journal is the queue), the restart replays
+    it from scratch, and the CLIENT sees a perfectly ordinary stream —
+    no error event, no retry burden, byte-identical greedy output."""
+    m, server, cfg = llm_server
+    ref = _reference(m, server)
+    restarts0 = m.supervisor.accounting()["restarts"]
+    m.supervisor.arm_faults(_crash_now(seed=11))
+    deadline = time.monotonic() + 10
+    while not m.supervisor.degraded and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert m.supervisor.degraded   # engine provably down at submit time
+    res = stream_completion(server.port, {
+        "model": "llm", "prompt": PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})
+    assert res["status"] == 200
+    assert res["token_ids"] == ref
+    assert res["errors"] == []
+    assert res["done_count"] == 1 and res["usage_count"] == 1
+    assert res["finish_reason"] in ("stop", "length")
+    acc = m.supervisor.accounting()
+    assert acc["restarts"] >= restarts0 + 1 and acc["lost"] == 0
+
+
+def test_crash_midstream_resumes_byte_identical_with_keepalives(llm_server):
+    """THE tentpole contract over a real socket: kill the engine once
+    >=2 tokens of a live stream are journaled; the SSE connection stays
+    open (keepalive comments during the restart window), emission
+    resumes from the journaled prefix, and the full stream is
+    byte-identical with zero duplicate and zero lost tokens."""
+    import threading
+
+    m, server, cfg = llm_server
+    ref = _reference(m, server)
+    sup = m.supervisor
+    replayed0 = sup.accounting()["replayed"]
+    out_box: list[dict] = []
+
+    def client():
+        conn, resp = _open_stream(server.port, {
+            "model": "llm", "prompt": PROMPT, "max_tokens": MAX_TOKENS,
+            "temperature": 0.0, "stream": True})
+        out_box.append(_drain(resp))
+        conn.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    # arm on SERVER-side truth: >=2 tokens journaled and the request
+    # still in flight — the supervisor's kill-check runs at the top of
+    # every step, so the crash provably lands mid-generation
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        n = _inflight_tokens(sup)
+        if n is not None and n >= 2:
+            break
+        time.sleep(0.001)
+    else:
+        pytest.fail("stream never reached 2 in-flight tokens")
+    sup.arm_faults(_crash_now(seed=12))
+    t.join(timeout=120)
+    assert not t.is_alive(), "stream hung through the crash"
+    out = out_box[0]
+    assert out["token_ids"] == ref          # zero lost, zero duplicate
+    assert out["errors"] == []
+    assert out["done_count"] == 1 and out["usage_count"] == 1
+    # the restart window (>=0.3 s backoff at 50 ms keepalive cadence)
+    # provably kept the connection warm
+    assert out["keepalives"] >= 1
+    acc = sup.accounting()
+    assert acc["lost"] == 0 and acc["replay_mismatch"] == 0
+    assert acc["replayed"] >= replayed0 + 1   # it WAS a mid-stream replay
+
+
+def test_crash_during_final_chunk_no_duplicate_done(llm_server):
+    """A crash landing around the final chunk must not duplicate the
+    [DONE] sentinel or the usage object — the terminal frame is written
+    once, by the server, after the supervised request is terminal."""
+    m, server, cfg = llm_server
+    ref = _reference(m, server)
+
+    def arm(n):
+        if n == MAX_TOKENS:   # the last token just arrived
+            m.supervisor.arm_faults(_crash_now(seed=13))
+
+    conn, resp = _open_stream(server.port, {
+        "model": "llm", "prompt": PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0, "stream": True})
+    out = _drain(resp, on_token=arm)
+    conn.close()
+    assert out["token_ids"] == ref
+    assert out["done_count"] == 1 and out["usage_count"] == 1
+    assert out["errors"] == []
+    # drive the armed crash to consumption so it cannot leak into the
+    # next test: wait for the restart to complete
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        acc = m.supervisor.accounting()
+        if acc["in_flight"] == 0 and m.supervisor.engine is not None \
+                and not m.supervisor.degraded:
+            break
+        time.sleep(0.02)
+    assert m.supervisor.accounting()["lost"] == 0
+
+
+def test_client_disconnect_during_replay_finalizes_cancelled(llm_server):
+    """The ISSUE's disconnect-during-replay hole: the client vanishes
+    while the engine is DOWN and its request sits journaled. The
+    keepalive write probes the dead socket (the r7 MSG_PEEK path fires
+    even with no tokens flowing), the supervisor finalizes the request
+    `cancelled`, and the journal entry never stays pending."""
+    m, server, cfg = llm_server
+    sup = m.supervisor
+    base = sup.accounting()
+    conn, resp = _open_stream(server.port, {
+        "model": "llm", "prompt": PROMPT, "max_tokens": 24,
+        "temperature": 0.0, "stream": True})
+    # wait for at least one delivered token, then kill the engine
+    got = []
+    while not got:
+        line = resp.readline()
+        if line.startswith(b"data: ") and b'"token_id"' in line:
+            got.append(line)
+    sup.arm_faults(_crash_now(seed=14))
+    deadline = time.monotonic() + 10
+    while not sup.degraded and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert sup.degraded, "crash never fired"
+    # the client leaves DURING the outage. NOTE: with Connection: close
+    # responses http.client detaches the socket into the response, so
+    # closing the response (not just the connection) is what sends FIN
+    resp.close()
+    conn.close()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        acc = sup.accounting()
+        if (acc["cancelled"] >= base["cancelled"] + 1
+                and acc["in_flight"] == 0 and acc["journal_depth"] == 0
+                and not sup.degraded):
+            break
+        time.sleep(0.02)
+    acc = sup.accounting()
+    assert acc["cancelled"] >= base["cancelled"] + 1
+    assert acc["in_flight"] == 0 and acc["lost"] == 0
+    assert acc["journal_depth"] == 0   # released, not pending forever
+    # the dataplane recovered: a fresh request serves byte-identically
+    ref = _reference(m, server)
+    assert len(ref) == MAX_TOKENS
+
+
+def test_healthz_supervisor_section(llm_server):
+    """Satellite: GET /healthz carries the supervisor's recovery state
+    alongside the r10 kv_cache section shape."""
+    m, server, cfg = llm_server
+    with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
+        body = json.loads(r.read())
+    assert body["alive"] is True
+    sup = body["supervisor"]["llm"]
+    assert sup["permanent_failed"] is False
+    assert isinstance(sup["restarts"], int) and sup["restarts"] >= 1
+    assert isinstance(sup["journal_depth"], int)
+    assert "last_mttr_s" in sup and "in_flight" in sup
+
+
+def test_stream_through_router_survives_crash(llm_server):
+    """Every client path crosses the router: the SSE stream relays
+    PROGRESSIVELY through it (not buffered), and a mid-stream engine
+    crash under the router is absorbed by the supervisor — the relayed
+    stream is still byte-identical with one [DONE]."""
+    import threading
+
+    m, server, cfg = llm_server
+    ref = _reference(m, server)
+    sup = m.supervisor
+    router = Router("t/dp")
+    try:
+        router.set_backends(server.port)
+        out_box: list[dict] = []
+        status_box: list = []
+
+        def client():
+            conn, resp = _open_stream(router.port, {
+                "model": "llm", "prompt": PROMPT,
+                "max_tokens": MAX_TOKENS,
+                "temperature": 0.0, "stream": True})
+            status_box.append((resp.status,
+                               resp.getheader("Content-Type") or ""))
+            out_box.append(_drain(resp))
+            conn.close()
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            n = _inflight_tokens(sup)
+            if n is not None and n >= 2:
+                break
+            time.sleep(0.001)
+        else:
+            pytest.fail("stream never reached 2 in-flight tokens")
+        sup.arm_faults(_crash_now(seed=15))
+        t.join(timeout=120)
+        assert not t.is_alive(), "stream hung through the crash"
+        status, ctype = status_box[0]
+        assert status == 200 and ctype.startswith("text/event-stream")
+        out = out_box[0]
+        assert out["token_ids"] == ref
+        assert out["done_count"] == 1 and out["errors"] == []
+        # keepalives crossed the router too — that is what held the
+        # client connection through the restart
+        assert out["keepalives"] >= 1
+    finally:
+        router.stop()
+
+
+def test_permanent_fail_streams_terminal_error_event():
+    """Satellite: when the supervisor exhausts its restart budget
+    mid-stream the client gets a TERMINAL error event and [DONE] — not a
+    hang, not a silent truncation — and the replica reports itself
+    permanently failed (healthz + readiness 503 + new submits 503)."""
+    m = LLMModel("llm", model=dict(vocab_size=64, d_model=16, n_layers=1,
+                                   n_heads=2, n_kv_heads=1, d_ff=32,
+                                   max_seq_len=32, attention_impl="xla",
+                                   remat=False),
+                 n_slots=1, max_len=32, buckets=(8,), seed=0,
+                 decode_chunk=2,
+                 supervisor={"stall_timeout_s": 30.0,
+                             "backoff_base_s": 0.01,
+                             "backoff_cap_s": 0.02,
+                             "max_restarts": 0, "rewarm": False},
+                 sse_keepalive_s=0.05)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    try:
+        m.supervisor.arm_faults(_crash_now(seed=16))
+        res = stream_completion(server.port, {
+            "model": "llm", "prompt": [3, 5, 7], "max_tokens": 8,
+            "temperature": 0.0}, timeout_s=60.0)
+        assert res["status"] == 200        # the stream had committed
+        assert res["errors"], "no terminal error event arrived"
+        assert any("permanently failed" in str(e) for e in res["errors"])
+        assert res["done_count"] == 1      # terminated, cleanly
+        assert m.supervisor.failed
+        # the replica self-reports: healthz + readiness + admission
+        h = server.health()
+        assert h["supervisor"]["llm"]["permanent_failed"] is True
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("GET", "/v2/health/ready")
+        assert conn.getresponse().status == 503
+        conn.close()
+        res2 = stream_completion(server.port, {
+            "model": "llm", "prompt": [3, 5], "max_tokens": 4})
+        assert res2["status"] == 503       # QueueFull: permanently failed
+    finally:
+        server.stop()
+        m.unload()
+
+
+def test_steady_scenario_over_http_with_crash_loses_nothing(llm_server):
+    """The acceptance integration, measured where the client lives: the
+    loadgen `steady` scenario replayed through a REAL socket while the
+    committed `crash_midstream` script kills the engine mid-window.
+    Every stream reaches a clean terminal state (no error events, no
+    truncated streams) and the supervisor accounts zero lost."""
+    from kubeflow_tpu.chaos import load_fault_script
+    from kubeflow_tpu.loadgen import (generate_trace, load_scenario,
+                                      miniature, run_trace_http)
+
+    m, server, cfg = llm_server
+    scenario = miniature(load_scenario("steady"), vocab=120,
+                         max_prompt_len=14, duration_s=3.0, rate_rps=3.0)
+    trace = generate_trace(scenario.trace)
+    base = m.supervisor.accounting()
+    script = load_fault_script("crash_midstream",
+                               duration_s=scenario.trace.duration_s)
+    m.supervisor.arm_faults(script)
+    res = run_trace_http(server.port, trace, model="llm",
+                         max_wall_s=60.0, timeout_s=60.0)
+    assert not res["timed_out"]
+    agg = res["summary"]["aggregate"]
+    reasons = [r.finish_reason for r in res["records"]]
+    assert "error" not in reasons, reasons
+    assert all(rsn in ("stop", "length", "rejected", "cancelled")
+               for rsn in reasons), reasons
+    completed = [r for r in res["records"] if r.completed]
+    assert completed and all(r.n_tokens == r.max_new_tokens
+                             or r.finish_reason == "stop"
+                             for r in completed)
+    acc = m.supervisor.accounting()
+    assert acc["restarts"] >= base["restarts"] + 1   # the crash landed
+    assert acc["lost"] == 0 and acc["in_flight"] == 0
+    assert agg["n_requests"] == len(trace.requests)

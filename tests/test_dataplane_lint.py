@@ -1,0 +1,81 @@
+"""Fast-lane dataplane lint (ISSUE 12 satellite): no non-test module may
+construct a bare LLMEngine outside a supervisor factory, and the HTTP/
+gRPC frontends must stay engine-blind. scripts/check_dataplane.py is the
+CI entrypoint; these tests run it in-process so the fast lane fails the
+moment someone reopens the crash hole."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_dataplane", os.path.join(REPO, "scripts",
+                                        "check_dataplane.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_dataplane_is_clean():
+    lint = _load_lint()
+    findings = lint.check()
+    assert findings == [], "\n".join(findings)
+
+
+def test_lint_runs_as_a_script():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_dataplane.py")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "check_dataplane: ok" in out.stdout
+
+
+def test_lint_flags_bare_engine_construction(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "from kubeflow_tpu.serving.llm import LLMEngine\n"
+        "def serve(params, cfg):\n"
+        "    eng = LLMEngine(params, cfg)\n"   # bare: no supervisor
+        "    return eng.submit([1], 4)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "rogue.py:3" in findings[0]
+    assert "supervisor factory" in findings[0]
+
+
+def test_lint_allows_supervisor_factory(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "fine.py").write_text(
+        "from kubeflow_tpu.serving.llm import LLMEngine\n"
+        "from kubeflow_tpu.serving.agent import EngineSupervisor\n"
+        "def supervised(params, cfg):\n"
+        "    def engine_factory():\n"
+        "        return LLMEngine(params, cfg)\n"
+        "    return EngineSupervisor(engine_factory)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_flags_engine_aware_frontend(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "server.py").write_text(
+        "from kubeflow_tpu.serving.llm import LLMEngine\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert any("frontends must speak" in f for f in findings)

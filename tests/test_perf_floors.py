@@ -125,3 +125,41 @@ def test_prefix_floors_gated_on_schema_5(tmp_path):
     p.write_text(json.dumps(rec5))
     assert any(f.startswith("prefix_greedy_parity")
                for f in bench.check_floors(str(p)))
+
+
+def test_http_chaos_floors_gated_on_schema_6(tmp_path):
+    """serving_chaos.http floors (r11) only bind records new enough to
+    carry the HTTP-path measurement: every pre-r11 committed record
+    stays valid, a schema-6 record missing the section fails loudly,
+    and a schema-6 record holding its floors is green — including the
+    exact stream-completion contract (0.99 is a failure)."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 6   # committed record predates r11
+    assert not any("chaos_http" in f for f in bench.check_floors(_RECORD))
+
+    rec6 = json.loads(json.dumps(rec))
+    rec6["schema"] = 6
+    p = tmp_path / "rec6.json"
+    p.write_text(json.dumps(rec6))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("chaos_http_stream_completion")
+               for f in fails)
+    assert any(f.startswith("chaos_http_goodput_retained")
+               for f in fails)
+
+    rec6["extras"]["serving_chaos"] = {
+        "http": {"stream_completion_frac": 1.0,
+                 "goodput_retained": 0.4}}
+    p.write_text(json.dumps(rec6))
+    assert not any("chaos_http" in f for f in bench.check_floors(str(p)))
+
+    # the streaming zero-duplicate/zero-lost contract is EXACT: a single
+    # truncated or duplicated stream (0.99) fails no matter the goodput
+    rec6["extras"]["serving_chaos"]["http"][
+        "stream_completion_frac"] = 0.99
+    p.write_text(json.dumps(rec6))
+    assert any(f.startswith("chaos_http_stream_completion")
+               for f in bench.check_floors(str(p)))

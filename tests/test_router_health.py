@@ -356,3 +356,259 @@ def test_backoff_limit_exhaustion_is_crashloopbackoff():
             pytest.fail("CrashLoopBackOff never reported")
         with ctrl._lock:
             assert ctrl._crash_backoff[key]["count"] >= 2
+
+
+# -- stream-aware failover (r11, the unified-dataplane tentpole) --------------
+
+def _sse_backend(mode: str, n_tokens: int = 3):
+    """A scriptable fake SSE backend: `complete` streams n token events
+    + usage + [DONE]; `die_before_event` commits SSE headers then dies
+    (the client saw nothing — retryable); `die_midstream` dies after the
+    token events (committed — must become a typed error event)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            if mode == "die_before_event":
+                self.wfile.flush()
+                self.connection.close()
+                return
+            for i in range(n_tokens):
+                self.wfile.write(
+                    b'data: {"choices": [{"token_id": %d, "text": "t"}]}'
+                    b"\n\n" % i)
+                self.wfile.flush()
+            if mode == "die_midstream":
+                self.connection.close()
+                return
+            self.wfile.write(
+                b'data: {"choices": [{"finish_reason": "length"}], '
+                b'"usage": {"completion_tokens": %d}}\n\n' % n_tokens)
+            self.wfile.write(b"data: [DONE]\n\n")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name=f"sse-{mode}").start()
+    return srv
+
+
+def test_stream_failover_before_first_token_retries_next_replica():
+    """A backend that dies after committing SSE headers but BEFORE any
+    data event is invisible to the client: the router retries the same
+    request on the next candidate (session-affinity order) and the
+    client sees one complete stream."""
+    from kubeflow_tpu.loadgen import stream_completion
+    from kubeflow_tpu.serving.router import _rendezvous_rank
+
+    dead = _sse_backend("die_before_event")
+    good = _sse_backend("complete", n_tokens=4)
+    pool = [dead.server_address[1], good.server_address[1]]
+    # pick a session key whose rendezvous order puts the DYING backend
+    # first, so the failover path provably runs
+    key = next(f"k{i}" for i in range(64)
+               if _rendezvous_rank(pool, f"k{i}")[0] == pool[0])
+    r = Router("t/stream-fo", failure_threshold=3)
+    try:
+        r.set_backends(pool)
+        res = stream_completion(r.port, {"model": "m", "prompt": "x",
+                                         "session": key, "stream": True})
+        assert res["status"] == 200
+        assert res["token_ids"] == [0, 1, 2, 3]
+        assert res["errors"] == [] and res["done_count"] == 1
+        assert r.stream_failovers >= 1
+        assert r.affinity_failovers == 1   # served off-affine, scored
+    finally:
+        r.stop()
+        dead.shutdown()
+        good.shutdown()
+
+
+def test_stream_midstream_failure_emits_typed_error_event():
+    """After the first token reached the client the stream is committed:
+    a backend death becomes a typed `mid_stream_failure` event carrying
+    `tokens_delivered` (the resume point), then [DONE] — never a
+    silently-truncated stream."""
+    from kubeflow_tpu.loadgen import stream_completion
+
+    b = _sse_backend("die_midstream", n_tokens=2)
+    r = Router("t/stream-err", failure_threshold=3)
+    try:
+        r.set_backends(b.server_address[1])
+        res = stream_completion(r.port, {"model": "m", "prompt": "x",
+                                         "stream": True})
+        assert res["status"] == 200
+        assert res["token_ids"] == [0, 1]
+        assert res["done_count"] == 1          # the router closed it out
+        assert len(res["errors"]) == 1
+        err = res["errors"][0]
+        assert err["type"] == "mid_stream_failure"
+        assert err["tokens_delivered"] == 2    # the client's resume point
+        assert r.stream_midfailures == 1
+    finally:
+        r.stop()
+        b.shutdown()
+
+
+# -- fleet chaos: zone outage (r11) -------------------------------------------
+
+def test_zone_outage_opens_many_circuits_and_fails_over():
+    """A `zone_outage` window takes out every replica in zone-a AT ONCE:
+    their circuits all open, every client request fails over to zone-b
+    (zero client errors), and once the window closes the breakers'
+    half-open cycle re-admits zone-a."""
+    servers = [_mean_server() for _ in range(4)]
+    ports = [s.port for s in servers]
+    zone_a, zone_b = ports[:2], ports[2:]
+    script = generate_fault_script(FaultScriptConfig(
+        seed=9, duration_s=30.0,
+        faults=(FaultSpec("zone_outage", 1, (0.0, 0.0), (0.7, 0.7),
+                          target="zone-a"),)), name="za")
+    inj = FaultInjector(script)
+    r = Router("t/zone", failure_threshold=1, circuit_open_s=0.2)
+    try:
+        r.set_backends(ports)
+        r.set_zones({"zone-a": zone_a, "zone-b": zone_b})
+        r.set_fault_injector(inj)
+        inj.start()
+        # during the outage: every request still 200 (zone-b absorbs),
+        # and BOTH zone-a circuits trip — many circuits at once
+        t_end = time.monotonic() + 0.55
+        while time.monotonic() < t_end:
+            assert _get(r.url)[0] == 200
+        states = r.circuit_states()
+        assert all(states[p] == OPEN for p in zone_a), states
+        assert all(states[p] == CLOSED for p in zone_b), states
+        assert sum(_served_count(s) for s in servers[:2]) == 0
+        # window over + hold-off expired: half-open probes re-admit
+        # zone-a and the fleet converges back to fully closed
+        time.sleep(0.5)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            assert _get(r.url)[0] == 200
+            if all(st == CLOSED for st in r.circuit_states().values()):
+                break
+            time.sleep(0.02)
+        assert all(st == CLOSED for st in r.circuit_states().values())
+        assert all(_served_count(s) > 0 for s in servers[:2])
+        assert inj.log() and inj.log()[0]["kind"] == "zone_outage"
+    finally:
+        r.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_full_fleet_zone_outage_sheds_with_retry_after():
+    """A zone_outage with target None is the full-fleet drill: every
+    circuit opens, the router backs clients off with 503 + Retry-After
+    (degraded-mode shedding with a schedule), and the fleet recovers by
+    itself after the window."""
+    servers = [_mean_server() for _ in range(2)]
+    ports = [s.port for s in servers]
+    script = generate_fault_script(FaultScriptConfig(
+        seed=10, duration_s=30.0,
+        faults=(FaultSpec("zone_outage", 1, (0.0, 0.0), (0.5, 0.5),
+                          target=None),)), name="all-zones")
+    inj = FaultInjector(script)
+    r = Router("t/zone-all", failure_threshold=1, circuit_open_s=0.15)
+    try:
+        r.set_backends(ports)
+        r.set_zones({"za": [ports[0]], "zb": [ports[1]]})
+        r.set_fault_injector(inj)
+        inj.start()
+        code, _, _ = _get(r.url)           # trips every circuit
+        assert code == 502
+        code, body, headers = _get(r.url)
+        assert code == 503
+        assert "circuit open" in body["error"]
+        assert int(headers.get("Retry-After", "0")) >= 1
+        time.sleep(0.6)                    # window + hold-off over
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _get(r.url)[0] == 200:
+                break
+            time.sleep(0.05)
+        assert _get(r.url)[0] == 200
+    finally:
+        r.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- controller pruning reads /healthz (r11 satellite) ------------------------
+
+def test_controller_prunes_permanently_failed_replica():
+    """The controller's dead-replica pruning reads the replica's
+    /healthz payload, not just ModelServer.alive: a replica whose HTTP
+    thread still answers but whose supervisor permanently failed is
+    pruned and restarted — the fresh instance gets a fresh supervisor."""
+    from kubeflow_tpu.serving.model import Model, serving_runtime
+
+    created: list = []
+
+    class _FlakySup(Model):
+        def __init__(self, name):
+            super().__init__(name)
+            self.permanent_failed = False
+            created.append(self)
+
+        def load(self):
+            self._mark_ready()
+
+        def predict(self, payload):
+            return {"predictions": [1.0]}
+
+        def metrics(self):
+            return {"supervisor": {
+                "restarts": 3, "journal_depth": 0, "last_mttr_s": 0.05,
+                "permanent_failed": self.permanent_failed}}
+
+    @serving_runtime("flaky-sup")
+    def _flaky(name, uri=None, **cfg):
+        return _FlakySup(name)
+
+    c = Cluster(n_devices=8)
+    ctrl = c.add(serving.InferenceServiceController)
+    with c:
+        spec = {"predictor": {"model": {"modelFormat": "flaky-sup"}}}
+        c.store.create(new_resource(serving.ISVC_KIND, "perm", spec=spec))
+        c.wait_for(serving.ISVC_KIND, "perm",
+                   lambda o: has_condition(o["status"], "Ready"),
+                   timeout=30)
+        inst0 = ctrl._instances[("default", "perm", "predictor")][0]
+        old_port = inst0.server.port
+        assert inst0.server.alive
+        # the supervisor gives up — the HTTP thread is still serving,
+        # so ModelServer.alive alone would NEVER prune this replica
+        created[0].permanent_failed = True
+        assert inst0.server.health()["alive"] is True   # yet unhealthy
+        assert inst0.server.health()["supervisor"]["perm"][
+            "permanent_failed"] is True
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with ctrl._lock:
+                insts = ctrl._instances.get(
+                    ("default", "perm", "predictor"), [])
+            if insts and insts[0].server.port != old_port \
+                    and insts[0].server.alive:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("permanently-failed replica was never replaced")
+        with ctrl._lock:
+            cb = ctrl._crash_backoff[("default", "perm", "predictor")]
+        assert cb["count"] >= 1
+        # the replacement reports healthy (a fresh model instance)
+        assert len(created) >= 2 and not created[-1].permanent_failed
