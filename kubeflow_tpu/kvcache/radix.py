@@ -294,15 +294,33 @@ class RadixKVCache:
     def stats(self) -> dict[str, Any]:
         """The committed-record shape: global counters + per-tenant
         rows. hit_rate is over recorded hits+misses (admissions the
-        engine considered), not raw match calls."""
+        engine considered), not raw match calls. pinned_blocks /
+        evictable_blocks are live occupancy gauges (the disagg
+        backpressure + /healthz surface): pinned = refs > 0 (an
+        in-flight admission or handoff holds the chain), evictable =
+        unpinned LEAVES the next insert could reclaim — capacity minus
+        blocks plus evictable is what the pool can still absorb."""
         with self._lock:
             hits = sum(r["hits"] for r in self._acct.values())
             misses = sum(r["misses"] for r in self._acct.values())
             reused = sum(r["reused_tokens"] for r in self._acct.values())
+            pinned = evictable = 0
+            stack = list(self._roots.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.block is None:
+                    continue
+                if n.block.refs > 0:
+                    pinned += 1
+                elif not n.children:
+                    evictable += 1
             return {
                 "block_tokens": self.block_tokens,
                 "capacity_blocks": self.capacity_blocks,
                 "blocks": self._n_blocks,
+                "pinned_blocks": pinned,
+                "evictable_blocks": evictable,
                 "hits": hits,
                 "misses": misses,
                 "hit_rate": (round(hits / (hits + misses), 4)

@@ -278,6 +278,15 @@ class _Journaled:
     finish_reason: str | None = None
     chain: list[str] = dataclasses.field(default_factory=list)
     verify_prefix: list[int] | None = None
+    #: phase split (queue_wait_ms / prefill_ms / decode_ms) captured from
+    #: the engine at completion — durations survive the engine's death,
+    #: so request_timing() keeps reporting them after release/restart
+    #: (for a replayed request they describe the LAST engine generation)
+    phases: dict[str, Any] | None = None
+    #: prefix-KV tokens the engine reused, captured at completion: the
+    #: live engine rid is released right there, so without this the
+    #: usage/cached_tokens surface read 0 the moment a request finished
+    cached: int = 0
 
 
 class EngineSupervisor:
@@ -594,6 +603,14 @@ class EngineSupervisor:
                         e.first_token_s = now
                 if self.engine.is_done(e.engine_rid):
                     reason = self.engine.finish_reason(e.engine_rid)
+                    try:
+                        tm = self.engine.request_timing(e.engine_rid)
+                        e.phases = {k: tm.get(k) for k in
+                                    ("queue_wait_ms", "prefill_ms",
+                                     "decode_ms")}
+                        e.cached = int(tm.get("cached_prefix_len") or 0)
+                    except Exception:
+                        pass   # phase detail is best-effort accounting
                     result = (self.engine.result(e.engine_rid)
                               if reason != "cancelled"
                               else self.engine.partial_result(e.engine_rid))
@@ -727,13 +744,17 @@ class EngineSupervisor:
         cached = self.cached_tokens(rid)
         with self._lock:
             e = self._journal[rid]
+            phases = dict(e.phases or {})
             return {"submit_s": e.submit_s,
                     "first_token_s": e.first_token_s,
                     "finish_s": e.finish_s, "tenant": e.tenant,
                     "n_tokens": len(e.base_tokens) + len(e.tokens),
                     "prompt_len": len(e.prompt),
                     "cached_prefix_len": cached,
-                    "prefill_tokens": len(e.prompt) - cached}
+                    "prefill_tokens": len(e.prompt) - cached,
+                    "queue_wait_ms": phases.get("queue_wait_ms"),
+                    "prefill_ms": phases.get("prefill_ms"),
+                    "decode_ms": phases.get("decode_ms")}
 
     def cached_tokens(self, rid: int) -> int:
         """Prefix-KV tokens the CURRENT engine reused for this request.
@@ -745,7 +766,10 @@ class EngineSupervisor:
             erid = e.engine_rid if e is not None else None
             eng = self.engine
         if eng is None or erid is None:
-            return 0
+            # finished (the engine rid was released at completion) or
+            # mid-restart: answer from the journal's completion capture
+            # — 0 until then, never fabricated
+            return e.cached if e is not None else 0
         fn = getattr(eng, "cached_tokens", None)
         try:
             return int(fn(erid)) if fn is not None else 0

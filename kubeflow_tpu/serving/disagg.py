@@ -1,0 +1,930 @@
+"""Disaggregated prefill/decode serving (ISSUE 13, ROADMAP #3 — the
+MPMD stage-scheduling idiom applied to inference).
+
+Long-prompt prefill and decode want opposite schedules from one engine:
+a chunked prefill chain blocks the step loop for seconds-class windows
+while decode wants short uniform steps, so colocating them makes decode
+TPOT spike whenever a 4k-token prompt arrives (the interference the
+loadgen per-bucket TTFT table measures). This module splits the two onto
+dedicated engine roles and coordinates them:
+
+  - **KVHandoff**: moves finished prefill KV between roles as radix-
+    cache BLOCK PAYLOADS (the r10 currency: ref-counted, block-granular,
+    int8-aware). `KVHandoff` is the same-process zero-copy insert —
+    device arrays move by reference; `SerializedKVHandoff` pushes every
+    block through a bytes round-trip (int8 blocks + scales stay int8)
+    behind the SAME interface, the shape a future multi-host transport
+    slots into. Either way the decode worker's ordinary radix admission
+    path consumes the result, so greedy/seeded parity with the colocated
+    engine holds by construction (the r10 cached-path parity contract).
+
+  - **PrefillQueue**: TTFT-aware prefill admission — shortest-REMAINING-
+    prefill first (remaining = prompt minus what the prefill worker's
+    own radix cache already holds; SRPT is what bends the TTFT p99 tail)
+    inside max-min tenant fairness (the decode scheduler's pop rule:
+    among tenants with queued jobs, fewest prefills currently in
+    flight). Jobs are held HERE, not in the prefill engine's FIFO, so
+    the ordering policy actually binds and backpressure has a place to
+    act.
+
+  - **DisaggregatedEngine**: the coordinator. Exposes the LLMEngine
+    submit/step/result surface over two `EngineSupervisor`s (one per
+    role — journal/restart semantics per role: a prefill-worker crash
+    replays only un-handed-off prefills, a decode-worker crash replays
+    from journaled prefixes exactly as in r11), pumps the
+    queue → prefill → handoff → decode state machine, and applies
+    BACKPRESSURE: a prefill is not dispatched while the decode worker's
+    KV pool (free + evictable blocks, minus blocks already in flight)
+    cannot hold its output — prefill admission can never starve decode
+    KV capacity. Degradations are explicit and safe: a prompt shorter
+    than one block (nothing to hand off) or a permanently-failed
+    prefill role bypasses straight to the decode worker, which falls
+    back to colocated behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+
+class KVHandoff:
+    """Prefill→decode transfer of radix block payloads: same-process
+    zero-copy (payload objects — device KV arrays — move by reference
+    into the target cache). `target` is a zero-arg callable returning
+    the CURRENT target RadixKVCache (None while the decode engine is
+    down/restarting: the send is skipped and the decode worker
+    re-prefills — degraded, never wrong)."""
+
+    name = "zero_copy"
+
+    def __init__(self, target: Callable[[], Any]):
+        self._target = target
+        self._lock = threading.Lock()
+        self.handoffs = 0
+        self.blocks_sent = 0
+        self.tokens_sent = 0
+        self.bytes_sent = 0       # serialized path only
+
+    def transfer(self, payload: Any) -> Any:
+        return payload
+
+    def send(self, tokens, payloads: list, *, namespace: Any = None,
+             tenant: str | None = None) -> int:
+        """Insert a matched block chain for the aligned prefix of
+        `tokens` into the target cache. `transfer` runs lazily — only
+        blocks the target does not already hold cross the interface.
+        Returns the number of NEW blocks stored (the target's insert may
+        stop early under capacity pressure: a prefix of a prefix is
+        still a valid chain)."""
+        cache = self._target()
+        if cache is None or not payloads:
+            return 0
+        bt = cache.block_tokens
+        aligned = min(len(payloads), len(tokens) // bt) * bt
+        if aligned <= 0:
+            return 0
+        inserted = cache.insert(
+            tokens, lambda i, s, e: self.transfer(payloads[i]),
+            max_tokens=aligned, tenant=tenant, namespace=namespace)
+        with self._lock:
+            self.handoffs += 1
+            self.blocks_sent += inserted
+            self.tokens_sent += inserted * bt
+        return inserted
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {"transport": self.name, "handoffs": self.handoffs,
+                    "blocks_sent": self.blocks_sent,
+                    "tokens_sent": self.tokens_sent,
+                    "bytes_sent": self.bytes_sent}
+
+
+class SerializedKVHandoff(KVHandoff):
+    """Bytes-round-trip handoff behind the same interface — the
+    multi-host shape: every array of a block payload (int8 blocks and
+    their scales stay int8 — half the wire traffic, exactly the storage
+    win) is fetched to host bytes and rebuilt as a fresh device array on
+    the target side. In-process the dtype/shape header rides as Python
+    objects; a real transport would ship their names — the byte payload
+    is already the exact wire format."""
+
+    name = "serialized"
+
+    def transfer(self, payload: Any) -> Any:
+        import jax.numpy as jnp
+
+        out = []
+        total = 0
+        for a in payload:
+            arr = np.asarray(a)
+            blob = arr.tobytes()
+            total += len(blob)
+            rebuilt = np.frombuffer(blob, dtype=arr.dtype).reshape(
+                arr.shape)
+            out.append(jnp.asarray(rebuilt))
+        with self._lock:
+            self.bytes_sent += total
+        return tuple(out)
+
+
+HANDOFFS = {"zero_copy": KVHandoff, "serialized": SerializedKVHandoff}
+
+
+@dataclasses.dataclass
+class _DisaggReq:
+    """One coordinated request's lifecycle record."""
+    rid: int
+    prompt: list[int]
+    max_new: int
+    kw: dict[str, Any]            # decode-side submit kwargs
+    tenant: str | None
+    adapter: str | None
+    submit_s: float
+    deadline_at: float | None
+    blocks_needed: int = 0
+    stage: str = "queued"         # queued | prefill | decode | done
+    prefill_rid: int | None = None
+    decode_rid: int | None = None
+    dispatch_s: float | None = None   # left the queue (phase epoch)
+    handoff_s: float | None = None
+    blocks: int = 0               # blocks actually handed off
+    bypass: bool = False
+    reason: str | None = None     # local terminal reason (no decode rid)
+
+
+class PrefillQueue:
+    """Host-side prefill admission queue: pop() returns the next job by
+    shortest-remaining-prefill first WITHIN max-min tenant fairness —
+    among tenants with queued jobs, the one holding the fewest in-flight
+    prefills wins (tie: shorter best job, then FIFO); within the chosen
+    tenant, the job with the least remaining prefill compute. SRPT is
+    the TTFT-tail policy: a 64-token prompt never waits behind three
+    4k-token chains. `done(tenant)` returns a finished job's fairness
+    share."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q: dict[Any, list[_DisaggReq]] = {}
+        self._active: dict[Any, int] = {}
+        self._seq: dict[int, int] = {}     # rid -> FIFO tiebreak
+        self._n = 0
+        self.enqueued = 0
+        self.popped = 0
+
+    def push(self, job: _DisaggReq) -> None:
+        with self._lock:
+            self._q.setdefault(job.tenant, []).append(job)
+            if job.rid not in self._seq:
+                self._n += 1
+                self._seq[job.rid] = self._n
+                self.enqueued += 1
+
+    def pop(self, remaining: Callable[[_DisaggReq], int]) -> \
+            "_DisaggReq | None":
+        """`remaining(job)` = prefill tokens the worker would still have
+        to compute (prompt minus its cached prefix) — evaluated at pop
+        time so a prefix cached since enqueue re-ranks the job."""
+        with self._lock:
+            best = None   # (active, rem, seq, tenant, idx)
+            for tenant, jobs in self._q.items():
+                if not jobs:
+                    continue
+                act = self._active.get(tenant, 0)
+                for i, j in enumerate(jobs):
+                    key = (act, remaining(j), self._seq[j.rid])
+                    if best is None or key < best[0]:
+                        best = (key, tenant, i)
+            if best is None:
+                return None
+            _, tenant, i = best
+            job = self._q[tenant].pop(i)
+            if not self._q[tenant]:
+                del self._q[tenant]
+            self._active[tenant] = self._active.get(tenant, 0) + 1
+            self._seq.pop(job.rid, None)
+            self.popped += 1
+            return job
+
+    def done(self, tenant: Any) -> None:
+        with self._lock:
+            n = self._active.get(tenant, 0) - 1
+            if n > 0:
+                self._active[tenant] = n
+            else:
+                self._active.pop(tenant, None)
+
+    def remove(self, job: _DisaggReq) -> bool:
+        with self._lock:
+            jobs = self._q.get(job.tenant)
+            if jobs and job in jobs:
+                jobs.remove(job)
+                if not jobs:
+                    del self._q[job.tenant]
+                self._seq.pop(job.rid, None)
+                return True
+            return False
+
+    def depth(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._q.values())
+
+    def inflight(self) -> int:
+        with self._lock:
+            return sum(self._active.values())
+
+
+class DisaggregatedEngine:
+    """Coordinator over a prefill-role and a decode-role
+    `EngineSupervisor`. Exposes the engine surface every consumer
+    already speaks (submit/step/is_done/partial_result/result/
+    finish_reason/cancel/release/request_timing/metrics/...), with its
+    OWN stable rids — role restarts invalidate neither. The decode
+    supervisor is the replica's identity: its permanent failure is THE
+    replica's permanent failure (controller pruning, readiness); a
+    permanently-failed prefill role degrades to bypass (the decode
+    worker prefills colocated-style) instead of taking the replica
+    down."""
+
+    def __init__(self, prefill, decode, *,
+                 handoff: str | KVHandoff = "zero_copy",
+                 max_inflight_prefills: int | None = None):
+        self.prefill = prefill
+        self.decode = decode
+        peng, deng = prefill.engine, decode.engine
+        if peng is None or deng is None:
+            raise ValueError("both role supervisors must start alive")
+        if not getattr(deng, "prefix_cache_enabled", False) \
+                or not getattr(peng, "prefix_cache_enabled", False):
+            raise ValueError("disaggregated roles require prefix_cache "
+                             "(the handoff currency)")
+        if peng.prefix_block_tokens != deng.prefix_block_tokens:
+            raise ValueError(
+                f"role block sizes differ (prefill "
+                f"{peng.prefix_block_tokens} vs decode "
+                f"{deng.prefix_block_tokens}): handed-off chains would "
+                "never match")
+        self._bt = deng.prefix_block_tokens
+        if isinstance(handoff, str):
+            try:
+                handoff = HANDOFFS[handoff](lambda: self.decode.kvcache)
+            except KeyError:
+                raise ValueError(
+                    f"unknown handoff transport {handoff!r}; "
+                    f"known: {sorted(HANDOFFS)}") from None
+        self.handoff = handoff
+        self._max_inflight = (max_inflight_prefills
+                              or max(1, peng.n_slots))
+        self.queue = PrefillQueue()
+        self._lock = threading.RLock()
+        self._reqs: dict[int, _DisaggReq] = {}
+        self._next_rid = 1
+        self._accepted = 0
+        self._terminal = {"completed": 0, "cancelled": 0, "rejected": 0}
+        self._bypass = 0
+        self._blocks_inflight = 0
+        self._qwait_sum_ms = 0.0
+        self._qwait_n = 0
+        self._pump_errors = 0
+        self._last_pump_error: str | None = None
+        # the DEDICATED prefill worker: its supervisor is driven by its
+        # own thread (queue dispatch → prefill steps → handoff → decode
+        # submit), so long-prompt prefill compute OVERLAPS decode
+        # instead of time-slicing the caller's step loop — the whole
+        # point of the split. step() drives only the decode role.
+        self._stop = threading.Event()
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_loop, daemon=True,
+            name="disagg-prefill-worker")
+        self._prefill_thread.start()
+
+    # -- submit-side API ------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, adapter: str | None = None,
+               top_k: int = 0, top_p: float = 1.0,
+               presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               seed: int | None = None, stop=None,
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> int:
+        from kubeflow_tpu.serving.scheduler import QueueFull
+
+        if self.failed:
+            raise QueueFull("decode backend permanently failed "
+                            "(restart budget exhausted)")
+        deng = self.decode.engine
+        if deng is not None:
+            # reject bad arguments on the CALLER's thread — the pump runs
+            # on the engine loop, where an exception kills serving for
+            # everyone (engine down: the journal-as-queue path accepts
+            # and surfaces errors at replay as recorded rejections)
+            deng._validate_submit(prompt, temperature, adapter, top_k,
+                                  top_p, presence_penalty,
+                                  frequency_penalty, seed, stop,
+                                  deadline_s, tenant)
+        kw = dict(temperature=temperature, adapter=adapter, top_k=top_k,
+                  top_p=top_p, presence_penalty=presence_penalty,
+                  frequency_penalty=frequency_penalty, seed=seed,
+                  stop=stop, tenant=tenant)
+        now = time.monotonic()
+        with self._lock:
+            r = _DisaggReq(
+                rid=self._next_rid, prompt=list(prompt),
+                max_new=max_new_tokens, kw=kw, tenant=tenant,
+                adapter=adapter, submit_s=now,
+                deadline_at=(now + deadline_s if deadline_s is not None
+                             else None))
+            self._next_rid += 1
+            self._reqs[r.rid] = r
+            self._accepted += 1
+            aligned = (len(r.prompt) // self._bt) * self._bt
+            r.blocks_needed = aligned // self._bt
+            if aligned < self._bt or self.prefill.failed:
+                # nothing to hand off (short prompt), or the prefill role
+                # is permanently dead: bypass straight to the decode
+                # worker, surfacing its admission errors to the caller
+                try:
+                    self._to_decode(r, bypass=True, raise_errors=True)
+                except BaseException:
+                    del self._reqs[r.rid]
+                    self._accepted -= 1
+                    raise
+            else:
+                self.queue.push(r)
+        return r.rid
+
+    #: how long an accepted request may wait for decode admission (queue
+    #: full / tenant cap at handoff time) before it is finalized as a
+    #: recorded rejection — only applies when the request carries no
+    #: deadline of its own
+    decode_wait_s = 60.0
+
+    def _to_decode(self, r: _DisaggReq, *, bypass: bool = False,
+                   raise_errors: bool = False) -> None:
+        """Submit one request to the decode supervisor (lock held)."""
+        kw = dict(r.kw)
+        if r.deadline_at is not None:
+            rem = r.deadline_at - time.monotonic()
+            if rem <= 0:
+                self._finalize(r, "cancelled")
+                return
+            kw["deadline_s"] = rem
+        if bypass and not r.bypass:
+            r.bypass = True
+            self._bypass += 1
+        try:
+            r.decode_rid = self.decode.submit(list(r.prompt), r.max_new,
+                                              **kw)
+        except Exception:
+            if raise_errors:
+                raise
+            # decode admission refused it mid-pipeline (queue full /
+            # tenant cap) AFTER the coordinator already accepted it:
+            # finalizing 'rejected' here would hand the client a silent
+            # empty 200 where the colocated path would have 503'd at
+            # submit — hold the request and RETRY until its deadline
+            # (decode slots churn constantly); _pump_decode gives up at
+            # the deadline with a recorded rejection
+            r.stage = "decode_wait"
+            return
+        r.stage = "decode"
+        if r.dispatch_s is None:
+            r.dispatch_s = time.monotonic()
+
+    def _finalize(self, r: _DisaggReq, reason: str) -> None:
+        if r.stage == "done":
+            return
+        r.stage = "done"
+        if r.reason is None and r.decode_rid is None:
+            r.reason = reason
+        key = ("completed" if reason in ("stop", "length")
+               else "rejected" if reason == "rejected" else "cancelled")
+        self._terminal[key] += 1
+
+    # -- the drive loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One coordinated iteration of the DECODE role (the prefill
+        worker runs on its own thread). False only when decode is idle
+        and nothing is queued or mid-prefill."""
+        worked = self.decode.step()
+        self._pump_decode()
+        with self._lock:
+            busy = any(r.stage in ("queued", "prefill", "handoff",
+                                   "decode_wait")
+                       for r in self._reqs.values())
+        if not worked and busy:
+            # decode is starved waiting on the prefill worker: yield the
+            # core instead of spinning against its thread
+            time.sleep(0.0005)
+        return worked or busy
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def _prefill_loop(self) -> None:
+        """The dedicated prefill worker's drive loop: dispatch queued
+        jobs (SRPT under backpressure), step the supervised prefill
+        engine, and hand finished KV off to the decode role. Exceptions
+        never escape (the loop must survive a broken pump), and never
+        vanish either: they land in the pump_errors counter + last
+        error string that metrics()["disagg"] surfaces."""
+        while not self._stop.is_set():
+            try:
+                worked = self._pump_prefill()
+                worked = self.prefill.step() or worked
+            except Exception as e:
+                # the loop itself must survive (supervisor-level errors
+                # have their own recovery story) — but never silently:
+                # the counter + last error ride metrics()["disagg"] so a
+                # wedged pump is diagnosable, not a mystery hang
+                with self._lock:
+                    self._pump_errors += 1
+                    self._last_pump_error = f"{type(e).__name__}: {e}"
+                worked = False
+            if not worked:
+                self._stop.wait(0.002)
+
+    def _remaining_prefill(self, r: _DisaggReq) -> int:
+        """Prefill tokens the worker would still compute for this job —
+        the SRPT key (an unpinned radix probe; no LRU touch)."""
+        cache = self.prefill.kvcache
+        if cache is None:
+            return len(r.prompt)
+        cached = cache.cached_prefix_len(
+            r.prompt, max_tokens=len(r.prompt) - 1,
+            namespace=self._namespace(r.adapter))
+        return len(r.prompt) - cached
+
+    def _namespace(self, adapter: str | None) -> int:
+        if adapter is None:
+            return 0
+        eng = self.decode.engine or self.prefill.engine
+        idx = getattr(eng, "_adapter_idx", {}) if eng is not None else {}
+        return idx.get(adapter, 0)
+
+    def _decode_kv_available(self) -> int | None:
+        """Blocks the decode worker's KV pool can still absorb: free +
+        evictable, minus blocks already promised to in-flight prefills.
+        None while the decode engine is down (unknown — don't gate)."""
+        cache = self.decode.kvcache
+        if cache is None:
+            return None
+        st = cache.stats()
+        free = st["capacity_blocks"] - st["blocks"]
+        return (free + st.get("evictable_blocks", 0)
+                - self._blocks_inflight)
+
+    def _pump_prefill(self) -> bool:
+        """Prefill-worker-thread half of the state machine. Returns True
+        if anything moved."""
+        now = time.monotonic()
+        moved = False
+        with self._lock:
+            # 1) deadline sweep over jobs the decode engine cannot yet
+            #    see (its own deadline machinery takes over after submit)
+            for r in list(self._reqs.values()):
+                if r.deadline_at is None or now < r.deadline_at:
+                    continue
+                if r.stage == "queued":
+                    self.queue.remove(r)
+                    self._finalize(r, "cancelled")
+                    moved = True
+                elif r.stage == "prefill":
+                    self._abort_prefill(r)
+                    self._finalize(r, "cancelled")
+                    moved = True
+            # 2) harvest finished prefills (the handoff itself runs
+            #    OUTSIDE the lock below: a serialized transfer crosses
+            #    the host per block, and client-facing calls must not
+            #    stall behind it)
+            finished: list[tuple[_DisaggReq, str]] = []
+            for r in list(self._reqs.values()):
+                if r.stage != "prefill" \
+                        or not self.prefill.is_done(r.prefill_rid):
+                    continue
+                reason = self.prefill.finish_reason(r.prefill_rid)
+                self.prefill.release(r.prefill_rid)
+                r.prefill_rid = None
+                self.queue.done(r.tenant)
+                self._blocks_inflight = max(
+                    0, self._blocks_inflight - r.blocks_needed)
+                r.stage = "handoff"
+                finished.append((r, reason))
+                moved = True
+            # 3) dispatch queued jobs under the inflight cap and decode-
+            #    KV backpressure
+            while self.queue.inflight() < self._max_inflight:
+                job = self.queue.pop(self._remaining_prefill)
+                if job is None:
+                    break
+                if job.stage != "queued":
+                    self.queue.done(job.tenant)
+                    continue
+                avail = self._decode_kv_available()
+                if (avail is not None and job.blocks_needed > avail
+                        and self.queue.inflight() > 1):
+                    # decode KV cannot absorb this output yet: hold it
+                    # (and everything behind it) until blocks free up.
+                    # With nothing else in flight we dispatch anyway —
+                    # the handoff degrades to a partial insert, never a
+                    # deadlock.
+                    self.queue.done(job.tenant)   # un-take the share
+                    self.queue.push(job)
+                    break
+                try:
+                    job.prefill_rid = self.prefill.submit(
+                        list(job.prompt), 1, adapter=job.adapter,
+                        tenant=job.tenant)
+                except Exception:
+                    # prefill admission refused (queue full / shed /
+                    # permanently failed): degrade to bypass
+                    self.queue.done(job.tenant)
+                    self._to_decode(job, bypass=True)
+                    continue
+                job.stage = "prefill"
+                job.dispatch_s = time.monotonic()
+                self._qwait_sum_ms += (job.dispatch_s - job.submit_s) * 1e3
+                self._qwait_n += 1
+                self._blocks_inflight += job.blocks_needed
+                moved = True
+        # the handoff: lock-free device/host work, then a short re-lock
+        # to advance the state machine (a cancel() that landed mid-
+        # transfer wins — the moved blocks just sit in the decode cache
+        # as ordinary reusable prefix KV)
+        for r, reason in finished:
+            blocks = (self._handoff(r) if reason in ("stop", "length")
+                      else 0)
+            with self._lock:
+                if r.stage != "handoff":
+                    continue
+                r.blocks = blocks
+                r.handoff_s = time.monotonic()
+                # a prefill-side rejection/cancellation (e.g. the
+                # replacement engine's queue refused the replay) still
+                # serves colocated-style on the decode worker
+                self._to_decode(r, bypass=reason not in ("stop",
+                                                         "length"))
+        return moved
+
+    def _pump_decode(self) -> None:
+        """Decode-side bookkeeping (runs on the caller's step loop):
+        observe decode completions for the zero-lost accounting, and
+        retry decode_wait requests (decode admission refused at handoff
+        time) until their deadline."""
+        now = time.monotonic()
+        with self._lock:
+            for r in list(self._reqs.values()):
+                if r.stage == "decode_wait":
+                    limit = (r.deadline_at
+                             if r.deadline_at is not None
+                             else r.submit_s + self.decode_wait_s)
+                    if now >= limit:
+                        self._finalize(r, "rejected")
+                    else:
+                        self._to_decode(r, bypass=r.bypass)
+                elif r.stage == "decode" and self.decode.is_done(
+                        r.decode_rid):
+                    self._finalize(r,
+                                   self.decode.finish_reason(r.decode_rid))
+
+    def _handoff(self, r: _DisaggReq) -> int:
+        """Match the finished prefill's banked chain and send it to the
+        decode worker's cache. Best-effort by design: a crashed prefill
+        engine (empty fresh cache), an evicted chain, or a down decode
+        engine all yield a short/zero send — the decode worker recomputes
+        the difference."""
+        cache = self.prefill.kvcache
+        if cache is None:
+            return 0
+        ns = self._namespace(r.adapter)
+        aligned = (len(r.prompt) // self._bt) * self._bt
+        m = cache.match(r.prompt, max_tokens=aligned, namespace=ns)
+        try:
+            return self.handoff.send(r.prompt, list(m.payloads),
+                                     namespace=ns, tenant=r.tenant)
+        finally:
+            cache.release(m)
+
+    def _abort_prefill(self, r: _DisaggReq) -> None:
+        """Drop a prefill-stage job's worker-side state (lock held)."""
+        if r.prefill_rid is not None:
+            self.prefill.cancel(r.prefill_rid)
+            self.prefill.release(r.prefill_rid)
+            r.prefill_rid = None
+        self.queue.done(r.tenant)
+        self._blocks_inflight = max(
+            0, self._blocks_inflight - r.blocks_needed)
+
+    # -- request-side API -----------------------------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.stage == "done":
+                return False
+            if r.stage == "queued":
+                self.queue.remove(r)
+                self._finalize(r, "cancelled")
+                return True
+            if r.stage == "prefill":
+                self._abort_prefill(r)
+                self._finalize(r, "cancelled")
+                return True
+            if r.stage in ("handoff", "decode_wait"):
+                # prefill-side state is already cleaned; the in-flight
+                # handoff (if any) checks the stage before proceeding
+                self._finalize(r, "cancelled")
+                return True
+            return self.decode.cancel(r.decode_rid)
+
+    def is_done(self, rid: int) -> bool:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.stage == "done":
+                return True
+            if r.stage == "decode":
+                return self.decode.is_done(r.decode_rid)
+            return False
+
+    def result(self, rid: int) -> list[int]:
+        with self._lock:
+            r = self._reqs[rid]
+            if r.decode_rid is not None:
+                return self.decode.result(r.decode_rid)
+            if r.stage != "done":
+                raise KeyError(f"request {rid} not finished")
+            return []
+
+    def result_logprobs(self, rid: int) -> list[float]:
+        with self._lock:
+            r = self._reqs[rid]
+            if r.decode_rid is not None:
+                return self.decode.result_logprobs(r.decode_rid)
+            if r.stage != "done":
+                raise KeyError(f"request {rid} not finished")
+            return []
+
+    def result_top_logprobs(self, rid: int) -> list[dict[int, float]]:
+        with self._lock:
+            r = self._reqs[rid]
+            if r.decode_rid is not None:
+                return self.decode.result_top_logprobs(r.decode_rid)
+            return []
+
+    def partial_result(self, rid: int) -> list[int]:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.decode_rid is None:
+                return []
+            return self.decode.partial_result(r.decode_rid)
+
+    def partial_logprobs(self, rid: int) -> list[float]:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.decode_rid is None:
+                return []
+            return self.decode.partial_logprobs(r.decode_rid)
+
+    def finish_reason(self, rid: int) -> str:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None:
+                return "length"
+            if r.decode_rid is not None:
+                return self.decode.finish_reason(r.decode_rid)
+            return r.reason or "length"
+
+    def usage_chain(self, rid: int) -> list[str]:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.decode_rid is None:
+                return []
+            return self.decode.usage_chain(r.decode_rid)
+
+    def cached_tokens(self, rid: int) -> int:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r.decode_rid is None:
+                return 0
+            return self.decode.cached_tokens(r.decode_rid)
+
+    def request_timing(self, rid: int) -> dict[str, Any]:
+        """The engine-shaped timing record, with the phase split mapped
+        onto the disaggregated pipeline: queue_wait_ms = submit → the
+        job leaving the coordinator's prefill queue; prefill_ms = queue
+        exit → first token (prefill-worker compute + handoff + the
+        decode-side tail continuation); decode_ms as always."""
+        with self._lock:
+            r = self._reqs[rid]
+            first = fin = None
+            n_tokens = 0
+            cached = 0
+            if r.decode_rid is not None:
+                tm = self.decode.request_timing(r.decode_rid)
+                first, fin = tm["first_token_s"], tm["finish_s"]
+                n_tokens = tm["n_tokens"]
+                cached = tm.get("cached_prefix_len", 0)
+            elif r.stage == "done":
+                fin = r.handoff_s or r.dispatch_s
+
+        def ms(a, b):
+            return (round((b - a) * 1e3, 3)
+                    if a is not None and b is not None else None)
+
+        return {
+            "submit_s": r.submit_s,
+            "first_token_s": first,
+            "finish_s": fin,
+            "tenant": r.tenant,
+            "n_tokens": n_tokens,
+            "prompt_len": len(r.prompt),
+            "cached_prefix_len": cached,
+            "prefill_tokens": len(r.prompt) - cached,
+            "queue_wait_ms": ms(r.submit_s, r.dispatch_s),
+            "prefill_ms": ms(r.dispatch_s, first),
+            "decode_ms": ms(first, fin),
+        }
+
+    def release(self, rid: int) -> None:
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None:
+                return
+            if r.stage != "done":
+                # the client may release the instant is_done() flips —
+                # possibly before the driver thread's _pump_decode
+                # observed the completion. Finalize HERE, or the
+                # terminal counters undercount and accounting() reports
+                # a phantom loss forever (the zero-lost floor).
+                if r.decode_rid is not None:
+                    if self.decode.is_done(r.decode_rid):
+                        self._finalize(r, self.decode.finish_reason(
+                            r.decode_rid))
+                    else:
+                        self.decode.cancel(r.decode_rid)
+                        self._finalize(r, "cancelled")
+                else:
+                    if r.stage == "queued":
+                        self.queue.remove(r)
+                    elif r.stage == "prefill":
+                        self._abort_prefill(r)
+                    self._finalize(r, "cancelled")
+            del self._reqs[rid]
+            if r.decode_rid is not None:
+                self.decode.release(r.decode_rid)
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0, adapter: str | None = None,
+                 **kw) -> list[int]:
+        rid = self.submit(prompt, max_new_tokens, temperature,
+                          adapter=adapter, **kw)
+        while not self.is_done(rid):
+            if not self.step():
+                raise RuntimeError("engine idle with request outstanding")
+        return self.result(rid)
+
+    # -- knobs / passthroughs -------------------------------------------------
+
+    @property
+    def failed(self) -> bool:
+        """The replica's permanent failure IS the decode role's — a dead
+        prefill role degrades to bypass, it does not kill serving."""
+        return bool(self.decode.failed)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.decode.degraded or self.prefill.degraded
+                    or self.prefill.failed)
+
+    @property
+    def kvcache(self):
+        return self.decode.kvcache
+
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        return self.decode.prefix_cache_enabled
+
+    @property
+    def _adapter_idx(self):
+        return self.decode._adapter_idx
+
+    @property
+    def injector(self):
+        return self.decode.injector
+
+    @property
+    def decode_chunk(self) -> int:
+        return self.decode.decode_chunk
+
+    @property
+    def decode_chunk_max(self) -> int:
+        return self.decode.decode_chunk_max
+
+    def set_decode_chunk(self, chunk: int) -> int:
+        return self.decode.set_decode_chunk(chunk)
+
+    def set_tenant_limits(self, max_active_per_tenant: int = 0,
+                          max_queued_per_tenant: int = 0) -> None:
+        self.decode.set_tenant_limits(max_active_per_tenant,
+                                      max_queued_per_tenant)
+        self.prefill.set_tenant_limits(max_active_per_tenant,
+                                       max_queued_per_tenant)
+
+    def arm_faults(self, script) -> "DisaggregatedEngine":
+        """Default chaos target: the decode role (the replica's
+        identity). Arm the prefill role explicitly via
+        `self.prefill.arm_faults(...)` — the prefill-crash drill."""
+        self.decode.arm_faults(script)
+        return self
+
+    # -- accounting / metrics -------------------------------------------------
+
+    def accounting(self) -> dict[str, Any]:
+        """Coordinator-level zero-lost contract: every accepted request
+        is queued, in a role's journal, or terminal — `lost` MUST be 0.
+        Role recovery detail rides under `prefill`/`decode`."""
+        dacc = self.decode.accounting()
+        pacc = self.prefill.accounting()
+        with self._lock:
+            inflight = sum(
+                1 for r in self._reqs.values()
+                if r.stage in ("queued", "prefill", "handoff",
+                               "decode_wait")
+                or (r.stage == "decode"
+                    and not self.decode.is_done(r.decode_rid)))
+            term = dict(self._terminal)
+            accepted = self._accepted
+        terminal = sum(term.values())
+        return {
+            "accepted": accepted,
+            "completed": term["completed"],
+            "cancelled": term["cancelled"],
+            "rejected": term["rejected"],
+            "in_flight": inflight,
+            "terminal": terminal,
+            "lost": accepted - terminal - inflight,
+            "restarts": dacc["restarts"] + pacc["restarts"],
+            "replayed": dacc["replayed"] + pacc["replayed"],
+            "retried": dacc["retried"] + pacc["retried"],
+            "replay_verified": (dacc["replay_verified"]
+                                + pacc["replay_verified"]),
+            "replay_mismatch": (dacc["replay_mismatch"]
+                                + pacc["replay_mismatch"]),
+            "shed": dacc["shed"] + pacc["shed"],
+            "outages": dacc["outages"] + pacc["outages"],
+            "mttr_s": dacc["mttr_s"],
+            "permanent_failed": self.failed,
+            "last_mttr_s": dacc["last_mttr_s"],
+            "journal_depth": dacc["journal_depth"],
+            "prefill": {k: pacc[k] for k in
+                        ("accepted", "completed", "cancelled", "rejected",
+                         "restarts", "mttr_s", "journal_depth")},
+            "decode": {k: dacc[k] for k in
+                       ("accepted", "completed", "cancelled", "rejected",
+                        "restarts", "mttr_s", "journal_depth")},
+        }
+
+    def metrics(self) -> dict[str, Any]:
+        out = self.decode.metrics()   # decode engine + its supervisor
+        out["supervisor"] = self.accounting()
+        with self._lock:
+            qn = self._qwait_n
+            disagg = {
+                "queue_depth": self.queue.depth(),
+                "inflight_prefills": self.queue.inflight(),
+                "blocks_in_flight": self._blocks_inflight,
+                "bypass": self._bypass,
+                "queue_wait_ms_mean": (round(self._qwait_sum_ms / qn, 3)
+                                       if qn else None),
+                "handoff": self.handoff.stats(),
+                "prefill_permanent_failed": bool(self.prefill.failed),
+                "prefill_restarts":
+                    self.prefill.accounting()["restarts"],
+                "pump_errors": self._pump_errors,
+                "last_pump_error": self._last_pump_error,
+            }
+        peng = self.prefill.engine
+        if peng is not None:
+            pm = peng.metrics()
+            disagg["prefill_cache"] = pm.get("prefix_cache")
+        deng = self.decode.engine
+        if deng is not None:
+            disagg["decode_full_prefills"] = getattr(
+                deng, "full_prefills", None)
+        out["disagg"] = disagg
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._prefill_thread.is_alive():
+            self._prefill_thread.join(timeout=10)
+        self.prefill.close()
+        self.decode.close()

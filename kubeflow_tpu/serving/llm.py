@@ -330,6 +330,10 @@ class LLMEngine:
         self._finish_reasons: dict[int, str] = {}
 
         self._prompts: dict[int, list[int]] = {}
+        # rid -> instant its prefill left the queue (the engine popped
+        # its PrefillAction): the queue_wait/prefill/decode phase split
+        # request_timing() reports (the bench's interference attribution)
+        self._prefill_start_t: dict[int, float] = {}
         self._results: dict[int, list[int]] = {}
         self._logprobs: dict[int, list[float]] = {}
         self._toplogprobs: dict[int, list[dict[int, float]]] = {}
@@ -1199,44 +1203,16 @@ class LLMEngine:
         plan.append((tail, t))
         return plan
 
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               temperature: float = 0.0,
-               adapter: str | None = None,
-               top_k: int = 0, top_p: float = 1.0,
-               presence_penalty: float = 0.0,
-               frequency_penalty: float = 0.0,
-               seed: int | None = None,
-               stop: Sequence[Sequence[int]] | None = None,
-               deadline_s: float | None = None,
-               tenant: str | None = None) -> int:
-        """Queue one request. top_k (0 = off) / top_p (1.0 = off) filter
-        the sampled distribution inside the compiled programs (only when
-        temperature > 0 — greedy rows stay bit-exact argmax).
-        presence/frequency penalties (OpenAI [-2, 2]; 0 = off) are logit
-        edits over the request's GENERATED tokens (the vLLM convention),
-        applied inside the compiled programs before temperature/filters —
-        they affect greedy requests too (penalized argmax). Nonzero
-        penalties are quantized to milli units with a floor of ±1 milli
-        (like the top_p micro guard): |v| < 0.0005 stays a minimal
-        penalty instead of silently turning off. `seed` makes
-        temp>0 sampling reproducible: the row's PRNG keys derive from
-        (seed, position) alone, independent of slot, batch composition,
-        decode chunking, or engine restarts. Seeds ride the f32 sampling
-        row, so they are folded onto 24 bits via a splitmix64 mixing
-        hash (_fold_seed24): distinct seeds can collide (~2^-24 per
-        pair — unavoidable at this width), but unlike a plain modulus
-        the colliding pairs are not predictable from the seed values,
-        and the fold is deterministic so a given seed replays the same
-        stream forever. `stop`: token-id sequences;
-        generation ends (finish_reason "stop") when the output ends with
-        one, and the matched sequence is excluded from the result (OpenAI
-        semantics; matching is host-side at chunk boundaries, so at most
-        one decode chunk of surplus is computed). `deadline_s`:
-        wall-clock budget; past it the request is cancelled at the next
-        chunk boundary (finish_reason "cancelled"). `tenant`: optional
-        tenant name — requests of the same tenant share a scheduler queue
-        and the max-min fair pop / admission caps (set_tenant_limits)
-        apply per tenant; None rides the anonymous tenant-0 queue."""
+    def _validate_submit(self, prompt, temperature, adapter, top_k, top_p,
+                         presence_penalty, frequency_penalty, seed, stop,
+                         deadline_s, tenant):
+        """Every submit()-time argument check, factored out so the
+        disaggregated coordinator (serving/disagg.py) can reject a bad
+        request EAGERLY — on the caller's thread, before the job enters
+        the prefill queue — instead of poisoning the engine-loop thread
+        at dispatch time. Raises exactly what submit() would; returns the
+        normalized (temperature, top_k, top_p, presence, frequency,
+        folded_seed, stop_seqs, adapter_id) tuple submit() enqueues."""
         import math
 
         # a NaN/inf/huge value would blow up later INSIDE the engine loop
@@ -1285,26 +1261,73 @@ class LLMEngine:
             # _tenant_idx for the engine's lifetime, so both the count
             # AND the bytes must be bounded against adversarial clients
             raise ValueError("tenant must be a string of 1..256 chars")
-        sched_len = len(prompt)
-        if sched_len > self.buckets[-1]:
+        if len(prompt) > self.buckets[-1]:
             # chunked prefill: validate the chain now (fail at submit, not
             # mid-serve); the scheduler sees the largest bucket — it only
             # uses the length for bucket choice, the engine keeps the truth
-            try:
-                self._chunk_plan(sched_len)
-            except PromptTooLong:
+            self._chunk_plan(len(prompt))
+        return (temperature, top_k, top_p, presence_penalty,
+                frequency_penalty, seed, stop_seqs, aid)
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               adapter: str | None = None,
+               top_k: int = 0, top_p: float = 1.0,
+               presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               seed: int | None = None,
+               stop: Sequence[Sequence[int]] | None = None,
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> int:
+        """Queue one request. top_k (0 = off) / top_p (1.0 = off) filter
+        the sampled distribution inside the compiled programs (only when
+        temperature > 0 — greedy rows stay bit-exact argmax).
+        presence/frequency penalties (OpenAI [-2, 2]; 0 = off) are logit
+        edits over the request's GENERATED tokens (the vLLM convention),
+        applied inside the compiled programs before temperature/filters —
+        they affect greedy requests too (penalized argmax). Nonzero
+        penalties are quantized to milli units with a floor of ±1 milli
+        (like the top_p micro guard): |v| < 0.0005 stays a minimal
+        penalty instead of silently turning off. `seed` makes
+        temp>0 sampling reproducible: the row's PRNG keys derive from
+        (seed, position) alone, independent of slot, batch composition,
+        decode chunking, or engine restarts. Seeds ride the f32 sampling
+        row, so they are folded onto 24 bits via a splitmix64 mixing
+        hash (_fold_seed24): distinct seeds can collide (~2^-24 per
+        pair — unavoidable at this width), but unlike a plain modulus
+        the colliding pairs are not predictable from the seed values,
+        and the fold is deterministic so a given seed replays the same
+        stream forever. `stop`: token-id sequences;
+        generation ends (finish_reason "stop") when the output ends with
+        one, and the matched sequence is excluded from the result (OpenAI
+        semantics; matching is host-side at chunk boundaries, so at most
+        one decode chunk of surplus is computed). `deadline_s`:
+        wall-clock budget; past it the request is cancelled at the next
+        chunk boundary (finish_reason "cancelled"). `tenant`: optional
+        tenant name — requests of the same tenant share a scheduler queue
+        and the max-min fair pop / admission caps (set_tenant_limits)
+        apply per tenant; None rides the anonymous tenant-0 queue."""
+        try:
+            (temperature, top_k, top_p, presence_penalty,
+             frequency_penalty, seed, stop_seqs, aid) = \
+                self._validate_submit(prompt, temperature, adapter, top_k,
+                                      top_p, presence_penalty,
+                                      frequency_penalty, seed, stop,
+                                      deadline_s, tenant)
+        except PromptTooLong:
+            if len(prompt) > self.buckets[-1]:
                 # bump the scheduler's rejected counter (the operator
                 # metric) but surface the chunk-aware message, not the
                 # scheduler's generic "exceeds buckets"
                 with self._submit_lock:
                     try:
-                        self.scheduler.submit(sched_len, max_new_tokens,
+                        self.scheduler.submit(len(prompt), max_new_tokens,
                                               time.monotonic(),
                                               tenant=self._tenant_id(tenant))
                     except PromptTooLong:
                         pass
-                raise
-            sched_len = self.buckets[-1]
+            raise
+        sched_len = min(len(prompt), self.buckets[-1])
         with self._submit_lock:
             req_id = self.scheduler.submit(sched_len, max_new_tokens,
                                            time.monotonic(),
@@ -1428,6 +1451,12 @@ class LLMEngine:
                 break   # Decode/None: dropping is safe — the decode pass
                         # re-derives from slot state on the next step()
             actions.append(nxt)
+        t_prefill = time.monotonic()
+        for a in actions:
+            # phase epoch: the request's prefill left the queue now (a
+            # chunked chain keeps its FIRST pop — the whole chain is one
+            # prefill phase)
+            self._prefill_start_t.setdefault(a.req_id, t_prefill)
         # prompts longer than the largest bucket peel off into chained
         # chunked prefills; prefix-cache hits into continuation programs
         # (tail-only compute); everything else groups by bucket, one
@@ -1800,6 +1829,7 @@ class LLMEngine:
         self._req_tenant.pop(req_id, None)
         self._cached_prefix.pop(req_id, None)
         self._req_plen.pop(req_id, None)
+        self._prefill_start_t.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
@@ -1825,19 +1855,36 @@ class LLMEngine:
         the prefix-reuse fields — prompt_len, cached_prefix_len (KV
         tokens reused from the radix cache; 0 until the prefill lands or
         with the cache off) and prefill_tokens (what was actually
-        computed). Read BEFORE release() — release drops all of it."""
+        computed) — plus the explicit PHASE split (the disagg bench's
+        interference attribution): queue_wait_ms (submit → the prefill
+        leaving the queue), prefill_ms (queue exit → first token) and
+        decode_ms (first token → finish), each None until its phase
+        boundary lands. Read BEFORE release() — release drops all of
+        it."""
         plen = self._req_plen.get(req_id)
         cached = self._cached_prefix.get(req_id, 0)
+        sub = self._submit_t.get(req_id)
+        pstart = self._prefill_start_t.get(req_id)
+        first = self._first_token_t.get(req_id)
+        fin = self._finish_t.get(req_id)
+
+        def ms(a, b):
+            return (round((b - a) * 1e3, 3)
+                    if a is not None and b is not None else None)
+
         return {
-            "submit_s": self._submit_t.get(req_id),
-            "first_token_s": self._first_token_t.get(req_id),
-            "finish_s": self._finish_t.get(req_id),
+            "submit_s": sub,
+            "first_token_s": first,
+            "finish_s": fin,
             "tenant": self._req_tenant.get(req_id),
             "n_tokens": len(self._results.get(req_id, ())),
             "prompt_len": plen,
             "cached_prefix_len": cached,
             "prefill_tokens": (plen - cached if plen is not None
                                else None),
+            "queue_wait_ms": ms(sub, pstart),
+            "prefill_ms": ms(pstart, first),
+            "decode_ms": ms(first, fin),
         }
 
     def cached_tokens(self, req_id: int) -> int:
@@ -2401,3 +2448,83 @@ class LLMEngine:
             self._req_aids.pop(req_id, None)
             self._deadlines.pop(req_id, None)
         return freed
+
+
+# -- disaggregated serving roles (ISSUE 13, ROADMAP #3) -----------------------
+#
+# Prefill and decode want opposite things from one engine: prefill is a
+# bursty, compute-bound batch job whose chained dispatches block the step
+# loop for a whole chunk plan, while decode wants short, uniform steps —
+# interleaving them is exactly the interference the loadgen per-bucket
+# TTFT table measures (a 4k-token prompt arriving mid-window spikes every
+# active request's TPOT). The disaggregated configuration
+# (serving/disagg.py) splits the two onto dedicated engine ROLES and moves
+# the finished KV between them as radix-cache block payloads — the r10
+# handoff currency. Both roles are ordinary LLMEngines (one program menu,
+# one scheduler, one parity story); the role classes below only pin the
+# contract each side of the split relies on. Like LLMEngine itself, role
+# engines may only be constructed inside supervisor factory functions
+# (scripts/check_dataplane.py lints all three names).
+
+
+class PrefillEngine(LLMEngine):
+    """Dedicated prefill worker: runs (chunked) prefill — starting from
+    the longest chain its own radix prefix cache already holds — and
+    STOPS at KV materialization. Every submission is clamped to ONE
+    greedy token, which the scheduler counts as the request's completion
+    AT the prefill, so the step loop never dispatches a decode program
+    and a queued long prompt never steals a decode step from anyone.
+    The single sampled token is a byproduct the coordinator discards
+    (greedy, so a crash-replay of an un-handed-off prefill is
+    byte-deterministic); the PRODUCT is the banked block-aligned prefix
+    KV in self.kvcache, which the coordinator matches and hands to the
+    decode worker through a KVHandoff (serving/disagg.py)."""
+
+    role = "prefill"
+
+    def __init__(self, params, cfg, **kw):
+        # the radix cache IS the handoff staging area — a prefill worker
+        # without it would materialize KV with no way to export it
+        kw["prefix_cache"] = True
+        super().__init__(params, cfg, **kw)
+
+    def submit(self, prompt, max_new_tokens: int = 1,
+               temperature: float = 0.0, **kw) -> int:
+        # max_new/temperature are clamped, not honored: KV
+        # materialization is the entire job, and greedy keeps the
+        # supervisor's journal-replay byte-exact
+        return super().submit(prompt, 1, 0.0, **kw)
+
+
+class DecodeEngine(LLMEngine):
+    """Dedicated decode worker: admissions are EXPECTED to find their
+    block-aligned prompt prefix already in the radix cache (a KVHandoff
+    inserted it), so per-request prefill compute is at most one tail
+    bucket of continuation — decode steps stay short and uniform. A
+    full/chunked prefill here means the handoff was missed (an eviction
+    raced the insert, or a supervisor replay landed on a fresh post-crash
+    cache): counted in `full_prefills`, never fatal — the decode worker
+    degrades to colocated behavior rather than refusing the request,
+    which is what keeps the crash-recovery story identical to r11's."""
+
+    role = "decode"
+
+    def __init__(self, params, cfg, **kw):
+        kw["prefix_cache"] = True
+        super().__init__(params, cfg, **kw)
+        # admissions (>= 1 block of prompt) that found NO cached prefix
+        # and paid a full prefill — the disagg miss counter
+        self.full_prefills = 0
+
+    def _prefix_lookup(self, action):
+        hit = super()._prefix_lookup(action)
+        if hit is None and self.prefix_block_tokens \
+                and len(self._prompts.get(action.req_id, ())) - 1 \
+                >= self.prefix_block_tokens:
+            self.full_prefills += 1
+        return hit
+
+    def metrics(self):
+        out = super().metrics()
+        out["disagg_full_prefills"] = self.full_prefills
+        return out

@@ -68,6 +68,9 @@ class LLMModel(Model):
                  supervised: bool = True,
                  supervisor: dict[str, Any] | None = None,
                  sse_keepalive_s: float = 15.0,
+                 disaggregated: bool = False,
+                 disagg: dict[str, Any] | None = None,
+                 usage_timing: bool = False,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -128,6 +131,24 @@ class LLMModel(Model):
         # compile only the programs it touches (the fast-lane setting).
         self._supervised = supervised
         self._sup_cfg = dict(supervisor or {})
+        # config.disaggregated (ISSUE 13): split serving into a
+        # dedicated PREFILL worker (chunked prefill → radix KV blocks,
+        # never a decode step) and a DECODE worker (admits via KV
+        # handoff, never a full prefill in steady state), each behind
+        # its own EngineSupervisor, coordinated by
+        # serving/disagg.DisaggregatedEngine. config.disagg tunes it:
+        # {handoff: zero_copy|serialized, prefill_slots: N,
+        #  max_inflight_prefills: N}.
+        self._disaggregated = bool(disaggregated)
+        self._disagg_cfg = dict(disagg or {})
+        if self._disaggregated and not supervised:
+            raise ValueError(
+                "disaggregated serving requires supervised: true (each "
+                "role's crash story IS its supervisor)")
+        # config.usage_timing: surface the request_timing() phase split
+        # (queue_wait_ms / prefill_ms / decode_ms) in the OpenAI usage
+        # object; off (default) keeps the usage shape byte-unchanged
+        self._usage_timing = bool(usage_timing)
         # config.sse_keepalive_s: max silence on a token stream before a
         # `: keepalive` SSE comment goes out — during a crash-restart
         # window the connection stays provably alive instead of tripping
@@ -236,7 +257,43 @@ class LLMModel(Model):
                 warmed.append(True)
             return eng
 
-        if self._supervised:
+        if self._disaggregated:
+            from kubeflow_tpu.serving.agent import EngineSupervisor
+            from kubeflow_tpu.serving.disagg import DisaggregatedEngine
+            from kubeflow_tpu.serving.llm import DecodeEngine, PrefillEngine
+
+            dg = self._disagg_cfg
+            pre_kw = dict(engine_kw, prefix_cache=True)
+            if dg.get("prefill_slots"):
+                pre_kw["n_slots"] = int(dg["prefill_slots"])
+            dec_kw = dict(engine_kw, prefix_cache=True)
+            warmed_roles: dict[str, bool] = {}
+
+            def prefill_engine_factory():
+                # role engines are born inside supervisor factories too
+                # (scripts/check_dataplane.py lints all three names)
+                eng = PrefillEngine(params, cfg, **pre_kw)
+                if rewarm or not warmed_roles.get("prefill"):
+                    eng.warmup()
+                    warmed_roles["prefill"] = True
+                return eng
+
+            def decode_engine_factory():
+                eng = DecodeEngine(params, cfg, **dec_kw)
+                if rewarm or not warmed_roles.get("decode"):
+                    eng.warmup()
+                    warmed_roles["decode"] = True
+                return eng
+
+            sup_kw = {k: v for k, v in self._sup_cfg.items()
+                      if k != "rewarm"}
+            sup_kw.setdefault("stall_timeout_s", 10.0)
+            self._engine = DisaggregatedEngine(
+                EngineSupervisor(prefill_engine_factory, **sup_kw),
+                EngineSupervisor(decode_engine_factory, **sup_kw),
+                handoff=dg.get("handoff", "zero_copy"),
+                max_inflight_prefills=dg.get("max_inflight_prefills"))
+        elif self._supervised:
             from kubeflow_tpu.serving.agent import EngineSupervisor
 
             # a conservative default stall watchdog for the HTTP path:
@@ -367,11 +424,28 @@ class LLMModel(Model):
     def supervisor(self):
         """The EngineSupervisor under this model (None on the
         supervised=False escape hatch) — the chaos harness arms fault
-        scripts here, and healthz reads its accounting."""
+        scripts here, and healthz reads its accounting. Under
+        disaggregated serving this is the DECODE role's supervisor (the
+        replica's identity); the prefill role rides
+        `prefill_supervisor`."""
         from kubeflow_tpu.serving.agent import EngineSupervisor
+        from kubeflow_tpu.serving.disagg import DisaggregatedEngine
 
+        if isinstance(self._engine, DisaggregatedEngine):
+            return self._engine.decode
         return (self._engine
                 if isinstance(self._engine, EngineSupervisor) else None)
+
+    @property
+    def prefill_supervisor(self):
+        """The prefill role's EngineSupervisor (disaggregated serving
+        only; None otherwise) — the prefill-crash chaos drill arms fault
+        scripts here."""
+        from kubeflow_tpu.serving.disagg import DisaggregatedEngine
+
+        return (self._engine.prefill
+                if isinstance(self._engine, DisaggregatedEngine)
+                else None)
 
     # -- inference -----------------------------------------------------------
 
@@ -502,6 +576,17 @@ class LLMModel(Model):
         hold = max((len(s) for s in stops), default=0)
         return self._stream_from(rid, on_finish, hold, info)
 
+    def _timing_fields(self, rid: int) -> dict[str, Any]:
+        """The request's phase split for the usage object (read BEFORE
+        release). Missing phases report as None — the engine fills them
+        as the boundaries land."""
+        try:
+            tm = self._engine.request_timing(rid)
+        except Exception:
+            return {}
+        return {k: tm.get(k) for k in
+                ("queue_wait_ms", "prefill_ms", "decode_ms")}
+
     def _cached_tokens(self, rid: int) -> int | None:
         """None when the engine runs no prefix cache (the usage object
         then omits cached_tokens entirely); 0 on a cache-on miss."""
@@ -570,6 +655,8 @@ class LLMModel(Model):
             cached = self._cached_tokens(rid)
             if cached is not None:
                 info["cached_tokens"] = cached
+            if self._usage_timing:
+                info["timing"] = self._timing_fields(rid)
         if on_finish is not None:
             on_finish(reason)
         self._engine.release(rid)
@@ -607,6 +694,11 @@ class LLMModel(Model):
             # miss); absent entirely when the engine runs no cache, so
             # cache-off deployments keep their exact usage shape
             result["cached_tokens"] = cached
+        if self._usage_timing:
+            # the phase split rides the usage object only when the
+            # operator turned it on (the r10 cached_tokens precedent:
+            # the default usage shape stays byte-unchanged)
+            result["timing"] = self._timing_fields(rid)
         if self._logprobs_topk:
             result["top_logprobs"] = self._engine.result_top_logprobs(rid)
         self._engine.release(rid)  # long-lived server: drop request state

@@ -189,6 +189,7 @@ class ModelServer:
             "uptime_s": round(time.monotonic() - self._t_start, 3)}
         caches: dict[str, Any] = {}
         sups: dict[str, Any] = {}
+        disaggs: dict[str, Any] = {}
         for mname in self.repository.names():
             try:
                 mm = self.repository.get(mname).metrics()
@@ -209,10 +210,29 @@ class ModelServer:
                     "in_flight": sup.get("in_flight", 0),
                     "degraded_rejections": sup.get("shed", 0),
                 }
+            dg = (mm or {}).get("disagg")
+            if dg:
+                # disaggregated-serving observability (ISSUE 13):
+                # handoff depth, queue wait, blocks in flight — what an
+                # operator needs to see backpressure instead of
+                # inferring it
+                disaggs[mname] = {
+                    "queue_depth": dg.get("queue_depth", 0),
+                    "inflight_prefills": dg.get("inflight_prefills", 0),
+                    "blocks_in_flight": dg.get("blocks_in_flight", 0),
+                    "queue_wait_ms_mean": dg.get("queue_wait_ms_mean"),
+                    "bypass": dg.get("bypass", 0),
+                    "handoff": dg.get("handoff"),
+                    "prefill_restarts": dg.get("prefill_restarts", 0),
+                    "prefill_permanent_failed": bool(
+                        dg.get("prefill_permanent_failed", False)),
+                }
         if caches:
             body["kv_cache"] = caches
         if sups:
             body["supervisor"] = sups
+        if disaggs:
+            body["disagg"] = disaggs
         return body
 
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:
@@ -541,6 +561,16 @@ class ModelServer:
                           for r in results[:n_choices])
         if n_cancelled:
             usage["cancelled"] = n_cancelled
+        # phase split (queue_wait_ms / prefill_ms / decode_ms): present
+        # only when the model runs usage_timing (shape unchanged
+        # otherwise — the cached_tokens precedent). One request, one
+        # split: n/best_of clones report the first returned choice's.
+        timing = next((r["timing"] for r in results if r.get("timing")),
+                      None)
+        if timing:
+            for k, v in timing.items():
+                if v is not None:
+                    usage[k] = v
         return 200, {
             "object": "chat.completion" if chat else "text_completion",
             "model": m.name, "choices": choices,
@@ -663,6 +693,9 @@ class ModelServer:
                     usage["cached_tokens"] = stream_info["cached_tokens"]
                     usage["prompt_tokens_details"] = {
                         "cached_tokens": stream_info["cached_tokens"]}
+                for k, v in (stream_info.get("timing") or {}).items():
+                    if v is not None:   # usage_timing models only
+                        usage[k] = v
                 if reason == "cancelled":
                     # same type as the buffered path: a COUNT of
                     # cancelled returned choices (a stream has one)

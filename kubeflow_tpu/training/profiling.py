@@ -235,6 +235,35 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
     t_nosample = _median_time(run_decode(fn_nosample), iters)
     t_read = max(_median_time(run_read, iters) - t_rtt, 0.0)
 
+    # kv_handoff bucket (ISSUE 13 satellite): the cost of moving one
+    # radix block of finished prefill KV between engines — raw extract
+    # (the banker's slice program) + zero-copy insert through the same
+    # KVHandoff interface the disaggregated coordinator uses — so the
+    # handoff's price sits NEXT TO weight-read/attention/sampling in the
+    # committed breakdown instead of folding into dispatch-RTT. None on
+    # engines without a prefix cache (no blocks to move).
+    kv_handoff_ms = None
+    if getattr(engine, "prefix_cache_enabled", False) \
+            and engine.kvcache is not None:
+        from kubeflow_tpu.kvcache import RadixKVCache
+        from kubeflow_tpu.serving.disagg import KVHandoff
+
+        bt = engine.prefix_block_tokens
+        scratch = RadixKVCache(bt, 4)
+        handoff = KVHandoff(lambda: scratch)
+        probe_tokens = list(range(1, bt + 1))
+
+        def run_handoff():
+            parts = engine._extract_raw_fn(bt)(engine.cache, 0)
+            payload = tuple(a[:, :, :bt] for a in parts)
+            scratch.clear()   # nothing pins the scratch between runs
+            handoff.send(probe_tokens, [payload])
+            float(np.asarray(parts[0]).flat[0])   # value-fetch sync
+
+        run_handoff()   # compile + fault pages, untimed
+        kv_handoff_ms = round(
+            max(_median_time(run_handoff, iters) - t_rtt, 0.0) * 1e3, 4)
+
     per_step = 1e3 / steps
     dev_full_ms = max(t_full - t_rtt, 0.0) * per_step
     dev_nosample_ms = max(t_nosample - t_rtt, 0.0) * per_step
@@ -265,6 +294,9 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
             "sampling_penalties": round(sampling_ms, 4),
             "dispatch_rtt_per_step": round(t_rtt * per_step, 4),
             "host_fetch_replay_per_step": host_ms,
+            # per BLOCK handed off, not per step: the handoff rides
+            # prefill completion, so its cadence is per-request
+            "kv_handoff": kv_handoff_ms,
         },
         # live engine counters for the host-side buckets (per-chunk wall
         # the host spent dispatching vs fetching+replaying, amortized)

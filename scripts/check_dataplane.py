@@ -6,14 +6,16 @@ statically, so a future module cannot quietly construct or drive a bare
 
 Rules (AST, no imports of the checked code):
 
-1. Inside `kubeflow_tpu/` (tests excluded), `LLMEngine(...)` may only be
-   constructed inside a function whose name marks it as a supervisor
-   factory (`factory` in the name) — the closure handed to
-   `EngineSupervisor`. Everything else must take a supervised engine
-   from the outside.
+1. Inside `kubeflow_tpu/` (tests excluded), `LLMEngine(...)` — and the
+   disaggregated role engines `PrefillEngine(...)` / `DecodeEngine(...)`
+   (ISSUE 13) — may only be constructed inside a function whose name
+   marks it as a supervisor factory (`factory` in the name) — the
+   closure handed to `EngineSupervisor`. Everything else must take a
+   supervised engine from the outside.
 2. The HTTP/gRPC frontends (`serving/server.py`, `serving/grpc_server.py`)
-   must not reference `LLMEngine` at all — they speak to engines only
-   through the `Model` abstraction, whose engine is the supervisor.
+   must not reference any engine class at all — they speak to engines
+   only through the `Model` abstraction, whose engine is the supervisor
+   (or the disaggregated coordinator).
 3. `bench.py` may build bare engines for raw-engine perf points, but its
    chaos/HTTP dataplane sections must go through `EngineSupervisor` /
    `LLMModel`; the repo-root bench is therefore out of scope here by
@@ -32,6 +34,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "kubeflow_tpu")
 
+#: every class the factory rule and the engine-blind rule cover: the
+#: bare engine plus the disaggregated role engines (a rogue
+#: PrefillEngine would be exactly the unsupervised crash hole rule 1
+#: closes for LLMEngine)
+ENGINE_NAMES = ("LLMEngine", "PrefillEngine", "DecodeEngine")
+
 #: frontends that must stay engine-blind (rule 2)
 ENGINE_BLIND = (
     os.path.join("kubeflow_tpu", "serving", "server.py"),
@@ -49,12 +57,12 @@ def _py_files(root: str):
 
 
 class _EngineCallVisitor(ast.NodeVisitor):
-    """Collect LLMEngine(...) call sites with their enclosing function
-    names (lexical nesting)."""
+    """Collect engine-class call sites (ENGINE_NAMES) with their
+    enclosing function names (lexical nesting)."""
 
     def __init__(self):
         self.stack: list[str] = []
-        self.calls: list[tuple[int, list[str]]] = []
+        self.calls: list[tuple[int, str, list[str]]] = []
 
     def _visit_func(self, node):
         self.stack.append(node.name)
@@ -68,8 +76,8 @@ class _EngineCallVisitor(ast.NodeVisitor):
         fn = node.func
         name = (fn.id if isinstance(fn, ast.Name)
                 else fn.attr if isinstance(fn, ast.Attribute) else None)
-        if name == "LLMEngine":
-            self.calls.append((node.lineno, list(self.stack)))
+        if name in ENGINE_NAMES:
+            self.calls.append((node.lineno, name, list(self.stack)))
         self.generic_visit(node)
 
 
@@ -81,9 +89,11 @@ def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
         rel = os.path.relpath(path, repo_root)
         with open(path, encoding="utf-8") as f:
             src = f.read()
-        if rel in ENGINE_BLIND and "LLMEngine" in src:
+        blind_hits = [n for n in ENGINE_NAMES if n in src] \
+            if rel in ENGINE_BLIND else []
+        for n in blind_hits:
             findings.append(
-                f"{rel}: references LLMEngine — frontends must speak "
+                f"{rel}: references {n} — frontends must speak "
                 "through the Model abstraction (supervised engine)")
         if rel == engine_def:
             continue
@@ -94,11 +104,11 @@ def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
             continue
         v = _EngineCallVisitor()
         v.visit(tree)
-        for lineno, stack in v.calls:
+        for lineno, cls, stack in v.calls:
             if any("factory" in name for name in stack):
                 continue   # the sanctioned pattern: a supervisor factory
             findings.append(
-                f"{rel}:{lineno}: bare LLMEngine construction outside a "
+                f"{rel}:{lineno}: bare {cls} construction outside a "
                 "supervisor factory — wrap it in an EngineSupervisor "
                 "(build it inside a *factory* function handed to one)")
     return findings

@@ -395,6 +395,145 @@ def test_permanent_fail_streams_terminal_error_event():
         m.unload()
 
 
+@pytest.fixture(scope="module")
+def disagg_server():
+    """A DISAGGREGATED LLMModel behind a real ModelServer (ISSUE 13):
+    prefill and decode roles each behind their own supervisor, KV moving
+    between them as radix block payloads."""
+    m = LLMModel("llm", model=dict(vocab_size=128, d_model=32, n_layers=2,
+                                   n_heads=4, n_kv_heads=2, d_ff=64,
+                                   max_seq_len=64, attention_impl="xla",
+                                   remat=False),
+                 n_slots=2, max_len=64, buckets=(8, 16), seed=0,
+                 decode_chunk=2,
+                 disaggregated=True,
+                 supervisor={"stall_timeout_s": 30.0,
+                             "backoff_base_s": 0.2,
+                             "backoff_cap_s": 0.4,
+                             "rewarm": False},
+                 sse_keepalive_s=0.05)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    yield m, server
+    server.stop()
+    m.unload()
+
+
+#: longer than the largest bucket (16), so the prefill worker runs a
+#: CHUNKED chain — the "mid-chunk" crash target the satellite names
+LONG_PROMPT = [(i * 5) % 120 + 1 for i in range(22)]
+
+
+@pytest.mark.slow
+def test_disagg_stream_serves_and_reports_health(disagg_server):
+    """Baseline + observability: a stream through the disaggregated
+    dataplane completes normally, and /healthz carries the new `disagg`
+    section (handoff depth, queue wait, blocks in flight) next to the
+    kv_cache gauges."""
+    m, server = disagg_server
+    res = stream_completion(server.port, {
+        "model": "llm", "prompt": LONG_PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})
+    assert res["status"] == 200 and res["errors"] == []
+    assert len(res["token_ids"]) == MAX_TOKENS
+    assert res["done_count"] == 1 and res["usage_count"] == 1
+    with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
+        body = json.loads(r.read())
+    dg = body["disagg"]["llm"]
+    assert dg["prefill_permanent_failed"] is False
+    assert dg["handoff"]["handoffs"] >= 1
+    assert dg["handoff"]["blocks_sent"] >= 1
+    assert dg["queue_depth"] == 0 and dg["blocks_in_flight"] == 0
+    # satellite: the kv_cache healthz section now carries the pinned/
+    # evictable occupancy gauges (disagg backpressure is observable)
+    kv = body["kv_cache"]["llm"]
+    assert "pinned_blocks" in kv and "evictable_blocks" in kv
+    # the supervisor section reflects the DECODE role (the replica's
+    # identity under disagg)
+    assert body["supervisor"]["llm"]["permanent_failed"] is False
+
+
+@pytest.mark.slow
+def test_disagg_prefill_crash_stream_byte_identical(disagg_server):
+    """THE satellite contract: the prefill worker dies with a chunked
+    long-prompt prefill outstanding (engine provably down at submit —
+    the journal is the queue, so the crash window covers the whole
+    chain), and the client's stream completes byte-identical with zero
+    lost requests across BOTH role supervisors."""
+    m, server = disagg_server
+    ref = stream_completion(server.port, {
+        "model": "llm", "prompt": LONG_PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})
+    assert ref["status"] == 200 and len(ref["token_ids"]) == MAX_TOKENS
+    psup = m.prefill_supervisor
+    restarts0 = psup.accounting()["restarts"]
+    psup.arm_faults(_crash_now(seed=21))
+    deadline = time.monotonic() + 10
+    while not psup.degraded and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert psup.degraded   # prefill worker provably down at submit time
+    res = stream_completion(server.port, {
+        "model": "llm", "prompt": LONG_PROMPT, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})
+    assert res["status"] == 200
+    assert res["token_ids"] == ref["token_ids"]   # byte-identical
+    assert res["errors"] == []
+    assert res["done_count"] == 1 and res["usage_count"] == 1
+    pacc = psup.accounting()
+    assert pacc["restarts"] >= restarts0 + 1 and pacc["lost"] == 0
+    acc = m._engine.accounting()
+    assert acc["lost"] == 0
+    # the decode role never noticed: no decode-side restart rode this
+    assert acc["decode"]["restarts"] == 0
+
+
+@pytest.mark.slow
+def test_disagg_prefill_crash_mid_flight_loses_nothing(disagg_server):
+    """Arm the crash while prefill jobs are journaled in flight (a wave
+    of long prompts keeps the prefill worker busy): every stream
+    completes byte-identical, zero lost."""
+    import threading
+
+    m, server = disagg_server
+    prompts = [LONG_PROMPT, [3, 5, 7, 9] * 5, list(range(1, 20))]
+    refs = [stream_completion(server.port, {
+        "model": "llm", "prompt": p, "max_tokens": MAX_TOKENS,
+        "temperature": 0.0})["token_ids"] for p in prompts]
+    psup = m.prefill_supervisor
+    out: list = [None] * len(prompts)
+
+    def client(i):
+        out[i] = stream_completion(server.port, {
+            "model": "llm", "prompt": prompts[i],
+            "max_tokens": MAX_TOKENS, "temperature": 0.0},
+            timeout_s=120.0)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    # arm as soon as the prefill journal holds work (best-effort mid-
+    # chain; if the prefills already drained the crash still fires and
+    # must cost nothing)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        with psup._lock:
+            if any(not e.terminal for e in psup._journal.values()):
+                break
+        time.sleep(0.0005)
+    psup.arm_faults(_crash_now(seed=22))
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "stream hung"
+    for i, res in enumerate(out):
+        assert res["status"] == 200 and res["errors"] == [], res
+        assert res["token_ids"] == refs[i], i
+        assert res["done_count"] == 1
+    assert psup.accounting()["lost"] == 0
+    assert m._engine.accounting()["lost"] == 0
+
+
 def test_steady_scenario_over_http_with_crash_loses_nothing(llm_server):
     """The acceptance integration, measured where the client lives: the
     loadgen `steady` scenario replayed through a REAL socket while the

@@ -79,3 +79,55 @@ def test_lint_flags_engine_aware_frontend(tmp_path):
     findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
                           repo_root=str(tmp_path))
     assert any("frontends must speak" in f for f in findings)
+
+
+def test_lint_flags_bare_role_engine_construction(tmp_path):
+    """ISSUE 13 satellite: the disaggregated role engines are held to
+    the same factory rule as LLMEngine — a bare PrefillEngine/
+    DecodeEngine outside a supervisor factory reopens the crash hole."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue_roles.py").write_text(
+        "from kubeflow_tpu.serving.llm import DecodeEngine, PrefillEngine\n"
+        "def serve(params, cfg):\n"
+        "    pre = PrefillEngine(params, cfg)\n"
+        "    dec = DecodeEngine(params, cfg)\n"
+        "    return pre, dec\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 2
+    assert any("PrefillEngine" in f for f in findings)
+    assert any("DecodeEngine" in f for f in findings)
+    assert all("supervisor factory" in f for f in findings)
+
+
+def test_lint_allows_role_engines_in_supervisor_factories(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "fine_roles.py").write_text(
+        "from kubeflow_tpu.serving.llm import DecodeEngine, PrefillEngine\n"
+        "from kubeflow_tpu.serving.agent import EngineSupervisor\n"
+        "def disagg(params, cfg):\n"
+        "    def prefill_engine_factory():\n"
+        "        return PrefillEngine(params, cfg)\n"
+        "    def decode_engine_factory():\n"
+        "        return DecodeEngine(params, cfg)\n"
+        "    return (EngineSupervisor(prefill_engine_factory),\n"
+        "            EngineSupervisor(decode_engine_factory))\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_flags_role_engine_aware_frontend(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "server.py").write_text(
+        "from kubeflow_tpu.serving.llm import PrefillEngine\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert any("PrefillEngine" in f and "frontends must speak" in f
+               for f in findings)

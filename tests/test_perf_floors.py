@@ -163,3 +163,48 @@ def test_http_chaos_floors_gated_on_schema_6(tmp_path):
     p.write_text(json.dumps(rec6))
     assert any(f.startswith("chaos_http_stream_completion")
                for f in bench.check_floors(str(p)))
+
+
+def test_disagg_floors_gated_on_schema_7(tmp_path):
+    """serving_disagg floors (r12) only bind records new enough to carry
+    the colocated-vs-disaggregated comparison: every pre-r12 committed
+    record stays valid, a schema-7 record missing the section fails
+    loudly, and a schema-7 record holding its floors is green —
+    including the exact parity and zero-lost contracts and the
+    acceptance product (TTFT p99 × decode throughput gain >= 1)."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 7   # committed record predates r12
+    assert not any("disagg" in f for f in bench.check_floors(_RECORD))
+
+    rec7 = json.loads(json.dumps(rec))
+    rec7["schema"] = 7
+    p = tmp_path / "rec7.json"
+    p.write_text(json.dumps(rec7))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("disagg_ttft_x_decode_gain") for f in fails)
+    assert any(f.startswith("disagg_greedy_parity") for f in fails)
+    assert any(f.startswith("disagg_crash_terminal_frac") for f in fails)
+
+    rec7["extras"]["serving_disagg"] = {
+        "ttft_x_decode_gain": 1.31,
+        "greedy_parity": True,
+        "crash": {"terminal_frac": 1.0}}
+    p.write_text(json.dumps(rec7))
+    assert not any("disagg" in f for f in bench.check_floors(str(p)))
+
+    # the acceptance product is a HARD floor: disagg merely matching
+    # colocated (0.99 after noise) is a failure, not a wash
+    rec7["extras"]["serving_disagg"]["ttft_x_decode_gain"] = 0.99
+    p.write_text(json.dumps(rec7))
+    assert any(f.startswith("disagg_ttft_x_decode_gain")
+               for f in bench.check_floors(str(p)))
+
+    # parity and zero-lost are exact contracts
+    rec7["extras"]["serving_disagg"]["ttft_x_decode_gain"] = 1.31
+    rec7["extras"]["serving_disagg"]["crash"]["terminal_frac"] = 0.99
+    p.write_text(json.dumps(rec7))
+    assert any(f.startswith("disagg_crash_terminal_frac")
+               for f in bench.check_floors(str(p)))

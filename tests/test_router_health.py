@@ -612,3 +612,88 @@ def test_controller_prunes_permanently_failed_replica():
         assert cb["count"] >= 1
         # the replacement reports healthy (a fresh model instance)
         assert len(created) >= 2 and not created[-1].permanent_failed
+
+
+# -- disaggregated serving: affinity across a prefill-worker restart ----------
+
+
+def _disagg_llm_server():
+    """A tiny disaggregated LLMModel replica behind a ModelServer (the
+    decode worker is the session-affinity target — ISSUE 13)."""
+    from kubeflow_tpu.serving.llm_runtime import LLMModel
+    from kubeflow_tpu.serving.server import ModelServer as MS
+
+    m = LLMModel("llm", model=dict(vocab_size=64, d_model=16, n_layers=1,
+                                   n_heads=2, n_kv_heads=1, d_ff=32,
+                                   max_seq_len=32, attention_impl="xla",
+                                   remat=False),
+                 n_slots=1, max_len=32, buckets=(8,), seed=0,
+                 decode_chunk=2, disaggregated=True,
+                 supervisor={"stall_timeout_s": 30.0,
+                             "backoff_base_s": 0.05,
+                             "backoff_cap_s": 0.1, "rewarm": False})
+    repo = ModelRepository()
+    repo.register(m)
+    return m, ModelServer(repo).start()
+
+
+def _completions(url, user, n=1):
+    import json as _json
+    import urllib.request as _rq
+
+    codes = []
+    for _ in range(n):
+        req = _rq.Request(
+            url + "/openai/v1/completions",
+            data=_json.dumps({"model": "llm", "prompt": [3, 5, 7],
+                              "max_tokens": 2, "user": user}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with _rq.urlopen(req, timeout=60) as r:
+            codes.append(r.status)
+    return codes
+
+
+@pytest.mark.slow
+def test_disagg_session_pins_to_decode_worker_across_prefill_restart():
+    """ISSUE 13 satellite: a pinned session keeps hitting the SAME
+    replica (= the same decode worker) across a prefill-worker restart —
+    the replica stays healthy because the decode role never died, so the
+    router's rendezvous pin never moves and affinity_failovers stays 0."""
+    servers = [_disagg_llm_server(), _disagg_llm_server()]
+    r = Router("t/disagg-aff")
+    try:
+        r.set_backends([s.port for _, s in servers])
+        assert _completions(r.url, "sess-disagg", 4) == [200] * 4
+        counts = [_served_count(s) for _, s in servers]
+        assert sorted(counts) == [0, 4], counts
+        pinned_m, pinned_s = servers[counts.index(4)]
+        # kill the pinned replica's PREFILL worker; the decode role (and
+        # the HTTP replica) stay up
+        psup = pinned_m.prefill_supervisor
+        restarts0 = psup.accounting()["restarts"]
+        psup.arm_faults(generate_fault_script(FaultScriptConfig(
+            seed=31, duration_s=1.0,
+            faults=(FaultSpec("backend_crash", 1, (0.0, 0.0)),)),
+            name="prefill-now"))
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if psup.accounting()["restarts"] >= restarts0 + 1 \
+                    and not psup.degraded:
+                break
+            time.sleep(0.01)
+        assert psup.accounting()["restarts"] >= restarts0 + 1
+        # the session still lands on the same replica, zero failovers
+        before = _served_count(pinned_s)
+        assert _completions(r.url, "sess-disagg", 4) == [200] * 4
+        assert _served_count(pinned_s) == before + 4
+        assert r.affinity_failovers == 0
+        assert r.affinity_hits >= 8
+        # the replica self-reports the prefill restart, not ill health
+        h = pinned_s.health()
+        assert h["disagg"]["llm"]["prefill_restarts"] >= 1
+        assert h["supervisor"]["llm"]["permanent_failed"] is False
+    finally:
+        r.stop()
+        for m, s in servers:
+            s.stop()
+            m.unload()
