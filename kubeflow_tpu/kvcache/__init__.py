@@ -12,6 +12,9 @@ prefix. The loadgen `shared_prefix` trace family measures the whole
 loop honestly.
 """
 
-from kubeflow_tpu.kvcache.radix import (Block, MatchResult, RadixKVCache)
+from kubeflow_tpu.kvcache.radix import (Block, MatchResult, RadixKVCache,
+                                        StageMatchResult,
+                                        StagePartitionedKVCache)
 
-__all__ = ["Block", "MatchResult", "RadixKVCache"]
+__all__ = ["Block", "MatchResult", "RadixKVCache", "StageMatchResult",
+           "StagePartitionedKVCache"]

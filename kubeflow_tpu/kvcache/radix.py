@@ -371,3 +371,142 @@ class RadixKVCache:
                 assert n.parent.children[n.key] is n
             assert count == self._n_blocks, (count, self._n_blocks)
             assert count <= self.capacity_blocks
+
+
+class StageMatchResult:
+    """Pinned longest-prefix across EVERY stage's chain (the pp-aware
+    twin of MatchResult): `tokens` = the SHORTEST per-stage match (a
+    block is only usable when all stages still hold it — uneven
+    eviction truncates to the common prefix), `payloads[i]` = the tuple
+    of per-stage payloads for block i. Release through
+    StagePartitionedKVCache.release."""
+
+    __slots__ = ("tokens", "payloads", "_inner")
+
+    def __init__(self, tokens: int, payloads: list[tuple],
+                 inner: list[MatchResult]):
+        self.tokens = tokens
+        self.payloads = payloads
+        self._inner = inner
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.payloads)
+
+
+class StagePartitionedKVCache:
+    """Stage-aware view over ONE RadixKVCache for pp-sharded serving
+    (ISSUE 14): every logical KV block exists once PER PIPELINE STAGE —
+    stage s's slice of the [L, ...] rows — and the stage id enters the
+    block key (namespace (ns, stage)), so KV banked under one stage
+    layout can never be handed to another layout or another stage's
+    slab. Capacity, eviction, and the LRU clock stay shared in the
+    inner cache (a logical block costs n_stages physical blocks — the
+    engine scales capacity accordingly); per-tenant insert accounting
+    counts stage 0 only, so the committed per-tenant block counts stay
+    logical, not multiplied by pp.
+
+    match/insert/cached_prefix_len take the MINIMUM across stages:
+    shared-capacity eviction may truncate one stage's chain before
+    another's, and a prefix is only reusable where every stage can
+    still materialize its slice."""
+
+    def __init__(self, inner: RadixKVCache, n_stages: int):
+        if n_stages < 1:
+            raise ValueError("n_stages must be >= 1")
+        self.inner = inner
+        self.n_stages = int(n_stages)
+
+    # -- geometry passthroughs ------------------------------------------------
+
+    @property
+    def block_tokens(self) -> int:
+        return self.inner.block_tokens
+
+    @property
+    def capacity_blocks(self) -> int:
+        return self.inner.capacity_blocks
+
+    @property
+    def n_blocks(self) -> int:
+        return self.inner.n_blocks
+
+    def _ns(self, namespace: Any, stage: int) -> tuple:
+        return (namespace, stage)
+
+    # -- the RadixKVCache surface the engine drives ---------------------------
+
+    def match(self, tokens: Sequence[int], *,
+              max_tokens: int | None = None,
+              namespace: Any = None) -> StageMatchResult:
+        ms = [self.inner.match(tokens, max_tokens=max_tokens,
+                               namespace=self._ns(namespace, s))
+              for s in range(self.n_stages)]
+        pos = min(m.tokens for m in ms)
+        nb = pos // self.block_tokens
+        payloads = [tuple(m.payloads[i] for m in ms) for i in range(nb)]
+        return StageMatchResult(pos, payloads, ms)
+
+    def release(self, m: StageMatchResult) -> None:
+        for im in m._inner:
+            self.inner.release(im)
+
+    def cached_prefix_len(self, tokens: Sequence[int], *,
+                          max_tokens: int | None = None,
+                          namespace: Any = None) -> int:
+        return min(self.inner.cached_prefix_len(
+            tokens, max_tokens=max_tokens,
+            namespace=self._ns(namespace, s))
+            for s in range(self.n_stages))
+
+    def insert(self, tokens: Sequence[int],
+               payload_fn: Callable[[int, int, int], Any], *,
+               max_tokens: int | None = None,
+               tenant: str | None = None,
+               namespace: Any = None) -> int:
+        """payload_fn(block_index, start, end) must return the TUPLE of
+        per-stage payloads for that block (the engine's raw-extract
+        already produces per-stage parts); stage s stores element s
+        under its own namespace. The tuple is memoized per block index —
+        every stage inserts the same new blocks, so without the memo the
+        engine would re-slice every stage's parts pp times per block.
+        Returns stage 0's new-block count (the logical number of new
+        blocks)."""
+        memo: dict[int, Any] = {}
+
+        def payload_at(i, a, b):
+            if i not in memo:
+                memo[i] = payload_fn(i, a, b)
+            return memo[i]
+
+        new0 = 0
+        for s in range(self.n_stages):
+            def payload_s(i, a, b, s=s):
+                return payload_at(i, a, b)[s]
+            n = self.inner.insert(
+                tokens, payload_s, max_tokens=max_tokens,
+                tenant=tenant if s == 0 else None,
+                namespace=self._ns(namespace, s))
+            if s == 0:
+                new0 = n
+        return new0
+
+    def record_hit(self, tenant: str | None, reused_tokens: int) -> None:
+        self.inner.record_hit(tenant, reused_tokens)
+
+    def record_miss(self, tenant: str | None) -> None:
+        self.inner.record_miss(tenant)
+
+    def stats(self) -> dict[str, Any]:
+        out = self.inner.stats()
+        out["stages"] = self.n_stages
+        # physical blocks count every stage's copy; the logical view is
+        # what capacity planning/debugging wants next to hit rates
+        out["logical_blocks"] = out["blocks"] // self.n_stages
+        return out
+
+    def clear(self) -> None:
+        self.inner.clear()
+
+    def check_invariants(self) -> None:
+        self.inner.check_invariants()

@@ -452,6 +452,38 @@ def _wo(cfg: LlamaConfig, out, layer, lora_layer=None, ids=None):
     return _adapted(out, layer, "wo", lora_layer, ids, cfg.dtype)
 
 
+def prefill_inner(layers: Params, x: jax.Array, positions: jax.Array,
+                  cfg: LlamaConfig, lora: Params | None = None,
+                  ids: jax.Array | None = None):
+    """Layer-slab half of prefill: [B, S, D] activations through a
+    contiguous slab of layers → (x, k, v [Ls, B, S, kv, hd]). `layers`
+    may be ANY leading-axis slice of the full stack — prefill() runs the
+    whole model through it, the pipeline stage runner
+    (parallel/pipeline.py) feeds each stage its own slab. Keeping ONE
+    body is what makes stage-sharded serving byte-exact against the
+    single-program engine."""
+    b, s = x.shape[:2]
+
+    def body(carry, inp):
+        x = carry
+        layer, ll = inp if lora is not None else (inp, None)
+        q, k, v = _project_qkv(cfg, layer, x, positions, ll, ids)
+        out = mha(q, k, v, causal=True)
+        x = x + _wo(cfg, out.reshape(b, s, -1), layer, ll, ids)
+        x = _serving_mlp(cfg, x, layer, ll, ids)
+        return x, (k, v)
+
+    xs = (layers, lora) if lora is not None else layers
+    return jax.lax.scan(body, x, xs)
+
+
+def lm_head(params: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """final_norm + lm_head projection — the serving tail every prefill/
+    decode wrapper (and the LAST pipeline stage) shares."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
+
+
 def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig,
             lora: Params | None = None, ids: jax.Array | None = None):
     """Forward a (right-padded) prompt, returning logits and per-layer KV.
@@ -465,25 +497,12 @@ def prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     d_out]}} (adapter-stacked per layer, b pre-scaled by alpha/rank),
     ids = [B] adapter index per row, 0 = base-only.
     """
-    b, s = tokens.shape
+    _, s = tokens.shape
     positions = jnp.arange(s)
     x = params["embed"].astype(cfg.dtype)[tokens]
-
-    def body(carry, inp):
-        x = carry
-        layer, ll = inp if lora is not None else (inp, None)
-        q, k, v = _project_qkv(cfg, layer, x, positions, ll, ids)
-        out = mha(q, k, v, causal=True)
-        x = x + _wo(cfg, out.reshape(b, s, -1), layer, ll, ids)
-        x = _serving_mlp(cfg, x, layer, ll, ids)
-        return x, (k, v)
-
-    xs = ((params["layers"], lora) if lora is not None
-          else params["layers"])
-    x, (ks, vs) = jax.lax.scan(body, x, xs)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
-    return logits, ks, vs
+    x, (ks, vs) = prefill_inner(params["layers"], x, positions, cfg,
+                                lora, ids)
+    return lm_head(params, x, cfg), ks, vs
 
 
 def prefill_continue(params: Params, tail_tokens: jax.Array,
@@ -499,10 +518,25 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
     The tail attends causally over prefix+tail (q_offset = P); pad tail
     positions produce garbage KV the caller masks by true lengths.
     """
-    b, t = tail_tokens.shape
-    p = k_prefix.shape[2]
-    positions = p + jnp.arange(t)
+    positions = k_prefix.shape[2] + jnp.arange(tail_tokens.shape[1])
     x = params["embed"].astype(cfg.dtype)[tail_tokens]
+    x, (ks, vs) = prefill_continue_inner(params["layers"], x, k_prefix,
+                                         v_prefix, positions, cfg,
+                                         lora, ids)
+    return lm_head(params, x, cfg), ks, vs
+
+
+def prefill_continue_inner(layers: Params, x: jax.Array,
+                           k_prefix: jax.Array, v_prefix: jax.Array,
+                           positions: jax.Array, cfg: LlamaConfig,
+                           lora: Params | None = None,
+                           ids: jax.Array | None = None):
+    """Layer-slab half of prefill_continue (see prefill_inner): `layers`
+    and `k_prefix`/`v_prefix` may be any matching leading-axis slice of
+    the stack — the pipeline stage runner hands each stage its own slab
+    and prefix-KV slab."""
+    b, t = x.shape[:2]
+    p = k_prefix.shape[2]
 
     def body(carry, inp):
         x = carry
@@ -518,12 +552,9 @@ def prefill_continue(params: Params, tail_tokens: jax.Array,
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, (k_new, v_new)
 
-    xs = ((params["layers"], k_prefix, v_prefix, lora)
-          if lora is not None else (params["layers"], k_prefix, v_prefix))
-    x, (ks, vs) = jax.lax.scan(body, x, xs)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
-    return logits, ks, vs
+    xs = ((layers, k_prefix, v_prefix, lora)
+          if lora is not None else (layers, k_prefix, v_prefix))
+    return jax.lax.scan(body, x, xs)
 
 
 def decode_step(params: Params, last_tokens: jax.Array, cache: Params,
@@ -576,12 +607,33 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
     extra S_v-1 query rows ride along nearly free — that asymmetry is the
     entire speculative-decoding bet.
     """
-    b, s_v = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, S_v, D]
+    cache_keys = (("k", "v", "k_s", "v_s") if "k_s" in cache
+                  else ("k", "v"))
+    cache_in = {name: cache[name] for name in cache_keys}
+    x, new_cache = verify_inner(params["layers"], x, cache_in, lengths,
+                                cfg, span=span, lora=lora, ids=ids)
+    return lm_head(params, x, cfg), new_cache
+
+
+def verify_inner(layers: Params, x: jax.Array, cache: Params,
+                 lengths: jax.Array, cfg: LlamaConfig,
+                 span: int | None = None, lora: Params | None = None,
+                 ids: jax.Array | None = None, slot_start: int = 0):
+    """Layer-slab half of verify_step: x [B, S_v, D] activations through
+    a contiguous slab of layers against that slab's KV cache →
+    (x, new_cache). The cache may hold MORE slots than x carries rows:
+    `slot_start` names the first cache slot this batch occupies (the
+    pipeline stage runner decodes one microbatch of slots at a time
+    against the stage's full-slot cache slab; the single-program path
+    always passes the full batch at slot_start 0, where the slicing is
+    the identity). `lengths` is per-ROW of x (already sliced to the
+    microbatch)."""
+    b, s_v = x.shape[:2]
     max_len = cache["k"].shape[2]
     span = max_len if span is None else min(span, max_len)
     quantized = "k_s" in cache
-    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, S_v, D]
-    rows = jnp.arange(b)
+    rows = slot_start + jnp.arange(b)
     positions = lengths[:, None] + jnp.arange(s_v)[None]  # [B, S_v]
     k_pos = jnp.arange(span)
     # query i (position lengths+i) attends keys at k_pos <= lengths+i;
@@ -593,6 +645,7 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
     idx = (rows[:, None], positions)
     nh, nkv = cfg.n_heads, cfg.n_kv_heads
     g = nh // nkv
+    full_batch = slot_start == 0 and cache["k"].shape[1] == b
 
     # The KV cache rides the scan as CARRY (not xs/ys): a per-layer
     # dynamic-update-slice on the carried buffer updates S_v rows in
@@ -620,10 +673,12 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
         def layer_span(name):
             # index the layer FIRST, then slice the span: the other order
             # would stage an [L, B, span, ...] temp of the whole cache
-            return jax.lax.slice_in_dim(
-                jax.lax.dynamic_index_in_dim(cache_c[name], li, axis=0,
-                                             keepdims=False),
-                0, span, axis=1)
+            rows_all = jax.lax.dynamic_index_in_dim(
+                cache_c[name], li, axis=0, keepdims=False)
+            if not full_batch:   # microbatch: this batch's slot window
+                rows_all = jax.lax.slice_in_dim(
+                    rows_all, slot_start, slot_start + b, axis=0)
+            return jax.lax.slice_in_dim(rows_all, 0, span, axis=1)
 
         ck = layer_span("k")
         cv = layer_span("v")
@@ -663,15 +718,12 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return (x, cache_c), None
 
-    cache_keys = (("k", "v", "k_s", "v_s") if quantized else ("k", "v"))
-    cache_in = {name: cache[name] for name in cache_keys}
-    layer_idx = jnp.arange(cfg.n_layers)
-    xs = ((params["layers"], layer_idx, lora) if lora is not None
-          else (params["layers"], layer_idx))
-    (x, new_cache), _ = jax.lax.scan(body, (x, cache_in), xs)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = quant.matmul_f32_out(x, params["lm_head"], cfg.dtype)
-    return logits, new_cache
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    layer_idx = jnp.arange(n_layers)
+    xs = ((layers, layer_idx, lora) if lora is not None
+          else (layers, layer_idx))
+    (x, new_cache), _ = jax.lax.scan(body, (x, cache), xs)
+    return x, new_cache
 
 
 # ---------------------------------------------------------------------------

@@ -9,6 +9,7 @@ from kubeflow_tpu.parallel.mesh import (
     num_data_shards,
     replicated,
     single_device_mesh,
+    stage_submeshes,
     validate_divisibility,
 )
 from kubeflow_tpu.parallel.sharding import (
@@ -24,6 +25,7 @@ __all__ = [
     "make_mesh",
     "mesh_shape",
     "single_device_mesh",
+    "stage_submeshes",
     "active_mesh",
     "get_active_mesh",
     "batch_sharding",

@@ -188,6 +188,28 @@ def make_mesh(
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def stage_submeshes(mesh: Mesh) -> list[Mesh]:
+    """Split a mesh with a `stage` axis into per-stage sub-meshes: stage s
+    gets the devices at stage-coordinate s, arranged over the REMAINING
+    axes (the `("stage", "tensor")` serving layout's building block —
+    parallel/pipeline.py's inference stage runner compiles one program
+    menu per sub-mesh, so each stage's tensor collectives stay inside its
+    own ICI group and activations are the only cross-stage traffic).
+
+    The per-stage sub-mesh keeps every axis except `stage`, so the same
+    logical sharding rules apply inside a stage — with `layers`
+    remapped to None (a slab is the stage's WHOLE local stack)."""
+    names = list(mesh.axis_names)
+    if "stage" not in names:
+        raise ValueError(f"mesh has no stage axis: {names}")
+    ax = names.index("stage")
+    sub_names = tuple(n for n in names if n != "stage")
+    out = []
+    for s in range(mesh.devices.shape[ax]):
+        out.append(Mesh(np.take(mesh.devices, s, axis=ax), sub_names))
+    return out
+
+
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     """A trivial mesh with all axes of size 1 — lets every sharded program run
     unmodified on one chip (the local-dev path; reference analog: 1-worker job)."""

@@ -32,11 +32,12 @@ is automatically the reverse pipeline (activations rotate back up the ring).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import time
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "stage"
 
@@ -227,3 +228,273 @@ def pipelined_llama_loss(params, batch, cfg, mesh: Mesh,
     total = jnp.sum(token_loss * mask)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     return total / denom, {"loss": total / denom, "tokens": jnp.sum(mask)}
+
+
+# ---------------------------------------------------------------------------
+# Inference-mode stage plan (ISSUE 14): MPMD stage-sharded SERVING.
+#
+# Training uses the SPMD gpipe schedule above — one program, shard_map
+# over `stage`. Serving wants the opposite shape: per-stage COMPILED
+# PROGRAMS on per-stage sub-meshes, host-chained, so (a) the KV cache is
+# threaded per-stage (stage s owns [L_s, slots, max_len, kv, hd] — the
+# 31B-class cache never exists whole anywhere), (b) decode microbatches
+# flow MPMD-style (stage k decodes microbatch i while stage k-1 runs
+# microbatch i+1 — async dispatch onto disjoint device groups overlaps
+# them for real), and (c) each stage's tensor collectives stay inside its
+# own sub-mesh ICI group. The plan below is the geometry + accounting
+# half; the engine drivers live in serving/multichip.py and reuse the
+# models/llama.py *_inner bodies so stage-sharded output is byte-exact
+# against the single-program engine.
+# ---------------------------------------------------------------------------
+
+
+def stage_bounds(n_layers: int, n_stages: int) -> list[tuple[int, int]]:
+    """Contiguous [start, end) layer slabs per stage. Uneven splits put
+    the remainder on the EARLIEST stages (stage 0 also owns the embed
+    gather — cheap — so front-loading one layer beats starving the
+    last stage, which owns the lm_head matmul)."""
+    if not 1 <= n_stages <= n_layers:
+        raise ValueError(
+            f"n_stages must be 1..n_layers ({n_layers}), got {n_stages}")
+    base, extra = divmod(n_layers, n_stages)
+    bounds, start = [], 0
+    for s in range(n_stages):
+        size = base + (1 if s < extra else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def microbatch_ranges(n_slots: int, n_stages: int) -> list[tuple[int, int]]:
+    """Decode-wave microbatches as contiguous (start, size) slot ranges:
+    one per stage so the pipe can fill, capped at one slot per
+    microbatch when stage-count exceeds the wave width (pp > n_slots —
+    the degenerate-but-legal geometry). Uneven splits front-load like
+    stage_bounds."""
+    m = min(max(1, n_stages), n_slots)
+    base, extra = divmod(n_slots, m)
+    out, start = [], 0
+    for i in range(m):
+        size = base + (1 if i < extra else 0)
+        out.append((start, size))
+        start += size
+    return out
+
+
+def wavefront(n_microbatches: int, n_stages: int):
+    """GPipe tick schedule: yields (tick, stage, microbatch) triples in
+    dispatch order — at tick t, stage s works microbatch t - s. With
+    async dispatch onto per-stage device groups this order IS the
+    overlap: within one tick every stage's program runs concurrently."""
+    for t in range(n_microbatches + n_stages - 1):
+        for s in range(n_stages):
+            m = t - s
+            if 0 <= m < n_microbatches:
+                yield t, s, m
+
+
+def split_stage_params(params: Any, bounds: Sequence[tuple[int, int]]
+                       ) -> list[dict]:
+    """init()-shaped llama params → per-stage slabs: every stage gets its
+    contiguous layer slice; stage 0 additionally owns `embed`, the last
+    stage `final_norm` + `lm_head` (the pipeline's entry/exit tensors).
+    Works on quantized leaves too ({"q", "s"} subtrees slice on their
+    leading layer axis like any other leaf)."""
+    n = len(bounds)
+    slabs: list[dict] = []
+    for s, (lo, hi) in enumerate(bounds):
+        slab: dict = {"layers": jax.tree.map(lambda p: p[lo:hi],
+                                             params["layers"])}
+        if s == 0:
+            slab["embed"] = params["embed"]
+        if s == n - 1:
+            slab["final_norm"] = params["final_norm"]
+            slab["lm_head"] = params["lm_head"]
+        slabs.append(slab)
+    return slabs
+
+
+class StagePerf:
+    """Per-stage busy/idle accounting for the decode pipeline — the
+    committed `pipeline_bubble_frac` input. Two views, both exposed:
+
+    - schedule ticks (always on, deterministic): each decode step runs
+      M + S - 1 ticks and every stage is busy for M of them, so the
+      schedule's bubble fraction is (S-1)/(M+S-1) by construction —
+      recorded as a cross-check, not a measurement;
+    - wall timestamps (opt-in `stage_timing`): the driver brackets every
+      stage-program execution with perf_counter() and blocks on its
+      output, so `stage_busy_s[s]` is stage s's measured busy wall and
+      bubble_frac = 1 - sum(busy) / (stages * window) is the measured
+      pipeline bubble. Blocking serializes the overlap, so timing mode
+      is for the bench/profiler, never live traffic.
+    """
+
+    def __init__(self, n_stages: int):
+        self.n_stages = n_stages
+        self.reset()
+
+    def reset(self) -> None:
+        self.stage_busy_s = [0.0] * self.n_stages
+        self.stage_ticks = [0] * self.n_stages
+        self.window_s = 0.0
+        self.steps = 0
+        self.ticks_total = 0
+
+    def record_step(self, n_microbatches: int, wall_s: float) -> None:
+        """One decode step's schedule accounting (M+S-1 ticks, every
+        stage busy for M of them) + its measured wall window."""
+        self.steps += 1
+        self.ticks_total += n_microbatches + self.n_stages - 1
+        for s in range(self.n_stages):
+            self.stage_ticks[s] += n_microbatches
+        self.window_s += wall_s
+
+    def record_stage(self, stage: int, busy_s: float) -> None:
+        self.stage_busy_s[stage] += busy_s
+
+    def bubble_frac(self) -> float | None:
+        """Measured bubble fraction over the accumulated window: the
+        share of stage-seconds spent idle. None until a timed window
+        accumulated (stage_timing off = no measured busy wall)."""
+        if self.window_s <= 0 or not any(self.stage_busy_s):
+            return None
+        busy = sum(self.stage_busy_s)
+        return max(0.0, min(1.0, round(
+            1.0 - busy / (self.n_stages * self.window_s), 4)))
+
+    def schedule_bubble_frac(self) -> float | None:
+        """The schedule's structural bubble: idle stage-ticks over total
+        stage-ticks, (S-1)/(M+S-1) per uniform step."""
+        if not self.ticks_total:
+            return None
+        busy = sum(self.stage_ticks)
+        return round(1.0 - busy / (self.n_stages * self.ticks_total), 4)
+
+    def snapshot(self) -> dict:
+        return {
+            "stages": self.n_stages,
+            "steps": self.steps,
+            "stage_busy_s": [round(b, 4) for b in self.stage_busy_s],
+            "window_s": round(self.window_s, 4),
+            "bubble_frac": self.bubble_frac(),
+            "schedule_bubble_frac": self.schedule_bubble_frac(),
+        }
+
+
+class InferenceStagePlan:
+    """Geometry + placement for stage-sharded serving: layer bounds,
+    per-stage sub-meshes (None = virtual staging on the default device —
+    the program decomposition and schedule run identically, just without
+    physical placement; the parity tests' shape), microbatch ranges, and
+    the cross-stage transfer helper.
+
+    `tensor` > 1 shards each slab tensor-parallel INSIDE its stage's
+    sub-mesh via the standard logical-axis rules (`layers` remapped to
+    None — a slab is the stage's whole local stack), the serving twin of
+    the dp x pp x fsdp x tp trainer composition."""
+
+    def __init__(self, n_layers: int, n_stages: int, n_slots: int, *,
+                 tensor: int = 1,
+                 devices: Sequence[jax.Device] | None = None):
+        from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh, \
+            stage_submeshes
+
+        if tensor < 1:
+            raise ValueError("tensor must be >= 1")
+        self.n_stages = int(n_stages)
+        self.tensor = int(tensor)
+        self.bounds = stage_bounds(n_layers, n_stages)
+        self.mb_ranges = microbatch_ranges(n_slots, n_stages)
+        if devices is None:
+            devices = jax.devices()
+        needed = self.n_stages * self.tensor
+        if len(devices) >= needed and needed > 1:
+            self.mesh = make_mesh(MeshConfig(stage=n_stages, tensor=tensor),
+                                  devices=devices[:needed])
+            self.submeshes: list[Mesh | None] = stage_submeshes(self.mesh)
+        else:
+            if self.tensor > 1:
+                raise ValueError(
+                    f"tensor={tensor} needs {needed} devices "
+                    f"({len(devices)} available); stage-only layouts "
+                    "degrade to virtual staging, tensor sharding cannot")
+            # virtual staging: every stage on the default device — same
+            # programs, same schedule, no physical placement
+            self.mesh = None
+            self.submeshes = [None] * self.n_stages
+        self._repl = [None if sm is None
+                      else NamedSharding(sm, P())
+                      for sm in self.submeshes]
+        self.perf = StagePerf(self.n_stages)
+
+    @property
+    def n_microbatches(self) -> int:
+        return len(self.mb_ranges)
+
+    def replicated(self, stage: int):
+        return self._repl[stage]
+
+    def to_stage(self, x, stage: int):
+        """Move an array onto `stage`'s sub-mesh (replicated). Identity
+        under virtual staging — and for host numpy inputs, which jit
+        places itself."""
+        sh = self._repl[stage]
+        if sh is None or x is None:
+            return x
+        return jax.device_put(x, sh)
+
+    def shard_slab(self, slab: dict, stage: int, logical_tree: dict):
+        """Place one stage's params slab: tensor-sharded by the logical
+        rules on the stage's sub-mesh (layers → None: the slab IS the
+        local stack), or left as-is under virtual staging."""
+        sm = self.submeshes[stage]
+        if sm is None:
+            return jax.tree.map(jnp.asarray, slab)
+        from kubeflow_tpu.parallel.sharding import (shard_tree,
+                                                    tree_logical_to_sharding)
+
+        shardings = tree_logical_to_sharding(
+            logical_tree, sm, rules={"layers": None})
+        return shard_tree(slab, shardings)
+
+    def cache_sharding(self, stage: int):
+        """KV-slab sharding on the stage sub-mesh: kv-heads over
+        `tensor` (dim 3 for both 5D payloads and 4D scale planes), the
+        single-program engine's layout per stage."""
+        sm = self.submeshes[stage]
+        if sm is None:
+            return None
+        return NamedSharding(sm, P(None, None, None, "tensor"))
+
+    def describe(self) -> dict:
+        """The /healthz `mesh` section's geometry half."""
+        return {
+            "stages": self.n_stages,
+            "tensor": self.tensor,
+            "virtual": self.mesh is None,
+            "device_count": (self.n_stages * self.tensor
+                             if self.mesh is not None else 1),
+            "stage_layers": [hi - lo for lo, hi in self.bounds],
+            "microbatches": [list(r) for r in self.mb_ranges],
+        }
+
+
+class StageClock:
+    """Timing bracket for one stage-program execution: measures busy
+    wall into a StagePerf when armed, a no-op pass-through otherwise
+    (blocking for the timestamp would serialize the very overlap the
+    schedule exists for)."""
+
+    def __init__(self, perf: StagePerf, enabled: bool):
+        self.perf = perf
+        self.enabled = enabled
+
+    def run(self, stage: int, thunk):
+        if not self.enabled:
+            return thunk()
+        t0 = time.perf_counter()
+        out = thunk()
+        jax.block_until_ready(out)
+        self.perf.record_stage(stage, time.perf_counter() - t0)
+        return out

@@ -1130,23 +1130,46 @@ class LLMEngine:
             return None
         return m, p, t
 
-    def _materialize_prefix(self, payloads: list):
-        """Matched block chain → the continuation program's (k, v)
-        prefix arrays [L, 1, P, kv, hd] in model dtype: concatenate
-        along the token axis, dequantizing int8 blocks at the last
-        moment (the store keeps them int8 — half the residency).
-        Device-to-device only; nothing crosses the host."""
-        if self.kv_quantize == "int8":
+    @staticmethod
+    def _materialize_payloads(payloads: list, kv_quantize, dtype):
+        """Block-payload chain → (k, v) prefix arrays [L, 1, P, kv, hd]
+        in model dtype: concatenate along the token axis, dequantizing
+        int8 blocks at the last moment (the store keeps them int8 — half
+        the residency). Device-to-device only; nothing crosses the host.
+        Static so the stage-sharded engine can run it per layer slab."""
+        if kv_quantize == "int8":
             kq = jnp.concatenate([b[0] for b in payloads], axis=2)
             ks = jnp.concatenate([b[1] for b in payloads], axis=2)
             vq = jnp.concatenate([b[2] for b in payloads], axis=2)
             vs = jnp.concatenate([b[3] for b in payloads], axis=2)
-            return (llama.dequantize_kv(kq, ks, self.cfg.dtype),
-                    llama.dequantize_kv(vq, vs, self.cfg.dtype))
+            return (llama.dequantize_kv(kq, ks, dtype),
+                    llama.dequantize_kv(vq, vs, dtype))
         if len(payloads) == 1:
             return payloads[0]
         return (jnp.concatenate([b[0] for b in payloads], axis=2),
                 jnp.concatenate([b[1] for b in payloads], axis=2))
+
+    def _materialize_prefix(self, payloads: list):
+        """Matched block chain → the continuation program's (k, v)
+        prefix arrays (see _materialize_payloads)."""
+        return self._materialize_payloads(payloads, self.kv_quantize,
+                                          self.cfg.dtype)
+
+    def _stack_prefix(self, entries: list):
+        """Stack per-request materialized prefixes into the continuation
+        wave's (k_prefix, v_prefix) program inputs along the batch axis.
+        entries: list of `_materialize_prefix` results, one per wave row.
+        The stage-sharded engine overrides this to stack per layer slab."""
+        return (jnp.concatenate([e[0] for e in entries], axis=1),
+                jnp.concatenate([e[1] for e in entries], axis=1))
+
+    @staticmethod
+    def _payload_slice(parts, s: int, e: int):
+        """One radix block's payload from the raw-extract arrays: the
+        [s, e) token-axis slice of every part. The stage-sharded engine
+        overrides this to slice each stage's parts (the block payload is
+        then the per-stage tuple — the stage-keyed store's currency)."""
+        return tuple(a[:, :, s:e] for a in parts)
 
     def _decode_fn(self, steps: int, span: int | None = None):
         """One compiled program per (chunk length, attention span) pair —
@@ -1159,6 +1182,19 @@ class LLMEngine:
                 functools.partial(self._decode, steps=steps, span=span),
                 donate_argnums=(1, 2, 3, 4, 5))
         return self._decode_fns[steps, span]
+
+    def _decode_nosample_fn(self, steps: int, span: int | None = None):
+        """The PROFILER's sampling-stripped decode variant (same call
+        signature as _decode_fn's programs): raw argmax, no sampling
+        pipeline, no penalty-count touch — timing it against the full
+        program isolates the sampling bucket of the decode breakdown.
+        A method (not an inline jit in the profiler) so the
+        stage-sharded engine can supply its pipelined twin."""
+        span = self.max_len if span is None else span
+        return jax.jit(
+            functools.partial(self._decode, steps=steps, span=span,
+                              sample=False),
+            donate_argnums=(1, 2, 3, 4, 5))
 
     def _span_menu(self) -> list[int]:
         """Attention-span buckets: powers of two from 128 up to (and always
@@ -1690,8 +1726,7 @@ class LLMEngine:
                     packed[:, -ex] = np.arange(width) % self.n_slots
                     packed[:, -ex + 1] = p + 1  # last-row index stays valid
                     packed[:, -ex + 7] = -1   # unseeded sentinel
-                    kw = jnp.concatenate([ek] * width, axis=1)
-                    vw = jnp.concatenate([ev] * width, axis=1)
+                    kw, vw = self._stack_prefix([(ek, ev)] * width)
                     (self.cache, self.lengths, self.last_tokens,
                      self.samp, self.rng_key, _) = \
                         self._cont_fn(p, t, width)(
@@ -1925,13 +1960,36 @@ class LLMEngine:
         self.decode_chunk = chunk
         return chunk
 
+    def mesh_info(self) -> dict[str, Any]:
+        """The /healthz `mesh` section (ISSUE 14 satellite): layout name,
+        axis names/sizes, device count, and params bytes — so a fleet
+        operator can tell a single-chip replica from a tp slice from a
+        tp×pp stage-sharded one without a device round-trip. The
+        stage-sharded engine overrides this with its per-stage view."""
+        params_bytes = (int(sum(l.nbytes
+                                for l in jax.tree.leaves(self.params)))
+                        if self.params is not None else 0)
+        if self.mesh is None:
+            return {"layout": "single", "axes": {}, "device_count": 1,
+                    "params_bytes": params_bytes}
+        from kubeflow_tpu.parallel.mesh import mesh_shape
+
+        shape = mesh_shape(self.mesh)
+        axes = {k: v for k, v in shape.items() if v > 1}
+        return {"layout": "tensor" if axes.get("tensor", 1) > 1
+                else "mesh",
+                "axes": axes,
+                "device_count": int(math.prod(shape.values())),
+                "params_bytes": params_bytes}
+
     def metrics(self) -> dict[str, Any]:
         ttfts = list(self._ttft_window)  # survives release() of old requests
         s = self.scheduler.stats()
         out = {"queued": s.queued, "active": s.active,
                "completed": s.completed, "rejected": s.rejected,
                "cancelled": self._cancelled_count,
-               "decode_chunk": self.decode_chunk}
+               "decode_chunk": self.decode_chunk,
+               "mesh": self.mesh_info()}
         out["prefill_tokens_computed"] = self._prefill_computed_tokens
         if self.prefix_cache_enabled and self.kvcache is not None:
             st = self.kvcache.stats()
@@ -2057,8 +2115,7 @@ class LLMEngine:
                  a.slot, a.prompt_len) + self._row_tail(a.req_id)
                 for a, _ in padded]
         packed = self._pack_rows(width, t + (p if self.spec else 0), rows)
-        k_prefix = jnp.concatenate([e[0] for _, e in padded], axis=1)
-        v_prefix = jnp.concatenate([e[1] for _, e in padded], axis=1)
+        k_prefix, v_prefix = self._stack_prefix([e for _, e in padded])
         (self.cache, self.lengths, self.last_tokens, self.samp,
          self.rng_key, out) = self._cont_fn(p, t, width)(
             self.params, self.cache, self.lengths, self.last_tokens,
@@ -2087,7 +2144,7 @@ class LLMEngine:
         parts = self._extract_raw_fn(aligned)(self.cache, action.slot)
 
         def payload(_i, s, e):
-            return tuple(a[:, :, s:e] for a in parts)
+            return self._payload_slice(parts, s, e)
 
         self.kvcache.insert(prompt, payload, max_tokens=aligned,
                             tenant=self._req_tenant.get(action.req_id),

@@ -71,6 +71,7 @@ class LLMModel(Model):
                  disaggregated: bool = False,
                  disagg: dict[str, Any] | None = None,
                  usage_timing: bool = False,
+                 parallel: dict[str, Any] | None = None,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -145,6 +146,30 @@ class LLMModel(Model):
             raise ValueError(
                 "disaggregated serving requires supervised: true (each "
                 "role's crash story IS its supervisor)")
+        # config.parallel {tensor: T, stage: P} (ISSUE 14): the tp×pp
+        # engine layout. stage > 1 builds the stage-sharded engine
+        # (serving/multichip.py) — per-stage params/KV slabs, microbatched
+        # MPMD decode; stage == 1 with tensor > 1 is sugar for the
+        # existing GSPMD tensor-parallel mesh path.
+        self._parallel = dict(parallel or {})
+        _pp_raw = self._parallel.get("stage")
+        _tp_raw = self._parallel.get("tensor")
+        pp = 1 if _pp_raw is None else int(_pp_raw)
+        tp = 1 if _tp_raw is None else int(_tp_raw)
+        if pp < 1 or tp < 1:
+            raise ValueError("parallel.stage/parallel.tensor must be >= 1")
+        if pp > 1 and self._disaggregated:
+            raise ValueError(
+                "parallel.stage > 1 does not compose with disaggregated "
+                "serving yet (the stage pipeline IS the prefill/decode "
+                "overlap mechanism)")
+        if (pp > 1 or tp > 1) and self._mesh:
+            # a silently-dropped tensor request would serve on an
+            # unintended layout — reject every parallel+mesh combo
+            raise ValueError("pass parallel OR mesh, not both")
+        self._pp, self._tp = pp, tp
+        if pp == 1 and tp > 1:
+            self._mesh = {"tensor": tp}
         # config.usage_timing: surface the request_timing() phase split
         # (queue_wait_ms / prefill_ms / decode_ms) in the OpenAI usage
         # object; off (default) keeps the usage shape byte-unchanged
@@ -245,13 +270,22 @@ class LLMModel(Model):
         warmed: list[bool] = []
 
         def engine_factory():
-            # the only sanctioned LLMEngine construction site on the
+            # the only sanctioned engine construction site on the
             # serving dataplane (scripts/check_dataplane.py enforces
             # this): engines are born inside a supervisor factory, so a
             # crash always has a recovery story. The first build always
             # warms (no live request waits on XLA at load); restarts
-            # rewarm per config.supervisor.rewarm.
-            eng = LLMEngine(params, cfg, **engine_kw)
+            # rewarm per config.supervisor.rewarm. config.parallel with
+            # stage > 1 builds the tp×pp stage-sharded engine instead —
+            # same supervision, journaling, and replay story.
+            if self._pp > 1:
+                from kubeflow_tpu.serving.multichip import \
+                    StageShardedEngine
+
+                eng = StageShardedEngine(params, cfg, stage=self._pp,
+                                         tensor=self._tp, **engine_kw)
+            else:
+                eng = LLMEngine(params, cfg, **engine_kw)
             if rewarm or not warmed:
                 eng.warmup()
                 warmed.append(True)

@@ -190,6 +190,7 @@ class ModelServer:
         caches: dict[str, Any] = {}
         sups: dict[str, Any] = {}
         disaggs: dict[str, Any] = {}
+        meshes: dict[str, Any] = {}
         for mname in self.repository.names():
             try:
                 mm = self.repository.get(mname).metrics()
@@ -199,6 +200,18 @@ class ModelServer:
             pc = (mm or {}).get("prefix_cache")
             if pc:
                 caches[mname] = pc
+            mesh = (mm or {}).get("mesh")
+            if mesh:
+                # multichip observability (ISSUE 14): layout name, axis
+                # names/sizes, device count, per-stage params bytes —
+                # a fleet operator tells a single-chip replica from a
+                # tp slice from a tp×pp stage-sharded one here, through
+                # the same EngineSupervisor metrics passthrough as the
+                # kv_cache section
+                meshes[mname] = mesh
+                pipe = (mm or {}).get("pipeline")
+                if pipe:
+                    meshes[mname] = dict(mesh, pipeline=pipe)
             sup = (mm or {}).get("supervisor")
             if sup:
                 sups[mname] = {
@@ -233,6 +246,8 @@ class ModelServer:
             body["supervisor"] = sups
         if disaggs:
             body["disagg"] = disaggs
+        if meshes:
+            body["mesh"] = meshes
         return body
 
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:

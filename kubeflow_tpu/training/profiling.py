@@ -139,8 +139,6 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
     the synthetic slots mid-generation so the attention span is
     realistic; `hbm_gbps` adds the analytic weight-read floor next to
     the measured one."""
-    import functools
-
     import jax
     import jax.numpy as jnp
 
@@ -189,19 +187,32 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         return go
 
     fn_full = engine._decode_fn(steps, span)
-    fn_nosample = jax.jit(
-        functools.partial(engine._decode, steps=steps, span=span,
-                          sample=False),
-        donate_argnums=(1, 2, 3, 4, 5))
+    # the sampling-stripped variant comes from the ENGINE (LLMEngine
+    # jits its _decode with sample=False; the stage-sharded engine
+    # returns its pipelined driver twin) so the differential stays
+    # apples-to-apples per engine kind
+    fn_nosample = engine._decode_nosample_fn(steps, span)
 
     # pure weight read: reduce every non-embed leaf to one scalar — reads
     # each byte exactly once, FLOPs are negligible, so its wall time IS
     # the achievable weight-read time of this chip (embed is excluded
-    # because decode gathers a handful of its rows, never the table)
+    # because decode gathers a handful of its rows, never the table).
+    # Stage-sharded engines hold params as a LIST of per-stage slabs —
+    # strip each slab's embed the same way.
     params = engine.params
-    read_tree = ({k: v for k, v in params.items() if k != "embed"}
-                 if isinstance(params, dict) else params)
-    read_bytes = int(sum(l.nbytes for l in jax.tree.leaves(read_tree)))
+    if isinstance(params, dict):
+        read_trees = [{k: v for k, v in params.items() if k != "embed"}]
+    elif isinstance(params, list):
+        # stage-sharded engine: one slab per stage, each on ITS OWN
+        # device group — one jitted read per slab (a single program
+        # spanning device groups is rejected), dispatched together so
+        # per-stage reads overlap exactly like the pipeline's
+        read_trees = [{k: v for k, v in slab.items() if k != "embed"}
+                      for slab in params]
+    else:
+        read_trees = [params]
+    read_bytes = int(sum(l.nbytes for t in read_trees
+                         for l in jax.tree.leaves(t)))
 
     @jax.jit
     def read_all(p):
@@ -211,7 +222,9 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         return tot
 
     def run_read():
-        float(np.asarray(read_all(read_tree)))
+        outs = [read_all(t) for t in read_trees]   # dispatch all first
+        for o in outs:
+            float(np.asarray(o))
 
     # trivial round trip: dispatch + scalar fetch of a one-add program —
     # the per-dispatch host<->device overhead every chunk pays once
@@ -231,7 +244,24 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         warm()
 
     t_rtt = _median_time(run_rtt, iters)
+    if hasattr(engine, "pipeline_perf"):
+        engine.pipeline_perf(reset=True)   # bracket the timed window
     t_full = _median_time(run_decode(fn_full), iters)
+    # pipeline_bubble bucket (ISSUE 14 satellite): per-stage idle wall
+    # per decode step, from the stage-sharded engine's own per-stage
+    # timestamps (None for single-program engines, and None when the
+    # engine runs with stage_timing off — the schedule-derived fraction
+    # still rides the `pipeline` sub-record either way)
+    pipe_bubble_ms = None
+    pipe_snap = None
+    if hasattr(engine, "pipeline_perf"):
+        pipe_snap = engine.pipeline_perf(reset=True)
+        if pipe_snap["steps"] and pipe_snap["bubble_frac"] is not None:
+            n_st = pipe_snap["stages"]
+            idle = (n_st * pipe_snap["window_s"]
+                    - sum(pipe_snap["stage_busy_s"]))
+            pipe_bubble_ms = round(
+                max(idle, 0.0) / (n_st * pipe_snap["steps"]) * 1e3, 4)
     t_nosample = _median_time(run_decode(fn_nosample), iters)
     t_read = max(_median_time(run_read, iters) - t_rtt, 0.0)
 
@@ -255,10 +285,11 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
 
         def run_handoff():
             parts = engine._extract_raw_fn(bt)(engine.cache, 0)
-            payload = tuple(a[:, :, :bt] for a in parts)
+            payload = engine._payload_slice(parts, 0, bt)
             scratch.clear()   # nothing pins the scratch between runs
             handoff.send(probe_tokens, [payload])
-            float(np.asarray(parts[0]).flat[0])   # value-fetch sync
+            float(np.asarray(jax.tree.leaves(parts)[0]).flat[0])
+            # ^ value-fetch sync
 
         run_handoff()   # compile + fault pages, untimed
         kv_handoff_ms = round(
@@ -297,12 +328,17 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
             # per BLOCK handed off, not per step: the handoff rides
             # prefill completion, so its cadence is per-request
             "kv_handoff": kv_handoff_ms,
+            # per-stage idle wall per decode step (stage-sharded
+            # engines with stage_timing armed; None elsewhere)
+            "pipeline_bubble": pipe_bubble_ms,
         },
         # live engine counters for the host-side buckets (per-chunk wall
         # the host spent dispatching vs fetching+replaying, amortized)
         "host_dispatch_per_step_ms": dispatch_host_ms,
         "perf_counters": perf,
     }
+    if pipe_snap is not None:
+        out["pipeline"] = pipe_snap
     if hbm_gbps:
         floor_ms = read_bytes / (hbm_gbps * 1e9) * 1e3
         out["weight_read_floor_ms"] = round(floor_ms, 4)
@@ -324,7 +360,12 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
 
     # leave the engine exactly as warmup does: slot state reset, host
     # mirrors zeroed (the junk cache rows are dead — the next prefill
-    # into a slot rewrites them)
+    # into a slot rewrites them). The pipeline counters reset too: the
+    # nosample/trace runs above fired record_step after the committed
+    # snapshot, and profiler junk must not leak into the next live
+    # metrics()["pipeline"] read.
+    if hasattr(engine, "pipeline_perf"):
+        engine.pipeline_perf(reset=True)
     engine.lengths = engine._put(np.zeros((n_slots,), np.int32))
     engine.last_tokens = engine._put(np.zeros((n_slots,), np.int32))
     engine.samp = engine._put(engine._samp_reset())

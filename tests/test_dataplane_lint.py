@@ -131,3 +131,48 @@ def test_lint_flags_role_engine_aware_frontend(tmp_path):
                           repo_root=str(tmp_path))
     assert any("PrefillEngine" in f and "frontends must speak" in f
                for f in findings)
+
+
+def test_lint_flags_bare_stage_sharded_engine(tmp_path):
+    """The tp×pp engine (ISSUE 14) is under the same factory-only rule:
+    a bare StageShardedEngine outside a supervisor factory is exactly
+    the unsupervised crash hole, times pp device groups."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue_pp.py").write_text(
+        "from kubeflow_tpu.serving.multichip import StageShardedEngine\n"
+        "def serve(params, cfg):\n"
+        "    return StageShardedEngine(params, cfg, stage=2)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "StageShardedEngine" in findings[0]
+    assert "supervisor factory" in findings[0]
+
+
+def test_lint_allows_stage_sharded_factory(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "fine_pp.py").write_text(
+        "from kubeflow_tpu.serving.multichip import StageShardedEngine\n"
+        "from kubeflow_tpu.serving.agent import EngineSupervisor\n"
+        "def supervised(params, cfg):\n"
+        "    def engine_factory():\n"
+        "        return StageShardedEngine(params, cfg, stage=2)\n"
+        "    return EngineSupervisor(engine_factory)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_flags_stage_engine_aware_frontend(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "grpc_server.py").write_text(
+        "from kubeflow_tpu.serving.multichip import StageShardedEngine\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert any("StageShardedEngine" in f for f in findings)

@@ -82,3 +82,37 @@ def test_breakdown_captures_profiler_trace(engine, tmp_path):
     if "trace_dir" in bd:
         assert os.path.exists(os.path.join(trace_dir, "PROFILE_DONE"))
         assert os.listdir(trace_dir)
+
+
+def test_breakdown_pipeline_bubble_none_on_single_program(engine):
+    """The pipeline_bubble bucket (ISSUE 14 satellite) exists on every
+    breakdown but is None for single-program engines — the bucket only
+    measures a stage pipeline's idle wall."""
+    bd = serving_decode_breakdown(engine, steps=2, iters=2)
+    assert "pipeline_bubble" in bd["buckets_ms"]
+    assert bd["buckets_ms"]["pipeline_bubble"] is None
+    assert "pipeline" not in bd
+
+
+@pytest.mark.slow
+def test_breakdown_pipeline_bubble_on_stage_sharded_engine():
+    """On a stage-sharded engine with stage_timing armed, the bucket
+    carries measured per-stage idle wall per decode step and the
+    `pipeline` sub-record rides the breakdown."""
+    from kubeflow_tpu.serving.multichip import StageShardedEngine
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    eng = StageShardedEngine(params, cfg, stage=2, stage_timing=True,
+                             n_slots=2, max_len=64, buckets=(16,),
+                             decode_chunk=4)
+    try:
+        bd = serving_decode_breakdown(eng, steps=2, iters=2)
+        assert bd["buckets_ms"]["pipeline_bubble"] is not None
+        assert bd["buckets_ms"]["pipeline_bubble"] >= 0
+        assert bd["pipeline"]["stages"] == 2
+        assert bd["pipeline"]["steps"] > 0
+        # profiling leaves the engine serviceable (warmup-style reset)
+        assert len(eng.generate([1, 2, 3], 6)) == 6
+    finally:
+        eng.close()

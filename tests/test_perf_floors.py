@@ -208,3 +208,50 @@ def test_disagg_floors_gated_on_schema_7(tmp_path):
     p.write_text(json.dumps(rec7))
     assert any(f.startswith("disagg_crash_terminal_frac")
                for f in bench.check_floors(str(p)))
+
+
+def test_multichip_floors_gated_on_schema_8(tmp_path):
+    """serving_multichip's exact-parity floor (r13) only binds records
+    new enough to carry the section: every pre-r13 committed record
+    stays valid, a schema-8 record missing the section fails loudly,
+    and a schema-8 record holding byte parity is green. Parity is an
+    exact contract — 0.99 is a failure, not noise."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 8   # committed record predates r13
+    assert not any("multichip" in f for f in bench.check_floors(_RECORD))
+
+    rec8 = json.loads(json.dumps(rec))
+    rec8["schema"] = 8
+    p = tmp_path / "rec8.json"
+    p.write_text(json.dumps(rec8))
+    assert any(f.startswith("multichip_greedy_parity")
+               for f in bench.check_floors(str(p)))
+
+    rec8["extras"]["serving_multichip"] = {"greedy_parity": True}
+    p.write_text(json.dumps(rec8))
+    assert not any("multichip" in f for f in bench.check_floors(str(p)))
+
+    rec8["extras"]["serving_multichip"]["greedy_parity"] = 0.99
+    p.write_text(json.dumps(rec8))
+    assert any(f.startswith("multichip_greedy_parity")
+               for f in bench.check_floors(str(p)))
+
+
+def test_schema_gates_table_matches_floors(tmp_path):
+    """SCHEMA_GATES drives the --check 'gated out' report: every gated
+    name must be a real floor, and gated_out_floors() must list exactly
+    the floors a record's schema predates."""
+    assert set(bench.SCHEMA_GATES) <= set(bench.PERF_FLOORS)
+    rec = {"schema": 5, "headline": {"value": 1}, "extras": {}}
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps(rec))
+    gated = bench.gated_out_floors(str(p))
+    assert "multichip_greedy_parity" in gated          # schema 8 > 5
+    assert "chaos_http_stream_completion" in gated     # schema 6 > 5
+    assert "prefix_cache_hit_rate" not in gated        # schema 5 binds
+    # schema-less committed records gate out every schema'd floor
+    p.write_text(json.dumps({"headline": {"value": 1}, "extras": {}}))
+    assert set(bench.gated_out_floors(str(p))) == set(bench.SCHEMA_GATES)
