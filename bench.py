@@ -298,6 +298,12 @@ def main() -> None:
                 on_tpu, budget)
         except Exception as e:
             extras["serving_multichip_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "serving_kernels"):
+        try:
+            extras["serving_kernels"] = serving_kernels_bench(
+                on_tpu, budget)
+        except Exception as e:
+            extras["serving_kernels_error"] = f"{type(e).__name__}: {e}"
     extras["budget"] = {"total_s": budget.total_s,
                         "used_s": round(budget.elapsed(), 1),
                         "env": BUDGET_ENV}
@@ -340,12 +346,13 @@ def main() -> None:
         # schema 7 adds serving_disagg (colocated-vs-disaggregated on
         # the pinned diurnal_burst trace); schema 8 adds
         # serving_multichip (tp×pp stage-sharded decode parity + bubble
-        # accounting) and the per-section runtime stamps. The floor
-        # gate only demands a section's metrics from records new enough
-        # to know about it (older committed records stay valid under
-        # --check; `--check` lists which floors a record's schema gates
-        # out).
-        json.dump({"schema": 8, "headline": headline, "extras": extras},
+        # accounting) and the per-section runtime stamps; schema 9 adds
+        # serving_kernels (the xla-vs-flash decode-kernel A/B with its
+        # exact parity contract). The floor gate only demands a
+        # section's metrics from records new enough to know about it
+        # (older committed records stay valid under --check; `--check`
+        # lists which floors a record's schema gates out).
+        json.dump({"schema": 9, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -448,6 +455,15 @@ PERF_FLOORS = {
     # EXACT contract: the zero-lost invariant under a prefill-worker
     # crash mid-trace (every accepted request reaches a terminal state).
     "disagg_crash_terminal_frac": 1.0,
+    # serving_kernels (r14): enforced only on schema>=9 records.
+    # EXACT contract, not a perf number: greedy AND seeded tokens
+    # through the Pallas flash-decode kernel (int8 KV, chunked prefill,
+    # prefix-cache hit, speculative verify) must be byte-identical to
+    # the XLA einsum path's on the same warmed-engine construction.
+    # The SPEEDUP stays a recorded number, not a floor — the CPU smoke
+    # runs the kernel in interpret mode, so the gain claim awaits the
+    # open-item-#1 TPU record (the established convention).
+    "kernel_greedy_parity": 1.0,
     # serving_multichip (r13): enforced only on schema>=8 records.
     # EXACT contract, not a perf number: greedy tokens through the
     # tp×pp stage-sharded engine (per-stage params/KV slabs,
@@ -477,6 +493,7 @@ SCHEMA_GATES = {
     "disagg_greedy_parity": 7,
     "disagg_crash_terminal_frac": 7,
     "multichip_greedy_parity": 8,
+    "kernel_greedy_parity": 9,
 }
 
 
@@ -556,6 +573,8 @@ def check_floors(path: str) -> list[str]:
          as_frac(get(ex, "serving_prefix_cache", "greedy_parity"))),
         ("multichip_greedy_parity",
          as_frac(get(ex, "serving_multichip", "greedy_parity"))),
+        ("kernel_greedy_parity",
+         as_frac(get(ex, "serving_kernels", "kernel_greedy_parity"))),
     ]
     schema = rec.get("schema", 1)
     failures = []
@@ -2237,6 +2256,221 @@ def serving_disagg_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
     return out
 
 
+def serving_kernels_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
+    """Kernel-path A/B record (ISSUE 15, ROADMAP #5): the SAME model,
+    trace, and engine construction measured twice — once with
+    `decode_attention_impl: xla` (the reference einsum) and once with
+    `flash` (the fused Pallas flash-decode kernel over the int8 KV
+    slab, ops/flash_decode.py) — so a kernel win (or regression) is a
+    committed number on the current toolchain, never folklore.
+    Committed:
+
+    - per impl: replayed TTFT/TPOT percentiles + decode throughput on
+      the identical byte-pinned shared_prefix_chat trace (int8 KV +
+      chunked prefill + prefix cache ON — every correctness-critical
+      decode path at once), and the full `serving_decode_breakdown`
+      (whose `attn_kernel`/`attn_dequant` sub-buckets localize the
+      delta: the impls differ there, every other bucket stays put);
+    - `decode_step_ratio` (xla device step / flash device step) and
+      `bucket_delta_ms` — the per-bucket attribution of the A/B;
+    - `kernel_greedy_parity` — the exact contract, floor 1.0 on
+      schema>=9 records: greedy AND seeded byte parity across the impls
+      on probes covering the prefix-cache hit path and chunked prompts,
+      plus speculative-verify parity (a flash spec engine, S_v>1
+      through the kernel, against the xla pair) — all must hold;
+    - `quant_matmul`: the weight-read path the record ran under
+      (resolve_quant_matmul_impl — the other ISSUE 15 default flip).
+
+    On CPU the flash engine runs the kernel in INTERPRET mode, so the
+    timing comparison is a smoke of machinery + parity only; the
+    speedup floor stays a placeholder until the open-item-#1 TPU record
+    (the established convention)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.loadgen import (generate_trace, load_scenario,
+                                      miniature, trace_sha256)
+    from kubeflow_tpu.loadgen.runner import run_trace
+    from kubeflow_tpu.ops import quant
+    from kubeflow_tpu.serving.llm import LLMEngine
+    from kubeflow_tpu.training.profiling import serving_decode_breakdown
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=3584, max_seq_len=1024, remat=False)
+        eng_kw = dict(n_slots=8, max_len=512, buckets=(64, 256),
+                      decode_chunk=8, prefix_cache=True,
+                      prefix_cache_blocks=128, kv_quantize="int8",
+                      quantize="int8", warm_cont_pairs=None)
+        spec_kw = dict(n_slots=8, max_len=512, buckets=(64,),
+                       decode_chunk=8, kv_quantize="int8",
+                       quantize="int8", speculative=3)
+        mini = None
+        max_new = 32
+        bd_kw = dict(steps=4, iters=5)
+    else:
+        # f32 on CPU: the parity claim is the MACHINERY's exactness,
+        # measured in a dtype where cross-impl accumulation-order drift
+        # cannot make byte comparison a coin flip at toy dims (the
+        # multichip smoke's choice); int8 KV stays ON — the dequant
+        # fusion is half the kernel's contract
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=8,
+            n_kv_heads=4, d_ff=128, max_seq_len=256, dtype=jnp.float32)
+        eng_kw = dict(n_slots=4, max_len=160, buckets=(8, 32),
+                      decode_chunk=4, prefix_cache=True,
+                      prefix_cache_blocks=96, kv_quantize="int8")
+        spec_kw = dict(n_slots=2, max_len=96, buckets=(16,),
+                       decode_chunk=4, kv_quantize="int8", speculative=3)
+        mini = dict(vocab=cfg.vocab_size, max_prompt_len=60,
+                    duration_s=3.0, rate_rps=5.0)
+        max_new = 12
+        bd_kw = dict(steps=2, iters=3)
+    params = llama.init(jax.random.key(0), cfg)
+    scenario = load_scenario("shared_prefix_chat")
+    if mini is not None:
+        scenario = miniature(scenario, **mini)
+    trace = generate_trace(scenario.trace)
+    out: dict = {
+        "engine": {"model": f"d{cfg.d_model}xL{cfg.n_layers}",
+                   "dtype": str(getattr(cfg.dtype, "__name__", cfg.dtype)),
+                   **{k: v for k, v in eng_kw.items()
+                      if k != "prefix_cache"}},
+        "scenario": scenario.name,
+        "trace_sha256": trace_sha256(trace),
+        "n_requests": len(trace.requests),
+        "quant_matmul": {"impl": quant.resolve_quant_matmul_impl(),
+                         "env": os.environ.get(quant.QUANT_MATMUL_ENV)
+                         or None},
+    }
+    if not on_tpu:
+        out["note"] = ("cpu smoke: the flash impl runs the Pallas "
+                       "INTERPRETER — parity + machinery are the "
+                       "committed claims; the step-time comparison "
+                       "awaits the on-TPU record")
+
+    def expired() -> bool:
+        return budget is not None and budget.expired()
+
+    def replay(engine) -> dict:
+        wall = scenario.trace.duration_s * 4.0 + 60.0
+        if budget is not None:
+            wall = max(5.0, min(wall, budget.remaining()))
+        res = run_trace(engine, trace, max_wall_s=wall)
+        ttfts = [r.ttft_ms() for r in res["records"]]
+        tpots = [r.tpot_ms() for r in res["records"]]
+
+        def pct(vals, q):
+            vals = [v for v in vals if v is not None]
+            return (round(float(np.percentile(vals, q)), 3)
+                    if vals else None)
+
+        agg = res["summary"]["aggregate"]
+        return {
+            "ttft_p50_ms": pct(ttfts, 50), "ttft_p99_ms": pct(ttfts, 99),
+            "tpot_p50_ms": pct(tpots, 50), "tpot_p99_ms": pct(tpots, 99),
+            "throughput_tok_per_s": agg["throughput_tok_per_s"],
+            "completed": agg["completed"],
+            "timed_out": res["timed_out"],
+        }
+
+    engines: dict = {}
+    try:
+        for impl in ("xla", "flash"):
+            if expired():
+                out.setdefault("skipped_for_budget", []).append(impl)
+                continue
+            t0 = time.perf_counter()
+            eng = LLMEngine(params, cfg, decode_attention_impl=impl,
+                            **eng_kw)
+            engines[impl] = eng   # registered BEFORE warmup: a compile
+            # failure must not leak the engine's slabs into the next
+            # section's HBM budget (the outer finally closes everything)
+            eng.warmup()
+            rec = replay(eng)
+            rec["warmup_s"] = round(time.perf_counter() - t0, 1)
+            rec["resolved_impl"] = eng.metrics()["decode_attention_impl"]
+            # the per-bucket attribution: attn_kernel carries the impl
+            # delta, weight_read/sampling/dispatch stay put — the
+            # "explainable per bucket" half of the acceptance criteria
+            rec["decode_breakdown"] = serving_decode_breakdown(
+                eng, **bd_kw)
+            out[impl] = rec
+        if "xla" in out and "flash" in out:
+            bx = out["xla"]["decode_breakdown"]
+            bf = out["flash"]["decode_breakdown"]
+            if bf["device_step_ms"]:
+                out["decode_step_ms"] = {
+                    "xla": bx["device_step_ms"],
+                    "flash": bf["device_step_ms"]}
+                out["decode_step_ratio"] = round(
+                    bx["device_step_ms"] / bf["device_step_ms"], 4)
+            if out["xla"]["tpot_p50_ms"] and out["flash"]["tpot_p50_ms"]:
+                out["tpot_p50_ratio"] = round(
+                    out["xla"]["tpot_p50_ms"]
+                    / out["flash"]["tpot_p50_ms"], 4)
+            out["bucket_delta_ms"] = {
+                k: round(bx["buckets_ms"][k] - bf["buckets_ms"][k], 4)
+                for k in bx["buckets_ms"]
+                if bx["buckets_ms"].get(k) is not None
+                and bf["buckets_ms"].get(k) is not None}
+        # -- the exact parity contract (floor 1.0, schema>=9): greedy +
+        # seeded probes across the impls, incl. a prefix-cache HIT and a
+        # chunked (> largest bucket) prompt; then speculative verify
+        # (S_v>1) through the flash kernel against the xla pair
+        parity: dict[str, bool] = {}
+        if "xla" in engines and "flash" in engines and not expired():
+            ex, ef = engines["xla"], engines["flash"]
+            bt = ex.prefix_block_tokens
+            shared = [(i * 7) % (cfg.vocab_size - 1) + 1
+                      for i in range(2 * bt + bt // 2)]
+            probes = [shared + [17, 23, 5],
+                      shared + [101, 9],          # second use: radix HIT
+                      [7, 9, 11],
+                      list(range(3, eng_kw["buckets"][-1] + 10))]  # chunked
+            parity["greedy"] = bool(all(
+                ex.generate(list(p), max_new) == ef.generate(list(p),
+                                                             max_new)
+                for p in probes))
+            parity["seeded"] = bool(all(
+                ex.generate(list(p), max_new, temperature=0.8, seed=99)
+                == ef.generate(list(p), max_new, temperature=0.8,
+                               seed=99)
+                for p in probes))
+            out["parity_probe_hits"] = ex.metrics()["prefix_hits"]
+        if "xla" in engines and not expired():
+            # speculative verify: draft acceptance runs S_v=4 windows
+            # through the kernel; spec-greedy == plain-greedy is the
+            # engine invariant, so the xla pair is the oracle for BOTH
+            sx = sf = None
+            try:
+                sx = LLMEngine(params, cfg, decode_attention_impl="xla",
+                               **spec_kw)
+                sf = LLMEngine(params, cfg,
+                               decode_attention_impl="flash", **spec_kw)
+                sx.warmup()
+                sf.warmup()
+                sprobes = [list(range(1, 12)) * 2, [5, 6, 7, 5, 6, 7, 5]]
+                parity["spec"] = bool(all(
+                    sx.generate(list(p), max_new)
+                    == sf.generate(list(p), max_new)
+                    for p in sprobes))
+            finally:
+                if sx is not None:
+                    sx.close()
+                if sf is not None:
+                    sf.close()
+        if parity:
+            out["parity"] = parity
+            out["kernel_greedy_parity"] = (
+                1.0 if all(parity.values()) else 0.0)
+    finally:
+        for eng in engines.values():
+            eng.close()
+    return out
+
+
 def _runtime_stamp() -> dict:
     """The live runtime a (section of a) record was measured under:
     platform/device kind/device count/jax versions — so CPU-smoke
@@ -2671,5 +2905,12 @@ if __name__ == "__main__":
         out = serving_multichip_bench(
             "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
         print(json.dumps({"serving_multichip": out}, indent=1))
+        sys.exit(0)
+    if "serving_kernels" in sys.argv:
+        # section-only entry (the ISSUE 15 A/B): run the xla-vs-flash
+        # kernel record standalone and print it
+        out = serving_kernels_bench(
+            "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
+        print(json.dumps({"serving_kernels": out}, indent=1))
         sys.exit(0)
     main()

@@ -68,10 +68,21 @@ class LlamaConfig:
     # logits never materialize (the seq-32k single-chip memory wall);
     # 0 = whole-sequence CE (faster at short seq, same numbers)
     ce_chunk: int = 0
+    # serving DECODE/verify attention over the KV cache slab (ISSUE 15):
+    # "xla" reference einsum, "flash" the fused Pallas flash-decode
+    # kernel (ops/flash_decode.py — online softmax over KV blocks, int8
+    # dequant fused at the block load, GQA regrouped in-kernel), "auto"
+    # the selection policy (flash on TPU, xla elsewhere; KTPU_DECODE_ATTN
+    # env overrides the default). Orthogonal to attention_impl, which
+    # governs the TRAINING/prefill full-sequence attention.
+    decode_attention_impl: str = "auto"
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.decode_attention_impl not in ("auto", "xla", "flash"):
+            raise ValueError("unknown decode_attention_impl "
+                             f"{self.decode_attention_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -616,6 +627,81 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
     return lm_head(params, x, cfg), new_cache
 
 
+def resolve_decode_attn(cfg: LlamaConfig) -> str:
+    """The decode-attention impl this config resolves to ("xla"/"flash")
+    under the ops/flash_decode selection policy — static, so each
+    engine's compiled program menu covers exactly the selected impl."""
+    from kubeflow_tpu.ops import flash_decode
+
+    return flash_decode.resolve_impl(cfg.decode_attention_impl)
+
+
+def decode_attention(cfg: LlamaConfig, q: jax.Array, ck: jax.Array,
+                     cv: jax.Array, cks, cvs, positions: jax.Array,
+                     impl: str | None = None) -> jax.Array:
+    """Grouped-query decode/verify attention over a span-sliced KV cache
+    slab — THE pluggable seam of the serving hot loop (ISSUE 15).
+
+    q: [B, S_v, nh, hd] (post-RoPE, cfg.dtype); ck/cv: [B, span, kv, hd]
+    in cache dtype (int8 or cfg.dtype) with cks/cvs [B, span, kv] f32
+    per-token scales when int8 (None otherwise); positions: [B, S_v]
+    absolute key positions of the query rows — row i MUST sit at
+    positions[:, 0] + i (the decode/verify contract; the flash kernel
+    exploits it). Key t is visible to row i iff t <= positions[:, i].
+    Returns [B, S_v, nh*hd] attention output in cfg.dtype.
+
+    impl: "xla" — the reference einsum path (dequant fused into the
+    einsum operands, f32 softmax); "flash" — the fused Pallas kernel
+    (ops/flash_decode.py; interpret-mode off-TPU, so the differential
+    tests run on CPU); None resolves cfg.decode_attention_impl.
+    """
+    if impl is None:
+        impl = resolve_decode_attn(cfg)
+    b, s_v = q.shape[:2]
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if impl == "flash":
+        from kubeflow_tpu.ops.flash_decode import flash_decode_attention
+
+        out = flash_decode_attention(q, ck, cv, positions[:, 0],
+                                     k_scale=cks, v_scale=cvs,
+                                     scale=1.0 / (hd ** 0.5))
+        return out.reshape(b, s_v, nh * hd)
+    # XLA reference: grouped-query attention WITHOUT repeat_kv — q
+    # regroups to [B, kv, g, Sv, hd] and both einsums contract against
+    # the [B, span, kv, hd] cache directly; materializing the 4x
+    # head-expanded K/V (and, when quantized, a dequantized copy) would
+    # add GiB-scale HBM traffic per step at 8B dims. The int8 cache
+    # dequant stays INSIDE the einsum operand (convert + scale fuse into
+    # the dot read); scales apply to the score/output instead of the
+    # payload where the algebra allows.
+    g = nh // nkv
+    span = ck.shape[1]
+    k_pos = jnp.arange(span)
+    mask = (k_pos[None, None, None, :]
+            <= positions[:, None, :, None])  # [B, 1, Sv, span]
+    qg = jnp.moveaxis(q.reshape(b, s_v, nkv, g, hd), 1, 3)
+    if cks is not None:
+        att = jnp.einsum("bhgqd,bkhd->bhgqk", qg, ck.astype(cfg.dtype),
+                         preferred_element_type=jnp.float32)
+        att = att * jnp.moveaxis(cks, -1, 1)[:, :, None, None, :]
+    else:
+        att = jnp.einsum("bhgqd,bkhd->bhgqk", qg, ck,
+                         preferred_element_type=jnp.float32)
+    att = att * (1.0 / (hd ** 0.5))
+    att = jnp.where(mask[:, :, None], att, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+    if cvs is not None:
+        # v = vq * vs[..., None]: fold vs into probs' k axis so the
+        # int8 payload feeds the dot un-materialized
+        probs_s = probs * jnp.moveaxis(cvs, -1, 1)[
+            :, :, None, None, :].astype(probs.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs_s,
+                         cv.astype(cfg.dtype))
+    else:
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
+    return out.reshape(b, s_v, nh * hd)
+
+
 def verify_inner(layers: Params, x: jax.Array, cache: Params,
                  lengths: jax.Array, cfg: LlamaConfig,
                  span: int | None = None, lora: Params | None = None,
@@ -635,17 +721,14 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
     quantized = "k_s" in cache
     rows = slot_start + jnp.arange(b)
     positions = lengths[:, None] + jnp.arange(s_v)[None]  # [B, S_v]
-    k_pos = jnp.arange(span)
-    # query i (position lengths+i) attends keys at k_pos <= lengths+i;
-    # extra leading axes broadcast over (kv-head, group)
-    mask = (k_pos[None, None, None, :]
-            <= positions[:, None, :, None])  # [B, 1, Sv, span]
     # drop mode: inactive slots can carry lengths near max_len — their junk
     # writes must vanish, not clamp onto the last live row
     idx = (rows[:, None], positions)
-    nh, nkv = cfg.n_heads, cfg.n_kv_heads
-    g = nh // nkv
     full_batch = slot_start == 0 and cache["k"].shape[1] == b
+    # resolved ONCE per trace (static): the whole compiled menu of an
+    # engine runs one decode-attention impl — xla einsum or the fused
+    # Pallas flash-decode kernel (cfg.decode_attention_impl)
+    attn_impl = resolve_decode_attn(cfg)
 
     # The KV cache rides the scan as CARRY (not xs/ys): a per-layer
     # dynamic-update-slice on the carried buffer updates S_v rows in
@@ -680,41 +763,17 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
                     rows_all, slot_start, slot_start + b, axis=0)
             return jax.lax.slice_in_dim(rows_all, 0, span, axis=1)
 
-        ck = layer_span("k")
-        cv = layer_span("v")
-        # grouped-query attention WITHOUT repeat_kv: q regroups to
-        # [B, kv, g, Sv, hd] and both einsums contract against the
-        # [B, span, kv, hd] cache directly — materializing the 4x
-        # head-expanded K/V (and, when quantized, a dequantized copy)
-        # would add GiB-scale HBM traffic per step at 8B dims. The int8
-        # cache dequant stays INSIDE the einsum operand (convert + scale
-        # fuse into the dot read); scales apply to the score/output
-        # instead of the payload where the algebra allows.
-        qg = jnp.moveaxis(q.reshape(b, s_v, nkv, g, cfg.head_dim), 1, 3)
-        if quantized:
-            att = jnp.einsum("bhgqd,bkhd->bhgqk", qg,
-                             ck.astype(cfg.dtype),
-                             preferred_element_type=jnp.float32)
-            cks = layer_span("k_s")   # [B, span, kv] f32
-            att = att * jnp.moveaxis(cks, -1, 1)[:, :, None, None, :]
-        else:
-            att = jnp.einsum("bhgqd,bkhd->bhgqk", qg, ck,
-                             preferred_element_type=jnp.float32)
-        att = att * (1.0 / (cfg.head_dim ** 0.5))
-        att = jnp.where(mask[:, :, None], att,
-                        jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
-        if quantized:
-            cvs = layer_span("v_s")
-            # v = vq * vs[..., None]: fold vs into probs' k axis so the
-            # int8 payload feeds the dot un-materialized
-            probs_s = probs * jnp.moveaxis(cvs, -1, 1)[
-                :, :, None, None, :].astype(probs.dtype)
-            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs_s,
-                             cv.astype(cfg.dtype))
-        else:
-            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
-        x = x + _wo(cfg, out.reshape(b, s_v, -1), layer, ll, ids)
+        # attention over the slab rides the pluggable decode_attention
+        # seam: the xla einsum reference or the fused Pallas flash-decode
+        # kernel, per cfg.decode_attention_impl — ONE body for plain
+        # decode (S_v=1) and speculative verify, so the impls can never
+        # diverge the two paths
+        out = decode_attention(
+            cfg, q, layer_span("k"), layer_span("v"),
+            layer_span("k_s") if quantized else None,
+            layer_span("v_s") if quantized else None,
+            positions, impl=attn_impl)
+        x = x + _wo(cfg, out, layer, ll, ids)
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return (x, cache_c), None
 

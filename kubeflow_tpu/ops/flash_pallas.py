@@ -38,10 +38,9 @@ FORCE_INTERPRET = False
 
 
 def _compiler_params(dimension_semantics):
-    try:
-        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
-    except TypeError:  # older/newer field name drift — let Mosaic autodetect
-        return pltpu.CompilerParams()
+    from kubeflow_tpu.ops.pallas_compat import tpu_compiler_params
+
+    return tpu_compiler_params(dimension_semantics)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -58,13 +57,17 @@ def default_blocks(sq: int, sk: int) -> tuple[int, int]:
 
 def _out_vma(*xs):
     """Varying-manual-axes annotation for pallas out_shapes: the union of
-    the inputs' vma. Inside a check_vma=True shard_map (e.g. a pipeline
-    stage body) a pallas_call output without vma is rejected; annotating
-    with the inputs' axes makes the kernels legal in any manual region."""
-    vma = frozenset()
-    for x in xs:
-        vma |= getattr(jax.typeof(x), "vma", frozenset())
-    return vma
+    the inputs' vma (None on jax versions without vma tracking); see
+    ops/pallas_compat.collect_vma."""
+    from kubeflow_tpu.ops.pallas_compat import collect_vma
+
+    return collect_vma(*xs)
+
+
+def _sds(shape, dtype, vma):
+    from kubeflow_tpu.ops.pallas_compat import sds_with_vma
+
+    return sds_with_vma(shape, dtype, vma)
 
 
 # ---------------------------------------------------------------------------
@@ -205,9 +208,8 @@ def _fwd(q, k, v, seg_q, seg_k, causal, scale, q_offset, interpret, block_q,
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, n_q, 1, block_q), jnp.float32,
-                                 vma=vma),
+            _sds((bh, sq_p, d), q.dtype, vma),
+            _sds((bh, n_q, 1, block_q), jnp.float32, vma),
         ],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -390,7 +392,7 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec,
                   *seg_specs_dq],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype, vma=vma),
+        out_shape=_sds((bh, sq_p, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
@@ -416,8 +418,8 @@ def _bwd(q, k, v, seg_q, seg_k, o, lse, do, causal, scale, interpret,
         in_specs=[q_spec_kv, kv_spec, kv_spec, q_spec_kv, row_spec_kv,
                   row_spec_kv, *seg_specs_kv],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d), k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct((bh, sk_p, d), v.dtype, vma=vma)],
+        out_shape=[_sds((bh, sk_p, d), k.dtype, vma),
+                   _sds((bh, sk_p, d), v.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),

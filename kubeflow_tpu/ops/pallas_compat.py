@@ -1,0 +1,66 @@
+"""Shared jax-version compatibility probes for the Pallas kernel modules
+(flash_pallas, quant_matmul, flash_decode) — ONE guarded implementation
+instead of three divergent copies, because the failure mode of a stale
+copy is every kernel call dying at trace time.
+
+The repo's floor is "whatever jax the container bakes": the kernels must
+run (interpret OR compiled) on both the 0.4.x line (TPUCompilerParams,
+no jax.typeof/vma) and the current line (CompilerParams, vma-checked
+shard_map regions).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+def tpu_compiler_params(dimension_semantics):
+    """CompilerParams for a pallas_call, or None (pallas_call accepts
+    None) when this jax exposes neither spelling — CompilerParams was
+    TPUCompilerParams before jax 0.5."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:
+        return None
+    try:
+        return cls(dimension_semantics=dimension_semantics)
+    except TypeError:  # field-name drift — let Mosaic autodetect
+        return cls()
+
+
+def collect_vma(*xs):
+    """Union of the inputs' varying-manual-axes, or None on jax versions
+    without vma tracking (no jax.typeof — those versions don't check vma
+    either). Inside a check_vma=True shard_map (e.g. a pipeline stage
+    body) a pallas_call output without vma is rejected; annotating with
+    the inputs' axes makes the kernels legal in any manual region."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    vma = frozenset()
+    for x in xs:
+        vma |= getattr(typeof(x), "vma", frozenset())
+    return vma
+
+
+def sds_with_vma(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the vma annotation when this jax
+    supports one (see collect_vma)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def target_platform() -> str:
+    """The platform kernels would COMPILE for: the active mesh's (it may
+    be a PJRT *topology* — AOT-compiling for v5e from a CPU-pinned
+    process must still pick the kernel path), else the process default
+    backend. The ONE platform probe every kernel-selection policy uses,
+    so the policies cannot diverge on the AOT/mesh scenario."""
+    from kubeflow_tpu.parallel.mesh import get_active_mesh
+
+    mesh = get_active_mesh()
+    if mesh is not None:
+        return mesh.devices.flat[0].platform
+    return jax.default_backend()

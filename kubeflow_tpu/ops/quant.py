@@ -34,21 +34,51 @@ def is_quantized(wt: Any) -> bool:
 
 
 # Fused Pallas dequant-matmul for decode-shaped int8 matmuls (few
-# activation rows against a whole 2D weight) — EXPERIMENTAL, default
-# OFF. Measured on v5e at 8B geometry: +7% on a single-step decode
-# program (the convert+dot lowering's staging recovered), but -17% on
-# the engine's scan-of-steps chunk programs — inside the step scan the
-# custom call defeats XLA's cross-iteration weight prefetch, which is
-# worth more than the staging it saves. Kept opt-in
-# (USE_PALLAS_DEQUANT=True) with interpreter-mode numerics tests; the
-# production decode path stays on the XLA lowering.
+# activation rows against a whole 2D weight). PROMOTED to the default
+# TPU weight-read path in ISSUE 15 (ROADMAP #5: "kernels on by default
+# where they win"), behind the same impl-selection mechanism as the
+# flash-decode kernel: default "pallas" on TPU, "xla" elsewhere, env
+# override KTPU_QUANT_MATMUL=xla|pallas (the fleet kill-switch), and
+# USE_PALLAS_DEQUANT=True as the programmatic force-on the older tests
+# use. The r2 caveat stands in the record: +7% on a single-step decode
+# program but -17% on scan-of-steps chunk programs on THAT jax (the
+# custom call defeated cross-iteration weight prefetch) — which is why
+# every record now carries the serving_kernels A/B (bench.py, schema 9)
+# so the default is re-litigated per hardware record, not folklore.
 USE_PALLAS_DEQUANT: bool = False
+
+#: env override for the quant-matmul impl selection: "pallas" | "xla".
+QUANT_MATMUL_ENV = "KTPU_QUANT_MATMUL"
+
+
+def resolve_quant_matmul_impl() -> str:
+    """"pallas" | "xla" — which lowering decode-shaped int8 matmuls take
+    (the ISSUE 15 selection policy): USE_PALLAS_DEQUANT (programmatic
+    force-on) > KTPU_QUANT_MATMUL env > platform default (pallas on
+    TPU, xla elsewhere). The platform probe is the same mesh-aware
+    `pallas_compat.target_platform` the flash-decode policy uses, so
+    the two kernel defaults can never diverge on the AOT-for-TPU-from-
+    CPU scenario."""
+    import os
+
+    if USE_PALLAS_DEQUANT:
+        return "pallas"
+    env = os.environ.get(QUANT_MATMUL_ENV, "").strip().lower()
+    if env in ("xla", "pallas"):
+        return env
+    try:
+        from kubeflow_tpu.ops.pallas_compat import target_platform
+
+        return "pallas" if target_platform() == "tpu" else "xla"
+    except Exception:
+        return "xla"
 
 
 def _pallas_dequant_wanted(x, q) -> bool:
     from kubeflow_tpu.ops import quant_matmul
 
-    if not (USE_PALLAS_DEQUANT or quant_matmul.FORCE_INTERPRET):
+    if not (quant_matmul.FORCE_INTERPRET
+            or resolve_quant_matmul_impl() == "pallas"):
         return False
     if q.ndim != 2:
         return False
@@ -59,9 +89,12 @@ def _pallas_dequant_wanted(x, q) -> bool:
         return False
     if quant_matmul.FORCE_INTERPRET:
         return True
-    try:   # opted-in on a non-TPU backend: compiled Mosaic can't lower —
-        # fall back silently rather than crash every quantized matmul
-        return jax.devices()[0].platform == "tpu"
+    try:   # selected but the compile TARGET isn't a TPU (explicit env
+        # on a CPU box): compiled Mosaic can't lower — fall back
+        # silently rather than crash every quantized matmul
+        from kubeflow_tpu.ops.pallas_compat import target_platform
+
+        return target_platform() == "tpu"
     except Exception:
         return False
 
@@ -71,9 +104,10 @@ def matmul(x: jax.Array, wt: Any, dtype) -> jax.Array:
     is applied in f32 and the PRODUCT cast to dtype — casting s itself to
     bf16 first would add a systematic per-channel bias on top of the
     quantization error (s is tiny; this costs nothing). Decode-shaped
-    quantized matmuls can OPT IN to the fused Pallas kernel
-    (USE_PALLAS_DEQUANT; ops/quant_matmul.py) — the production default
-    stays on this XLA lowering, per the A/B above."""
+    quantized matmuls route through the fused Pallas kernel
+    (ops/quant_matmul.py) when resolve_quant_matmul_impl() selects it —
+    the TPU default since ISSUE 15; everything else (big prefill rows,
+    ragged blocks, non-TPU) takes this XLA lowering."""
     if is_quantized(wt):
         if _pallas_dequant_wanted(x, wt["q"]):
             from kubeflow_tpu.ops import quant_matmul

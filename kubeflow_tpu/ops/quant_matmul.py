@@ -1,4 +1,6 @@
-"""Pallas (Mosaic) fused int8-dequant matmul — EXPERIMENTAL, opt-in.
+"""Pallas (Mosaic) fused int8-dequant matmul — the default TPU
+weight-read path since ISSUE 15 (env kill-switch KTPU_QUANT_MATMUL=xla;
+see ops/quant.py resolve_quant_matmul_impl for the selection policy).
 
 Decode/verify matmuls are pure bandwidth: a handful of activation rows
 (m = slots × verify-positions, 4..~100) against every int8 weight in the
@@ -10,13 +12,16 @@ accumulates f32 across d-blocks in VMEM scratch, and applies the
 per-output-channel scale on the last block — the weight's HBM footprint
 is its int8 bytes, full stop.
 
-MEASURED OUTCOME (v5e, 8B geometry; why this is opt-in, not the
-default): +7% on a single-step decode program, but -17% on the engine's
-production scan-of-steps chunk programs — inside the step scan the
-custom call blocks XLA's cross-iteration weight prefetch, which turns
-out to be worth more than the staging traffic it saves. quant.matmul
-gates on USE_PALLAS_DEQUANT (or FORCE_INTERPRET in tests); see the
-ops/quant.py comment for the full A/B numbers.
+MEASURED HISTORY (v5e, 8B geometry, r2 jax): +7% on a single-step
+decode program, but -17% on the engine's scan-of-steps chunk programs —
+inside the step scan the custom call blocked XLA's cross-iteration
+weight prefetch. ISSUE 15 promotes the kernel to the TPU default
+anyway, WITH teeth: every bench record carries a serving_kernels A/B on
+the same warmed engine (schema 9), so a regression on the current
+toolchain shows up as a committed per-bucket delta, and
+KTPU_QUANT_MATMUL=xla flips the fleet back without a code push.
+quant.matmul gates on resolve_quant_matmul_impl() (or FORCE_INTERPRET
+in tests); see ops/quant.py for the policy.
 
 Gating (quant.matmul decides): m ≤ MAX_ROWS (decode/verify shapes; big
 prefill batches are compute-bound and XLA's MXU path is fine), block
@@ -108,10 +113,9 @@ def _dequant_matmul_2d(x, q, s, *, out_dtype, interpret=False):
 
 
 def _compiler_params(dimension_semantics):
-    try:
-        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
-    except TypeError:   # field-name drift across jax versions
-        return pltpu.CompilerParams()
+    from kubeflow_tpu.ops.pallas_compat import tpu_compiler_params
+
+    return tpu_compiler_params(dimension_semantics)
 
 
 def dequant_matmul(x: jax.Array, q: jax.Array, s: jax.Array,

@@ -169,6 +169,7 @@ class LLMEngine:
                  quantize: str | None = None,
                  warm_cont_pairs: int | None = 4,
                  kv_quantize: str | None = None,
+                 decode_attention_impl: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
                  spec_adaptive: bool = True,
@@ -255,6 +256,41 @@ class LLMEngine:
         # top_p_micro, presence_milli, freq_milli, seed] and, under
         # multi-adapter serving, an adapter-id column
         self._row_extra = 9 if adapters else 8
+        # -- decode-attention impl (ISSUE 15): "xla" einsum vs the fused
+        # Pallas "flash" kernel over the KV slab (ops/flash_decode.py) —
+        # a convenience override of cfg.decode_attention_impl, so bench
+        # A/B pairs and runtime configs need not rebuild the LlamaConfig.
+        # Static per engine: warmup compiles exactly the selected impl's
+        # menu (an A/B bench builds TWO engines — the menu never carries
+        # both impls for live traffic).
+        if decode_attention_impl is not None:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, decode_attention_impl=decode_attention_impl)
+        if mesh is not None and cfg.decode_attention_impl == "auto":
+            # GSPMD tensor-parallel serving: a pallas custom call has no
+            # SPMD partitioning rule, so "auto" must not hand the
+            # sharded-cache programs to the kernel (XLA would replicate
+            # the cache it exists to stream). The einsum path keeps the
+            # mesh layout; kernel+collective overlap for tp layouts is
+            # ROADMAP #5's remaining half. An EXPLICIT "flash" is
+            # honored — the operator owns the layout claim.
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, decode_attention_impl="xla")
+        if cfg.decode_attention_impl == "auto":
+            # PIN the resolved impl at construction: a program compiled
+            # lazily after warmup (cold span/chunk combos) re-traces
+            # verify_inner, and an env flip or active-mesh context at
+            # THAT moment must not hand one engine a mixed-impl menu —
+            # nor let metrics()/healthz report an impl the warmed
+            # programs don't run.
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg,
+                decode_attention_impl=llama.resolve_decode_attn(cfg))
         # int8 KV cache: decode re-reads the whole (span of the) cache
         # every step, so int8 storage halves that HBM traffic vs bf16 and
         # halves cache residency (2x slots or context at 8B scale);
@@ -1989,6 +2025,10 @@ class LLMEngine:
                "completed": s.completed, "rejected": s.rejected,
                "cancelled": self._cancelled_count,
                "decode_chunk": self.decode_chunk,
+               # the RESOLVED decode-attention impl (the A/B bench and
+               # /healthz read this, so a record can never misreport
+               # which kernel path produced its numbers)
+               "decode_attention_impl": llama.resolve_decode_attn(self.cfg),
                "mesh": self.mesh_info()}
         out["prefill_tokens_computed"] = self._prefill_computed_tokens
         if self.prefix_cache_enabled and self.kvcache is not None:

@@ -55,6 +55,7 @@ class LLMModel(Model):
                  decode_chunk: int = 8,
                  quantize: str | None = None,
                  kv_quantize: str | None = None,
+                 decode_attention_impl: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
                  spec_adaptive: bool = True,
@@ -94,6 +95,16 @@ class LLMModel(Model):
         self._decode_chunk = decode_chunk
         self._quantize = quantize
         self._kv_quantize = kv_quantize
+        # config.decode_attention_impl (ISSUE 15): "xla" | "flash" |
+        # "auto" — the serving decode/verify attention kernel selection.
+        # It is a LlamaConfig field, so `model: {decode_attention_impl:
+        # ...}` works too; this top-level key is the ergonomic spelling
+        # (and wins over the model dict when both are given). "auto"
+        # (the default) resolves flash on TPU / xla elsewhere, with the
+        # KTPU_DECODE_ATTN env as the fleet kill-switch.
+        if decode_attention_impl is not None:
+            self._cfg_overrides["decode_attention_impl"] = \
+                decode_attention_impl
         self._speculative = speculative
         self._spec_ngram = spec_ngram
         # config.spec_adaptive (default on): per-slot EMA acceptance

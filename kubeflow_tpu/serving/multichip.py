@@ -90,6 +90,16 @@ class StageShardedEngine(LLMEngine):
                 f"n_kv_heads={cfg.n_kv_heads} must divide by the tensor "
                 f"axis ({tensor}) to shard the per-stage KV slabs")
         n_slots = int(kw.get("n_slots", 4))
+        if tensor > 1 and cfg.decode_attention_impl == "auto":
+            # per-stage programs with tensor > 1 are GSPMD-sharded over
+            # the stage sub-mesh — same reason the base engine's mesh
+            # path pins "auto" to the einsum: a pallas custom call has
+            # no SPMD partitioning rule yet (ROADMAP #5's remaining
+            # half). tensor == 1 stages run whole on one device and
+            # take the kernel like the single-program engine.
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, decode_attention_impl="xla")
         # geometry + placement first: _alloc_cache/_put run inside the
         # base __init__ and need the plan
         self._plan = InferenceStagePlan(cfg.n_layers, stage, n_slots,

@@ -125,7 +125,22 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
       attention_kv_update  — the rest of the sampling-stripped forward:
                              attention over the KV span, cache update,
                              norms/activations (nosample-variant time
-                             minus the weight read);
+                             minus the weight read); sub-attributed by
+                             two probe programs (ISSUE 15):
+                             `attn_kernel` — the selected decode-
+                             attention impl (xla einsum or the Pallas
+                             flash-decode kernel) once per layer over
+                             the live span at S_v=1, and `attn_dequant`
+                             — reading + dequantizing the same int8
+                             span and nothing else (0.0 on unquantized
+                             caches; both None when the cache isn't a
+                             single-program slab or is mesh-sharded).
+                             Probes, not a partition: the bucket also
+                             carries cache writes + MLP — but the
+                             xla-vs-flash A/B delta lands in
+                             attn_kernel while every other bucket
+                             stays put, which is what makes the
+                             serving_kernels record explainable;
       sampling_penalties   — full program minus the sampling-stripped
                              variant (_decode(sample=False));
       dispatch_rtt         — a trivial-program host->device->host round
@@ -295,6 +310,83 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         kv_handoff_ms = round(
             max(_median_time(run_handoff, iters) - t_rtt, 0.0) * 1e3, 4)
 
+    # attn_kernel / attn_dequant sub-attribution (ISSUE 15 satellite):
+    # the attention+KV bucket is a differential (nosample forward minus
+    # weight read) — it cannot say what the ATTENTION itself costs vs
+    # the int8 dequant riding it, which is exactly the split an
+    # xla-vs-flash A/B needs to be explainable per bucket.
+    attn_kernel_ms = None
+    attn_dequant_ms = None
+    cfg = getattr(engine, "cfg", None)
+    cache_obj = getattr(engine, "cache", None)
+    if (cfg is not None and getattr(engine, "mesh", None) is None
+            and isinstance(cache_obj, dict) and "k" in cache_obj):
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import llama as _llama
+
+        quantized = "k_s" in cache_obj
+        n_layers = int(cache_obj["k"].shape[0])
+        q_probe = jax.random.normal(
+            jax.random.key(7),
+            (n_slots, 1, cfg.n_heads, cfg.head_dim)).astype(cfg.dtype)
+
+        def _layer_span(cache, name, li):
+            rows_all = jax.lax.dynamic_index_in_dim(
+                cache[name], li, axis=0, keepdims=False)
+            return jax.lax.slice_in_dim(rows_all, 0, span, axis=1)
+
+        @jax.jit
+        def attn_probe(cache, lengths):
+            positions = lengths[:, None]   # S_v=1: one decode step
+
+            def body(acc, li):
+                out = _llama.decode_attention(
+                    cfg, q_probe,
+                    _layer_span(cache, "k", li),
+                    _layer_span(cache, "v", li),
+                    _layer_span(cache, "k_s", li) if quantized else None,
+                    _layer_span(cache, "v_s", li) if quantized else None,
+                    positions)
+                return acc + jnp.sum(out.astype(jnp.float32)), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                  jnp.arange(n_layers))
+            return acc
+
+        def run_attn():
+            float(np.asarray(attn_probe(engine.cache, engine.lengths)))
+
+        run_attn()   # compile + fault pages, untimed
+        attn_kernel_ms = round(
+            max(_median_time(run_attn, iters) - t_rtt, 0.0) * 1e3, 4)
+        if quantized:
+            @jax.jit
+            def dequant_probe(cache):
+                def body(acc, li):
+                    k = _llama.dequantize_kv(
+                        _layer_span(cache, "k", li),
+                        _layer_span(cache, "k_s", li), cfg.dtype)
+                    v = _llama.dequantize_kv(
+                        _layer_span(cache, "v", li),
+                        _layer_span(cache, "v_s", li), cfg.dtype)
+                    return acc + (jnp.sum(k.astype(jnp.float32))
+                                  + jnp.sum(v.astype(jnp.float32))), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                      jnp.arange(n_layers))
+                return acc
+
+            def run_dequant():
+                float(np.asarray(dequant_probe(engine.cache)))
+
+            run_dequant()   # compile + fault pages, untimed
+            attn_dequant_ms = round(
+                max(_median_time(run_dequant, iters) - t_rtt, 0.0) * 1e3,
+                4)
+        else:
+            attn_dequant_ms = 0.0   # nothing to dequantize, by definition
+
     per_step = 1e3 / steps
     dev_full_ms = max(t_full - t_rtt, 0.0) * per_step
     dev_nosample_ms = max(t_nosample - t_rtt, 0.0) * per_step
@@ -322,6 +414,11 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         "buckets_ms": {
             "weight_read": round(weight_read_ms, 4),
             "attention_kv_update": round(attn_kv_ms, 4),
+            # probe-based sub-attribution of attention_kv_update (the
+            # xla-vs-flash A/B lever vs the int8 read+convert tax); not
+            # part of the bucket partition
+            "attn_kernel": attn_kernel_ms,
+            "attn_dequant": attn_dequant_ms,
             "sampling_penalties": round(sampling_ms, 4),
             "dispatch_rtt_per_step": round(t_rtt * per_step, 4),
             "host_fetch_replay_per_step": host_ms,

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Kernel-path lint (ISSUE 15, CI satellite): an untestable-on-CPU
+Pallas kernel must never land. With the kernel path ON BY DEFAULT on
+TPU (flash-decode attention, fused dequant matmul), the only thing
+standing between a kernel edit and silent production corruption is the
+interpret-mode differential gauntlet — so its preconditions are
+enforced statically, the check_dataplane.py pattern:
+
+Rules (AST + text, no imports of the checked code), applied to every
+module under `kubeflow_tpu/ops/` that calls `pallas_call`:
+
+1. Every `pallas_call` call site passes an `interpret=` keyword — a
+   kernel hard-wired to compiled Mosaic cannot run its byte-level
+   differential tests in the CPU fast lane.
+2. The module defines `FORCE_INTERPRET` — the seam the tests flip to
+   route numerics through the interpreter (the ops/flash_pallas.py
+   convention every kernel here follows).
+3. The module is referenced by name from at least one `tests/test_*.py`
+   — a kernel no parity test imports is, by construction, untested.
+
+Run: `python scripts/check_kernels.py` — exit 0 clean, 1 with findings
+(one per line). The fast lane runs it via tests/test_dataplane_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OPS = os.path.join(REPO, "kubeflow_tpu", "ops")
+TESTS = os.path.join(REPO, "tests")
+
+
+class _PallasCallVisitor(ast.NodeVisitor):
+    """Collect pallas_call call sites and whether each passes
+    interpret=."""
+
+    def __init__(self):
+        self.calls: list[tuple[int, bool]] = []
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name == "pallas_call":
+            has_interpret = any(kw.arg == "interpret"
+                                for kw in node.keywords)
+            self.calls.append((node.lineno, has_interpret))
+        self.generic_visit(node)
+
+
+def _test_references(tests_root: str) -> str:
+    """Concatenated source of every tests/test_*.py (module-name
+    reference check is textual: any import or attribute spelling
+    counts)."""
+    chunks = []
+    if os.path.isdir(tests_root):
+        for fn in sorted(os.listdir(tests_root)):
+            if fn.startswith("test_") and fn.endswith(".py"):
+                with open(os.path.join(tests_root, fn),
+                          encoding="utf-8") as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def check(ops_root: str = OPS, tests_root: str = TESTS) -> list[str]:
+    findings: list[str] = []
+    test_src = _test_references(tests_root)
+    for fn in sorted(os.listdir(ops_root)):
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(ops_root, fn)
+        rel = os.path.relpath(path, os.path.dirname(
+            os.path.dirname(ops_root)))
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if "pallas_call" not in src:
+            continue
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(f"{rel}: unparseable ({e})")
+            continue
+        v = _PallasCallVisitor()
+        v.visit(tree)
+        for lineno, has_interpret in v.calls:
+            if not has_interpret:
+                findings.append(
+                    f"{rel}:{lineno}: pallas_call without an interpret= "
+                    "keyword — the kernel cannot run its differential "
+                    "tests on the CPU fast lane (thread an `interpret` "
+                    "argument through, the ops/flash_pallas.py pattern)")
+        if v.calls and "FORCE_INTERPRET" not in src:
+            findings.append(
+                f"{rel}: kernel module without a FORCE_INTERPRET seam — "
+                "tests cannot route its numerics through the Pallas "
+                "interpreter")
+        module = fn[:-3]
+        if v.calls and module not in test_src:
+            findings.append(
+                f"{rel}: kernel module not referenced by any "
+                "tests/test_*.py — land it WITH its interpret-mode "
+                "parity test")
+    return findings
+
+
+def main() -> int:
+    findings = check()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"check_kernels: {len(findings)} finding(s)")
+        return 1
+    print("check_kernels: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

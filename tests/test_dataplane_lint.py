@@ -176,3 +176,94 @@ def test_lint_flags_stage_engine_aware_frontend(tmp_path):
     findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
                           repo_root=str(tmp_path))
     assert any("StageShardedEngine" in f for f in findings)
+
+
+# -- kernel-path lint (ISSUE 15 satellite: scripts/check_kernels.py) ----------
+# An untestable-on-CPU Pallas kernel must never land: every ops module
+# calling pallas_call must pass interpret= at each call site, expose the
+# FORCE_INTERPRET seam, and be referenced from a parity test.
+
+
+def _load_kernel_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_kernels", os.path.join(REPO, "scripts",
+                                      "check_kernels.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_kernels_are_clean():
+    lint = _load_kernel_lint()
+    findings = lint.check()
+    assert findings == [], "\n".join(findings)
+
+
+def test_kernel_lint_runs_as_a_script():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_kernels.py")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "check_kernels: ok" in out.stdout
+
+
+def _kernel_tree(tmp_path, src, test_src=""):
+    ops = tmp_path / "kubeflow_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "rogue_kernel.py").write_text(src)
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_rogue.py").write_text(test_src)
+    return str(ops), str(tests)
+
+
+def test_kernel_lint_flags_pallas_call_without_interpret(tmp_path):
+    lint = _load_kernel_lint()
+    ops, tests = _kernel_tree(
+        tmp_path,
+        "from jax.experimental import pallas as pl\n"
+        "FORCE_INTERPRET = False\n"
+        "def op(x):\n"
+        "    return pl.pallas_call(lambda i, o: None, out_shape=x)(x)\n",
+        "from kubeflow_tpu.ops import rogue_kernel\n")
+    findings = lint.check(ops_root=ops, tests_root=tests)
+    assert len(findings) == 1
+    assert "without an interpret=" in findings[0]
+    assert "rogue_kernel.py:4" in findings[0]
+
+
+def test_kernel_lint_flags_missing_force_interpret_seam(tmp_path):
+    lint = _load_kernel_lint()
+    ops, tests = _kernel_tree(
+        tmp_path,
+        "from jax.experimental import pallas as pl\n"
+        "def op(x, interpret=False):\n"
+        "    return pl.pallas_call(lambda i, o: None, out_shape=x,\n"
+        "                          interpret=interpret)(x)\n",
+        "from kubeflow_tpu.ops import rogue_kernel\n")
+    findings = lint.check(ops_root=ops, tests_root=tests)
+    assert len(findings) == 1
+    assert "FORCE_INTERPRET" in findings[0]
+
+
+def test_kernel_lint_flags_untested_kernel_module(tmp_path):
+    lint = _load_kernel_lint()
+    ops, tests = _kernel_tree(
+        tmp_path,
+        "from jax.experimental import pallas as pl\n"
+        "FORCE_INTERPRET = False\n"
+        "def op(x, interpret=False):\n"
+        "    return pl.pallas_call(lambda i, o: None, out_shape=x,\n"
+        "                          interpret=interpret)(x)\n",
+        "# no reference to the kernel module here\n")
+    findings = lint.check(ops_root=ops, tests_root=tests)
+    assert len(findings) == 1
+    assert "not referenced" in findings[0]
+
+
+def test_kernel_lint_ignores_pallas_free_modules(tmp_path):
+    lint = _load_kernel_lint()
+    ops, tests = _kernel_tree(
+        tmp_path, "def op(x):\n    return x\n")
+    assert lint.check(ops_root=ops, tests_root=tests) == []

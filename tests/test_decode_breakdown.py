@@ -52,6 +52,37 @@ def test_breakdown_buckets_account_for_the_device_step(engine):
     assert engine.generate([1, 2, 3], 8) == baseline
 
 
+def test_breakdown_attn_subattribution_unquantized(engine):
+    """attn_kernel/attn_dequant (ISSUE 15 satellite) sub-attribute the
+    attention+KV bucket: the attention probe runs the selected impl
+    over the live span, and an UNQUANTIZED cache's dequant cost is 0.0
+    by definition (None is reserved for engines whose cache isn't a
+    probe-able single-program slab)."""
+    bd = serving_decode_breakdown(engine, steps=1, iters=2)
+    b = bd["buckets_ms"]
+    assert "attn_kernel" in b and "attn_dequant" in b
+    assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
+    assert b["attn_dequant"] == 0.0
+    # sub-attribution never perturbs the bucket PARTITION contract
+    device_sum = (b["weight_read"] + b["attention_kv_update"]
+                  + b["sampling_penalties"])
+    assert device_sum == pytest.approx(bd["device_step_ms"], rel=0.02)
+
+
+def test_breakdown_attn_dequant_measured_on_int8_cache():
+    """An int8 KV engine gets a real (>= 0, not-None) dequant
+    sub-bucket — the read+convert tax the fused kernel folds into its
+    block loads."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    eng = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8,),
+                    decode_chunk=2, kv_quantize="int8")
+    bd = serving_decode_breakdown(eng, steps=1, iters=2)
+    b = bd["buckets_ms"]
+    assert b["attn_dequant"] is not None and b["attn_dequant"] >= 0
+    assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
+
+
 def test_breakdown_records_analytic_floor_when_bandwidth_given(engine):
     bd = serving_decode_breakdown(engine, steps=1, iters=2, hbm_gbps=100.0)
     assert bd["weight_read_floor_ms"] > 0

@@ -415,3 +415,38 @@ def test_prefill_crash_replays_and_stays_byte_identical(ref_engine, disagg):
     acc = disagg.accounting()
     assert acc["lost"] == 0
     assert acc["decode"]["restarts"] == 0   # the decode role never died
+
+
+@pytest.mark.slow
+def test_parity_with_flash_decode_impl(params):
+    """ISSUE 15 acceptance: the disagg role engines inherit the
+    decode-attention impl through the shared layer bodies — with
+    `decode_attention_impl: flash` (interpret mode on CPU, int8 blocks
+    through the serialized transport) the prefill→handoff→decode
+    pipeline stays byte-identical to the colocated FLASH engine, greedy
+    and seeded, including the chunked probe."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, decode_attention_impl="flash")
+
+    def prefill_engine_factory():
+        return PrefillEngine(params, cfg, kv_quantize="int8", **ENG_KW)
+
+    def decode_engine_factory():
+        return DecodeEngine(params, cfg, kv_quantize="int8", **ENG_KW)
+
+    co = DisaggregatedEngine(EngineSupervisor(prefill_engine_factory),
+                             EngineSupervisor(decode_engine_factory),
+                             handoff="serialized")
+    ref = LLMEngine(params, cfg, prefix_cache=True, kv_quantize="int8",
+                    **ENG_KW)
+    try:
+        for p in PROBES:
+            assert co.generate(p, 10) == ref.generate(p, 10), p
+        want = ref.generate(PROBES[1], 10, temperature=0.9, seed=42)
+        got = co.generate(PROBES[1], 10, temperature=0.9, seed=42)
+        assert got == want
+        assert co.metrics()["decode_attention_impl"] == "flash"
+    finally:
+        co.close()
+        ref.close()

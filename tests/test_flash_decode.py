@@ -1,0 +1,358 @@
+"""Differential gauntlet for the Pallas flash-decode kernel (ISSUE 15,
+ops/flash_decode.py) — the kernel runs via the interpreter on the CPU
+mesh (FORCE_INTERPRET, the flash_pallas/quant_matmul pattern), so every
+claim here is byte-level testable without hardware:
+
+- op level: kernel-vs-einsum parity across GQA ratios (1:1, 4:1, 8:1),
+  int8 + f32 KV, span edge cases (span=1, span=max_len, ragged spans
+  across slots), and S_v ∈ {1, 4} verify windows — all against
+  llama.decode_attention's XLA reference on identical inputs;
+- selection policy: explicit config > KTPU_DECODE_ATTN env > platform
+  default (xla on this CPU box);
+- engine level: a full warmed xla-vs-flash engine pair (int8 KV, f32
+  model) produces byte-identical greedy AND seeded outputs — the
+  fast-lane core at toy dims; heavy combos (prefix-cache + chunked
+  prompts, speculative verify, bf16) ride the slow lane. The committed
+  A/B with per-bucket attribution is bench.py serving_kernels.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.ops import flash_decode
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    flash_decode.FORCE_INTERPRET = True
+    yield
+    flash_decode.FORCE_INTERPRET = False
+
+
+def _cfg(nh, nkv, hd, dtype=jnp.float32):
+    return llama.LlamaConfig(vocab_size=64, d_model=nh * hd, n_layers=1,
+                             n_heads=nh, n_kv_heads=nkv, d_ff=32,
+                             max_seq_len=512, dtype=dtype)
+
+
+def _inputs(nh, nkv, s_v, t, hd, quantized, lengths, *, seed=0,
+            dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    b = len(lengths)
+    q = jnp.asarray(rng.normal(size=(b, s_v, nh, hd)), dtype)
+    kf = jnp.asarray(rng.normal(size=(b, t, nkv, hd)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(b, t, nkv, hd)), jnp.float32)
+    if quantized:
+        kq, ks = llama.quantize_kv(kf)
+        vq, vs = llama.quantize_kv(vf)
+        return q, kq, vq, ks, vs
+    return q, kf.astype(dtype), vf.astype(dtype), None, None
+
+
+def _both(cfg, q, ck, cv, cks, cvs, lengths):
+    s_v = q.shape[1]
+    positions = jnp.asarray(lengths, jnp.int32)[:, None] \
+        + jnp.arange(s_v)[None]
+    want = llama.decode_attention(cfg, q, ck, cv, cks, cvs, positions,
+                                  impl="xla")
+    got = llama.decode_attention(cfg, q, ck, cv, cks, cvs, positions,
+                                 impl="flash")
+    return np.asarray(want, np.float32), np.asarray(got, np.float32)
+
+
+# GQA 1:1 / 4:1 / 8:1 × {f32, int8} KV × S_v ∈ {1, 4} × span shapes:
+# span=1 (a single cached token), span=max_len (lengths reach the last
+# row), a multi-block span that pads (300 % 128 != 0), and an exact
+# block multiple — every case with RAGGED lengths across slots.
+CASES = [
+    # nh, nkv, s_v,   t, quantized
+    (4,    4,   1,  40, False),
+    (8,    2,   1,  40, False),
+    (8,    1,   1,  40, False),
+    (8,    2,   4,  40, False),
+    (8,    2,   1,   1, False),
+    (8,    2,   4,   1, True),
+    (4,    4,   1,  40, True),
+    (8,    1,   4,  40, True),
+    (8,    2,   1, 300, True),
+    (8,    2,   4, 256, True),
+]
+
+
+@pytest.mark.parametrize("nh,nkv,s_v,t,quantized", CASES)
+def test_kernel_matches_einsum(nh, nkv, s_v, t, quantized):
+    hd = 16
+    cfg = _cfg(nh, nkv, hd)
+    rng = np.random.default_rng(1)
+    # ragged spans across slots, INCLUDING the span=max_len edge: one
+    # slot pinned at t-1 (its S_v window reads the whole span), one at 0
+    lengths = rng.integers(0, t, size=(3,))
+    lengths[0], lengths[-1] = t - 1, 0
+    q, ck, cv, cks, cvs = _inputs(nh, nkv, s_v, t, hd, quantized, lengths)
+    want, got = _both(cfg, q, ck, cv, cks, cvs, lengths)
+    assert got.shape == want.shape
+    err = float(np.max(np.abs(got - want)))
+    scale = float(np.max(np.abs(want))) or 1.0
+    assert err / scale < 1e-5, (nh, nkv, s_v, t, quantized, err, scale)
+
+
+def test_kernel_bf16_close_to_einsum():
+    """bf16 compute (the production model dtype): accumulation order
+    differs across the impls, so the bound is bf16-ulp-scale, not
+    exact — the byte-exactness claim lives at the ENGINE level where
+    argmax/sampling consume the logits."""
+    cfg = _cfg(8, 2, 16, dtype=jnp.bfloat16)
+    lengths = [17, 3, 39]
+    q, ck, cv, cks, cvs = _inputs(8, 2, 2, 40, 16, True, lengths,
+                                  dtype=jnp.bfloat16)
+    want, got = _both(cfg, q, ck, cv, cks, cvs, lengths)
+    assert float(np.max(np.abs(got - want))) < 0.05
+
+
+def test_rows_mask_independent_slots():
+    """Slot i's output must depend only on slot i's span: perturbing KV
+    rows BEYOND a slot's visible window (k_pos > lengths + S_v - 1)
+    changes nothing — the in-kernel mask, not the caller, enforces it."""
+    cfg = _cfg(8, 2, 16)
+    lengths = [5, 20, 11]
+    q, ck, cv, cks, cvs = _inputs(8, 2, 1, 40, 16, False, lengths)
+    _, base = _both(cfg, q, ck, cv, cks, cvs, lengths)
+    ck2 = ck.at[0, 10:].set(99.0)   # beyond slot 0's window (5)
+    cv2 = cv.at[0, 10:].set(-99.0)
+    _, got = _both(cfg, q, ck2, cv2, cks, cvs, lengths)
+    np.testing.assert_allclose(got[0], base[0], rtol=0, atol=0)
+    # positive control: the same rows INSIDE slot 1's window (20) must
+    # change slot 1's output — the mask is per-slot, not global
+    ck3 = ck.at[1, 10:].set(99.0)
+    _, got3 = _both(cfg, q, ck3, cv, cks, cvs, lengths)
+    assert np.any(got3[1] != base[1])
+
+
+def test_selection_policy(monkeypatch):
+    monkeypatch.delenv(flash_decode.IMPL_ENV, raising=False)
+    # auto on this CPU box resolves xla
+    assert flash_decode.resolve_impl("auto") == "xla"
+    # env overrides the platform default...
+    monkeypatch.setenv(flash_decode.IMPL_ENV, "flash")
+    assert flash_decode.resolve_impl("auto") == "flash"
+    # ...but an explicit config wins over the env (bench A/B pins impls)
+    assert flash_decode.resolve_impl("xla") == "xla"
+    assert flash_decode.resolve_impl("flash") == "flash"
+    monkeypatch.setenv(flash_decode.IMPL_ENV, "xla")
+    assert flash_decode.resolve_impl("flash") == "flash"
+    with pytest.raises(ValueError):
+        llama.LlamaConfig.tiny().__class__(
+            **{**dataclasses.asdict(llama.LlamaConfig.tiny()),
+               "decode_attention_impl": "mosaic"})
+
+
+def test_quant_matmul_selection_policy(monkeypatch):
+    """The promoted weight-read path follows the same shape of policy:
+    force-on flag > KTPU_QUANT_MATMUL env > platform default (xla on
+    this CPU box)."""
+    from kubeflow_tpu.ops import quant
+
+    monkeypatch.delenv(quant.QUANT_MATMUL_ENV, raising=False)
+    assert quant.resolve_quant_matmul_impl() == "xla"   # CPU default
+    monkeypatch.setenv(quant.QUANT_MATMUL_ENV, "pallas")
+    assert quant.resolve_quant_matmul_impl() == "pallas"
+    monkeypatch.setenv(quant.QUANT_MATMUL_ENV, "xla")
+    monkeypatch.setattr(quant, "USE_PALLAS_DEQUANT", True)
+    assert quant.resolve_quant_matmul_impl() == "pallas"
+
+
+# -- engine level -------------------------------------------------------------
+
+ENG_KW = dict(n_slots=2, max_len=48, buckets=(8,), decode_chunk=2)
+
+
+@pytest.fixture(scope="module")
+def engine_pair():
+    """One warmed xla/flash engine pair at toy dims (f32 model — byte
+    comparison must not be an accumulation-order coin flip — with int8
+    KV, half the kernel's contract). Module-scoped: every fast-lane
+    engine test shares the ~15s of compiles."""
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                              dtype=jnp.float32)
+    params = llama.init(jax.random.key(0), cfg)
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    ex = LLMEngine(params, cfg, decode_attention_impl="xla",
+                   kv_quantize="int8", **ENG_KW)
+    ef = LLMEngine(params, cfg, decode_attention_impl="flash",
+                   kv_quantize="int8", **ENG_KW)
+    ex.warmup()
+    ef.warmup()
+    yield ex, ef
+    ex.close()
+    ef.close()
+
+
+def test_engine_reports_resolved_impl(engine_pair):
+    ex, ef = engine_pair
+    assert ex.metrics()["decode_attention_impl"] == "xla"
+    assert ef.metrics()["decode_attention_impl"] == "flash"
+
+
+def test_engine_greedy_byte_parity(engine_pair):
+    ex, ef = engine_pair
+    for p in ([1, 2, 3], [5, 6, 7, 8, 9, 10, 11], [40, 2]):
+        want = ex.generate(list(p), 10)
+        got = ef.generate(list(p), 10)
+        assert got == want, (p, got, want)
+
+
+def test_engine_seeded_byte_parity(engine_pair):
+    ex, ef = engine_pair
+    for seed in (7, 12345):
+        for p in ([3, 1, 4, 1, 5], [9, 9, 9]):
+            want = ex.generate(list(p), 8, temperature=0.9, seed=seed)
+            got = ef.generate(list(p), 8, temperature=0.9, seed=seed)
+            assert got == want, (p, seed, got, want)
+
+
+def test_engine_penalized_greedy_parity(engine_pair):
+    """Penalty edits run AFTER the attention produces logits — the
+    kernel must not perturb the penalized sampling pipeline either."""
+    ex, ef = engine_pair
+    p = [2, 4, 6, 8]
+    want = ex.generate(list(p), 8, presence_penalty=0.7,
+                       frequency_penalty=0.3)
+    got = ef.generate(list(p), 8, presence_penalty=0.7,
+                      frequency_penalty=0.3)
+    assert got == want
+
+
+@pytest.mark.slow
+def test_engine_prefix_cache_and_chunked_parity():
+    """The heavy engine gauntlet: prefix-cache hits (radix admission →
+    continuation programs) and chunked long prompts through a flash
+    engine match the xla engine byte-for-byte, greedy and seeded — the
+    in-engine twin of bench.py serving_kernels' committed parity."""
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                              dtype=jnp.float32)
+    params = llama.init(jax.random.key(0), cfg)
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    kw = dict(n_slots=2, max_len=96, buckets=(8, 16, 32),
+              decode_chunk=4, kv_quantize="int8", prefix_cache=True)
+    ex = LLMEngine(params, cfg, decode_attention_impl="xla", **kw)
+    ef = LLMEngine(params, cfg, decode_attention_impl="flash", **kw)
+    try:
+        ex.warmup()
+        ef.warmup()
+        shared = list(range(1, 18))           # 2 radix blocks
+        long = shared + list(range(300, 335))  # 52 tokens > bucket 32
+        for p in (shared + [99, 100], shared + [7], long):
+            want = ex.generate(list(p), 8)
+            got = ef.generate(list(p), 8)
+            assert got == want, p
+        assert ef.metrics()["prefix_hits"] >= 1   # the hit path ran
+        want = ex.generate(shared + [55], 8, temperature=0.8, seed=42)
+        got = ef.generate(shared + [55], 8, temperature=0.8, seed=42)
+        assert got == want
+    finally:
+        ex.close()
+        ef.close()
+
+
+@pytest.mark.slow
+def test_engine_speculative_verify_parity():
+    """Speculative decoding dispatches verify windows (S_v = k+1 > 1)
+    through the SAME attention body — a flash spec engine must match
+    the xla spec engine (and, by the engine invariant, plain greedy)
+    byte-for-byte."""
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                              dtype=jnp.float32)
+    params = llama.init(jax.random.key(0), cfg)
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    kw = dict(n_slots=2, max_len=96, buckets=(16,), decode_chunk=4,
+              kv_quantize="int8", speculative=3)
+    sx = LLMEngine(params, cfg, decode_attention_impl="xla", **kw)
+    sf = LLMEngine(params, cfg, decode_attention_impl="flash", **kw)
+    try:
+        sx.warmup()
+        sf.warmup()
+        for p in ([1, 2, 3, 1, 2, 3, 1], list(range(5, 17))):
+            want = sx.generate(list(p), 10)
+            got = sf.generate(list(p), 10)
+            assert got == want, p
+    finally:
+        sx.close()
+        sf.close()
+
+
+@pytest.mark.slow
+def test_engine_bf16_greedy_parity():
+    """The production dtype: greedy argmax over bf16 logits survives
+    the kernel's (mathematically equal, differently-ordered) softmax at
+    toy dims — the claim the TPU record rides on."""
+    cfg = llama.LlamaConfig.tiny()   # bf16 default
+    params = llama.init(jax.random.key(0), cfg)
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    ex = LLMEngine(params, cfg, decode_attention_impl="xla", **ENG_KW)
+    ef = LLMEngine(params, cfg, decode_attention_impl="flash", **ENG_KW)
+    try:
+        ex.warmup()
+        ef.warmup()
+        for p in ([1, 2, 3], [11, 12, 13, 14]):
+            assert ex.generate(list(p), 8) == ef.generate(list(p), 8), p
+    finally:
+        ex.close()
+        ef.close()
+
+
+def test_auto_pins_to_xla_under_gspmd_sharding():
+    """Under GSPMD sharding "auto" must pin to the einsum path — a
+    pallas custom call has no SPMD partitioning rule, so the kernel
+    would make XLA replicate the sharded cache. Explicit "flash" is
+    honored (the operator owns the layout claim)."""
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.serving.llm import LLMEngine
+    from kubeflow_tpu.serving.multichip import StageShardedEngine
+
+    cfg = llama.LlamaConfig.tiny()          # decode_attention_impl=auto
+    params = llama.init(jax.random.key(0), cfg)
+    eng = LLMEngine(params, cfg, mesh=MeshConfig(tensor=2), **ENG_KW)
+    assert eng.cfg.decode_attention_impl == "xla"
+    eng.close()
+    eng = LLMEngine(params, cfg, mesh=MeshConfig(tensor=2),
+                    decode_attention_impl="flash", **ENG_KW)
+    assert eng.cfg.decode_attention_impl == "flash"
+    eng.close()
+    eng = StageShardedEngine(params, cfg, stage=2, tensor=2, **ENG_KW)
+    assert eng.cfg.decode_attention_impl == "xla"
+    eng.close()
+    # tensor=1 stages run whole per device: "auto" follows the platform
+    # default exactly like the single-program engine — and is PINNED at
+    # construction (this CPU box resolves xla), so a later env flip can
+    # never hand an engine a mixed-impl program menu
+    eng = StageShardedEngine(params, cfg, stage=2, **ENG_KW)
+    assert eng.cfg.decode_attention_impl == "xla"
+    eng.close()
+    eng = LLMEngine(params, cfg, **ENG_KW)   # no mesh: same pin
+    assert eng.cfg.decode_attention_impl == "xla"
+    eng.close()
+
+
+def test_breakdown_attn_subbuckets_on_flash_engine(engine_pair):
+    """serving_decode_breakdown's attn_kernel/attn_dequant probes run
+    the SELECTED impl — on the flash engine the probe exercises the
+    kernel, and the int8 cache yields a real dequant sub-bucket."""
+    from kubeflow_tpu.training.profiling import serving_decode_breakdown
+
+    _, ef = engine_pair
+    bd = serving_decode_breakdown(ef, steps=1, iters=2)
+    b = bd["buckets_ms"]
+    assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
+    assert b["attn_dequant"] is not None and b["attn_dequant"] >= 0
+    # profiling leaves the engine serviceable (warmup-style reset)
+    assert len(ef.generate([1, 2, 3], 4)) == 4

@@ -339,3 +339,37 @@ def test_runtime_parallel_config_validation():
         LLMModel("m", parallel={"tensor": 2}, mesh={"data": 2})
     with pytest.raises(ValueError, match=">= 1"):
         LLMModel("m", parallel={"stage": 0})
+
+
+@pytest.mark.slow
+def test_stage_sharded_parity_with_flash_decode_impl(params):
+    """ISSUE 15 acceptance: the stage-sharded engine inherits the
+    decode-attention impl for free through the shared layer bodies
+    (llama.verify_inner) — with `decode_attention_impl: flash`
+    (interpret mode on CPU) the pp2 engine stays byte-exact against
+    the single-program FLASH engine: tokens AND logprobs, greedy and
+    seeded, int8 KV. (Flash-vs-flash: the suite's contract is the
+    stage machinery's exactness; the flash-vs-xla contract is
+    tests/test_flash_decode.py and the bench floor.)"""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, decode_attention_impl="flash")
+    ref = LLMEngine(params, cfg, kv_quantize="int8", **KW)
+    eng = StageShardedEngine(params, cfg, stage=2, kv_quantize="int8",
+                             **KW)
+    try:
+        assert eng.metrics()["decode_attention_impl"] == "flash"
+        for kwargs in (dict(),
+                       dict(temperature=0.9, top_k=8, seed=123)):
+            rid_r = ref.submit(list(PROMPT), 10, **kwargs)
+            ref.run_until_idle()
+            rid_s = eng.submit(list(PROMPT), 10, **kwargs)
+            eng.run_until_idle()
+            assert eng.result(rid_s) == ref.result(rid_r), kwargs
+            assert eng.result_logprobs(rid_s) \
+                == ref.result_logprobs(rid_r), kwargs
+            ref.release(rid_r)
+            eng.release(rid_s)
+    finally:
+        ref.close()
+        eng.close()

@@ -240,6 +240,36 @@ def test_multichip_floors_gated_on_schema_8(tmp_path):
                for f in bench.check_floors(str(p)))
 
 
+def test_kernel_floors_gated_on_schema_9(tmp_path):
+    """serving_kernels' exact-parity floor (r14) only binds records new
+    enough to carry the xla-vs-flash A/B: every pre-r14 committed
+    record stays valid, a schema-9 record missing the section fails
+    loudly, and a schema-9 record holding byte parity is green. Parity
+    is an exact contract — 0.99 is a failure, not noise."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 9   # committed record predates r14
+    assert not any("kernel" in f for f in bench.check_floors(_RECORD))
+
+    rec9 = json.loads(json.dumps(rec))
+    rec9["schema"] = 9
+    p = tmp_path / "rec9.json"
+    p.write_text(json.dumps(rec9))
+    assert any(f.startswith("kernel_greedy_parity")
+               for f in bench.check_floors(str(p)))
+
+    rec9["extras"]["serving_kernels"] = {"kernel_greedy_parity": 1.0}
+    p.write_text(json.dumps(rec9))
+    assert not any("kernel" in f for f in bench.check_floors(str(p)))
+
+    rec9["extras"]["serving_kernels"]["kernel_greedy_parity"] = 0.99
+    p.write_text(json.dumps(rec9))
+    assert any(f.startswith("kernel_greedy_parity")
+               for f in bench.check_floors(str(p)))
+
+
 def test_schema_gates_table_matches_floors(tmp_path):
     """SCHEMA_GATES drives the --check 'gated out' report: every gated
     name must be a real floor, and gated_out_floors() must list exactly

@@ -179,24 +179,43 @@ def test_int8_kv_blocks_stay_quantized_and_match(tiny):
 
 
 def test_cached_tokens_and_request_timing_fields(tiny):
-    eng = make_engine(tiny, prefix_cache=True)
-    prompt = list(range(5, 26))            # 21 tokens
-    rid = eng.submit(prompt, 4, tenant="acme")
-    eng.run_until_idle()
-    assert eng.cached_tokens(rid) == 0     # cold
-    tm = eng.request_timing(rid)
-    assert tm["prompt_len"] == 21 and tm["cached_prefix_len"] == 0
-    assert tm["prefill_tokens"] == 21
-    eng.release(rid)
-    rid = eng.submit(prompt, 4, tenant="acme")
-    eng.run_until_idle()
-    assert eng.cached_tokens(rid) == 16    # 2 blocks reused
-    tm = eng.request_timing(rid)
-    assert tm["cached_prefix_len"] == 16 and tm["prefill_tokens"] == 5
-    eng.release(rid)
+    """The cached_tokens / request_timing surface — AND its invariance
+    under decode_attention_impl (ISSUE 15 satellite): the radix
+    admission path runs BEFORE any decode attention, so the reported
+    prompt_len/cached_prefix_len/prefill_tokens (and cached_tokens)
+    must be identical whether the engine decodes through the xla
+    einsum or the Pallas flash kernel — a kernel flip can never
+    change what the accounting says was reused."""
+
+    def drive(eng):
+        fields = []
+        for _ in range(2):
+            rid = eng.submit(list(range(5, 26)), 4, tenant="acme")
+            eng.run_until_idle()
+            tm = eng.request_timing(rid)
+            fields.append({"cached_tokens": eng.cached_tokens(rid),
+                           "prompt_len": tm["prompt_len"],
+                           "cached_prefix_len": tm["cached_prefix_len"],
+                           "prefill_tokens": tm["prefill_tokens"]})
+            eng.release(rid)
+        return fields
+
+    eng = make_engine(tiny, prefix_cache=True,
+                      decode_attention_impl="xla")
+    cold, hit = drive(eng)
+    assert cold == {"cached_tokens": 0, "prompt_len": 21,
+                    "cached_prefix_len": 0, "prefill_tokens": 21}
+    assert hit == {"cached_tokens": 16, "prompt_len": 21,
+                   "cached_prefix_len": 16, "prefill_tokens": 5}
     per_tenant = eng.metrics()["prefix_cache"]["per_tenant"]
     assert per_tenant["acme"]["hits"] == 1
     assert per_tenant["acme"]["reused_tokens"] == 16
+
+    flash = make_engine(tiny, prefix_cache=True,
+                        decode_attention_impl="flash")
+    assert drive(flash) == [cold, hit]   # impl-invariant accounting
+    assert flash.metrics()["prefix_cache"]["per_tenant"]["acme"] \
+        == per_tenant["acme"]
 
 
 def test_sampled_requests_through_continuation_path(tiny):
