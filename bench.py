@@ -304,6 +304,13 @@ def main() -> None:
                 on_tpu, budget)
         except Exception as e:
             extras["serving_kernels_error"] = f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "serving_observability"):
+        try:
+            extras["serving_observability"] = serving_observability_bench(
+                on_tpu, budget)
+        except Exception as e:
+            extras["serving_observability_error"] = \
+                f"{type(e).__name__}: {e}"
     extras["budget"] = {"total_s": budget.total_s,
                         "used_s": round(budget.elapsed(), 1),
                         "env": BUDGET_ENV}
@@ -348,11 +355,14 @@ def main() -> None:
         # serving_multichip (tp×pp stage-sharded decode parity + bubble
         # accounting) and the per-section runtime stamps; schema 9 adds
         # serving_kernels (the xla-vs-flash decode-kernel A/B with its
-        # exact parity contract). The floor gate only demands a
+        # exact parity contract); schema 10 adds serving_observability
+        # (the tracing-on-vs-off A/B: byte parity under sampled traces
+        # + bounded TPOT overhead + the SLO-burn summary `--check`
+        # prints). The floor gate only demands a
         # section's metrics from records new enough to know about it
         # (older committed records stay valid under --check; `--check`
         # lists which floors a record's schema gates out).
-        json.dump({"schema": 9, "headline": headline, "extras": extras},
+        json.dump({"schema": 10, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -473,6 +483,17 @@ PERF_FLOORS = {
     # gain itself is recorded, not floored — meaningful only on the
     # first on-TPU record (ROADMAP open item #1).
     "multichip_greedy_parity": 1.0,
+    # serving_observability (r16): enforced only on schema>=10 records.
+    # EXACT contract: greedy tokens with every request carrying a
+    # SAMPLED trace id must be byte-identical to the untraced engine's
+    # — telemetry reads timestamps, it never touches the dataplane.
+    "obs_greedy_parity": 1.0,
+    # bounded-overhead contract: tpot_p50(tracing off)/tpot_p50(on) on
+    # the identical byte-pinned replay. 0.95 = at most ~5% TPOT cost —
+    # generous on CPU-smoke noise at toy dims, and the retrospective-
+    # span design (aggregate counters only in the decode loop, spans
+    # minted once per request at finish) should hold it trivially.
+    "obs_tpot_overhead_ratio": 0.95,
 }
 
 #: floor name → the record schema that introduced it (names absent here
@@ -494,6 +515,8 @@ SCHEMA_GATES = {
     "disagg_crash_terminal_frac": 7,
     "multichip_greedy_parity": 8,
     "kernel_greedy_parity": 9,
+    "obs_greedy_parity": 10,
+    "obs_tpot_overhead_ratio": 10,
 }
 
 
@@ -505,6 +528,31 @@ def gated_out_floors(path: str) -> list[str]:
     with open(path) as f:
         schema = json.load(f).get("schema", 1)
     return sorted(n for n, s in SCHEMA_GATES.items() if schema < s)
+
+
+def slo_burn_summary(path: str) -> dict | None:
+    """The SLO-burn view of a committed record (ISSUE 17 satellite):
+    the serving_observability section's per-tenant attainment /
+    error-budget burn, reduced to the two numbers an operator pages on
+    — aggregate burn rate and the worst-burning tenant. None when the
+    record predates schema 10 (gated_out_floors already says so)."""
+    with open(path) as f:
+        rec = json.load(f)
+    burn = ((rec.get("extras") or {})
+            .get("serving_observability") or {}).get("slo_burn")
+    if not burn:
+        return None
+    tenants = burn.get("tenants") or {}
+    worst = max(tenants, key=lambda t: tenants[t]["burn_rate"],
+                default=None)
+    return {
+        "window_s": burn.get("window_s"),
+        "slo": burn.get("slo"),
+        "aggregate": burn.get("aggregate"),
+        "worst_tenant": ({"tenant": worst, **tenants[worst]}
+                         if worst is not None else None),
+        "n_tenants": len(tenants),
+    }
 
 
 def check_floors(path: str) -> list[str]:
@@ -575,6 +623,10 @@ def check_floors(path: str) -> list[str]:
          as_frac(get(ex, "serving_multichip", "greedy_parity"))),
         ("kernel_greedy_parity",
          as_frac(get(ex, "serving_kernels", "kernel_greedy_parity"))),
+        ("obs_greedy_parity",
+         as_frac(get(ex, "serving_observability", "obs_greedy_parity"))),
+        ("obs_tpot_overhead_ratio",
+         get(ex, "serving_observability", "obs_tpot_overhead_ratio")),
     ]
     schema = rec.get("schema", 1)
     failures = []
@@ -2471,26 +2523,186 @@ def serving_kernels_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
     return out
 
 
+def serving_observability_bench(on_tpu: bool,
+                                budget: Budget | None = None) -> dict:
+    """Tracing-on vs tracing-off A/B on the byte-pinned
+    shared_prefix_chat trace (ISSUE 17, schema>=10): the observability
+    layer's two committed contracts.
+
+    - `obs_greedy_parity` (floor exactly 1.0): greedy tokens with every
+      request carrying a SAMPLED trace id must be byte-identical to the
+      untraced engine's — telemetry reads timestamps, it must never
+      touch the dataplane;
+    - `obs_tpot_overhead_ratio` (floor 0.95): tpot_p50(off)/tpot_p50(on)
+      on the identical replay — the retrospective-span design (one
+      blake2b + a handful of dict writes per request, aggregate counters
+      only in the decode loop) keeps the hot path within noise.
+
+    The record also carries the span-export proof (per-kind counts, one
+    trace id's full span-name chain, JSONL line count) and the live SLO
+    burn summary computed from the tracing-on replay through
+    obs.slo.SloBurnTracker — the section `--check` prints."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.loadgen import (generate_trace, load_scenario,
+                                      miniature, trace_sha256)
+    from kubeflow_tpu.loadgen.runner import run_trace
+    from kubeflow_tpu.obs.slo import SloBurnTracker
+    from kubeflow_tpu.obs.trace import TRACER, new_trace_id
+    from kubeflow_tpu.serving.llm import LLMEngine
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=3584, max_seq_len=1024, remat=False)
+        eng_kw = dict(n_slots=8, max_len=512, buckets=(64, 256),
+                      decode_chunk=8, prefix_cache=True,
+                      prefix_cache_blocks=128, kv_quantize="int8",
+                      quantize="int8")
+        mini = None
+        max_new = 32
+    else:
+        # f32 on CPU, same rationale as the kernel A/B: the parity claim
+        # is the MACHINERY's exactness; the overhead ratio is a smoke on
+        # toy dims (the on-TPU record re-measures it at serving dims)
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=8,
+            n_kv_heads=4, d_ff=128, max_seq_len=256, dtype=jnp.float32)
+        eng_kw = dict(n_slots=4, max_len=160, buckets=(8, 32),
+                      decode_chunk=4, prefix_cache=True,
+                      prefix_cache_blocks=96, kv_quantize="int8")
+        mini = dict(vocab=cfg.vocab_size, max_prompt_len=60,
+                    duration_s=3.0, rate_rps=5.0)
+        max_new = 12
+    params = llama.init(jax.random.key(0), cfg)
+    scenario = load_scenario("shared_prefix_chat")
+    if mini is not None:
+        scenario = miniature(scenario, **mini)
+    trace = generate_trace(scenario.trace)
+    out: dict = {
+        "engine": {"model": f"d{cfg.d_model}xL{cfg.n_layers}",
+                   "dtype": str(getattr(cfg.dtype, "__name__", cfg.dtype)),
+                   **{k: v for k, v in eng_kw.items()
+                      if k != "prefix_cache"}},
+        "scenario": scenario.name,
+        "trace_sha256": trace_sha256(trace),
+        "n_requests": len(trace.requests),
+    }
+
+    def expired() -> bool:
+        return budget is not None and budget.expired()
+
+    def replay(engine) -> dict:
+        wall = scenario.trace.duration_s * 4.0 + 60.0
+        if budget is not None:
+            wall = max(5.0, min(wall, budget.remaining()))
+        res = run_trace(engine, trace, max_wall_s=wall)
+        tpots = [r.tpot_ms() for r in res["records"]]
+        ttfts = [r.ttft_ms() for r in res["records"]]
+
+        def pct(vals, q):
+            vals = [v for v in vals if v is not None]
+            return (round(float(np.percentile(vals, q)), 3)
+                    if vals else None)
+
+        agg = res["summary"]["aggregate"]
+        return res["records"], {
+            "ttft_p50_ms": pct(ttfts, 50), "ttft_p99_ms": pct(ttfts, 99),
+            "tpot_p50_ms": pct(tpots, 50), "tpot_p99_ms": pct(tpots, 99),
+            "throughput_tok_per_s": agg["throughput_tok_per_s"],
+            "completed": agg["completed"],
+            "timed_out": res["timed_out"],
+        }
+
+    prev_rate = TRACER.sample_rate
+    engines: dict = {}
+    try:
+        for label, rate in (("tracing_off", 0.0), ("tracing_on", 1.0)):
+            if expired():
+                out.setdefault("skipped_for_budget", []).append(label)
+                continue
+            TRACER.set_sample_rate(rate)
+            t0 = time.perf_counter()
+            eng = LLMEngine(params, cfg, **eng_kw)
+            engines[label] = eng
+            if rate > 0.0:
+                # every replayed request carries a (sampled) trace id —
+                # run_trace doesn't know about tracing, so the shim is
+                # the router/runtime minting step's stand-in
+                real_submit = eng.submit
+                eng.submit = (lambda *a, **kw: real_submit(
+                    *a, trace=new_trace_id(), **kw))
+            eng.warmup()
+            if rate > 0.0:
+                TRACER.sink.clear()   # count replay spans only
+            records, rec = replay(eng)
+            rec["warmup_s"] = round(time.perf_counter() - t0, 1)
+            out[label] = rec
+            if rate > 0.0:
+                spans = TRACER.sink.spans()
+                kinds: dict[str, int] = {}
+                for s in spans:
+                    kinds[s.kind] = kinds.get(s.kind, 0) + 1
+                chain = sorted({s.name for s in spans
+                                if s.trace_id == spans[0].trace_id}) \
+                    if spans else []
+                out["spans"] = {
+                    "total": len(spans),
+                    "dropped": TRACER.sink.dropped,
+                    "by_kind": dict(sorted(kinds.items())),
+                    "one_trace_chain": chain,
+                    "jsonl_lines": len(
+                        TRACER.sink.export_jsonl().splitlines()),
+                }
+                slo = SloBurnTracker(
+                    ttft_slo_ms=scenario.trace.ttft_slo_ms,
+                    tpot_slo_ms=scenario.trace.tpot_slo_ms)
+                for r in records:
+                    slo.record(r.tenant, r.ttft_ms(), r.tpot_ms(),
+                               completed=r.completed)
+                out["slo_burn"] = slo.summary()
+        if "tracing_on" in out and "tracing_off" in out \
+                and out["tracing_on"]["tpot_p50_ms"] \
+                and out["tracing_off"]["tpot_p50_ms"]:
+            out["obs_tpot_overhead_ratio"] = round(
+                out["tracing_off"]["tpot_p50_ms"]
+                / out["tracing_on"]["tpot_p50_ms"], 4)
+        if "tracing_on" in engines and "tracing_off" in engines \
+                and not expired():
+            # byte parity: traced (sampled) vs untraced generation —
+            # probes cover a radix HIT and a chunked (> largest bucket)
+            # prompt, the paths where telemetry reads the most state
+            TRACER.set_sample_rate(1.0)
+            eoff, eon = engines["tracing_off"], engines["tracing_on"]
+            bt = eoff.prefix_block_tokens
+            shared = [(i * 7) % (cfg.vocab_size - 1) + 1
+                      for i in range(2 * bt + bt // 2)]
+            probes = [shared + [17, 23, 5],
+                      shared + [101, 9],
+                      [7, 9, 11],
+                      list(range(3, eng_kw["buckets"][-1] + 10))]
+            out["obs_greedy_parity"] = 1.0 if all(
+                eoff.generate(list(p), max_new)
+                == eon.generate(list(p), max_new)
+                for p in probes) else 0.0
+    finally:
+        TRACER.set_sample_rate(prev_rate)
+        for eng in engines.values():
+            eng.close()
+    return out
+
+
 def _runtime_stamp() -> dict:
     """The live runtime a (section of a) record was measured under:
     platform/device kind/device count/jax versions — so CPU-smoke
     numbers can never masquerade as hardware claims (ISSUE 14
-    satellite; closes the ROADMAP 'self-reported or CPU-measured'
-    ambiguity)."""
-    dev = jax.devices()[0]
-    try:
-        import jaxlib
+    satellite). Delegates to obs.build.runtime_stamp (ISSUE 17: the
+    same helper stamps /healthz `build`, so a committed record and a
+    live endpoint can never disagree on what 'the runtime' means)."""
+    from kubeflow_tpu.obs.build import runtime_stamp
 
-        jaxlib_v = getattr(jaxlib, "__version__", None)
-    except Exception:
-        jaxlib_v = None
-    return {
-        "platform": str(dev.platform),
-        "device_kind": str(dev.device_kind),
-        "device_count": jax.device_count(),
-        "jax": jax.__version__,
-        "jaxlib": jaxlib_v or jax.__version__,
-    }
+    return runtime_stamp()
 
 
 def _geometry_31b() -> dict:
@@ -2894,6 +3106,12 @@ if __name__ == "__main__":
             # an old record passing --check is NOT attesting these
             # contracts — say so explicitly instead of silently passing
             print(json.dumps({"schema_gated_out": gated}))
+        burn = slo_burn_summary(_record)
+        if burn is not None:
+            # the validated record's SLO-burn picture rides --check so
+            # the gate's output says not just "floors hold" but how far
+            # the recorded serving run sat from its error budget
+            print(json.dumps({"slo_burn": burn}))
         print(json.dumps({"floors": "fail" if fails else "pass",
                           "n_failures": len(fails),
                           "n_schema_gated_out": len(gated)}))
@@ -2912,5 +3130,12 @@ if __name__ == "__main__":
         out = serving_kernels_bench(
             "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
         print(json.dumps({"serving_kernels": out}, indent=1))
+        sys.exit(0)
+    if "serving_observability" in sys.argv:
+        # section-only entry (the ISSUE 17 A/B): tracing-on vs
+        # tracing-off parity/overhead record standalone
+        out = serving_observability_bench(
+            "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
+        print(json.dumps({"serving_observability": out}, indent=1))
         sys.exit(0)
     main()

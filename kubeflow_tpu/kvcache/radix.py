@@ -45,6 +45,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Sequence
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+
 
 class Block:
     """One cached block: `payload` is opaque (the engine stores device
@@ -231,6 +233,7 @@ class RadixKVCache:
                     self._inserted_blocks += 1
                     new_blocks += 1
                     self._row(tenant)["inserted_blocks"] += 1
+                    obs_metrics.PREFIX_EVENTS.inc(event="insert")
                 else:
                     self._touch(child)
                 node = child
@@ -260,6 +263,7 @@ class RadixKVCache:
         victim.block.payload = None   # drop the device arrays NOW
         self._n_blocks -= 1
         self._evicted_blocks += 1
+        obs_metrics.PREFIX_EVENTS.inc(event="evict")
         return True
 
     # -- accounting ----------------------------------------------------------
@@ -281,10 +285,12 @@ class RadixKVCache:
             row = self._row(tenant)
             row["hits"] += 1
             row["reused_tokens"] += reused_tokens
+        obs_metrics.PREFIX_EVENTS.inc(event="hit")
 
     def record_miss(self, tenant: str | None) -> None:
         with self._lock:
             self._row(tenant)["misses"] += 1
+        obs_metrics.PREFIX_EVENTS.inc(event="miss")
 
     @property
     def n_blocks(self) -> int:

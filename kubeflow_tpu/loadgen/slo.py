@@ -73,13 +73,25 @@ class RequestRecord:
                 / (self.n_tokens - 1)) * 1e3
 
     def meets_slo(self, ttft_slo_ms: float, tpot_slo_ms: float) -> bool:
-        if not self.completed:
-            return False
-        ttft = self.ttft_ms()
-        if ttft is None or ttft > ttft_slo_ms:
-            return False
-        tpot = self.tpot_ms()
-        return tpot is None or tpot <= tpot_slo_ms
+        return request_meets(self.ttft_ms(), self.tpot_ms(),
+                             ttft_slo_ms=ttft_slo_ms,
+                             tpot_slo_ms=tpot_slo_ms,
+                             completed=self.completed)
+
+
+def request_meets(ttft_ms: float | None, tpot_ms: float | None, *,
+                  ttft_slo_ms: float, tpot_slo_ms: float,
+                  completed: bool = True) -> bool:
+    """THE SLO predicate (module docstring bullet 3), shared by the
+    offline record reduction above and the online burn tracker
+    (obs/slo.py) so the two surfaces can never drift: completed
+    normally, TTFT within bound, and TPOT within bound when defined
+    (single-token requests have no TPOT)."""
+    if not completed:
+        return False
+    if ttft_ms is None or ttft_ms > ttft_slo_ms:
+        return False
+    return tpot_ms is None or tpot_ms <= tpot_slo_ms
 
 
 def _pct(vals: Sequence[float], q: float) -> float | None:

@@ -1,0 +1,28 @@
+"""kubeflow_tpu.obs — the zero-dependency telemetry layer (ISSUE 17).
+
+Three pieces, each usable alone:
+
+- ``obs.trace``: request-scoped tracing. A trace id is minted at the
+  router (``X-Trace-Id``) or at ``submit()`` and rides every hop —
+  router relay → supervisor journal → engine admission → disagg roles /
+  pp stages — as plain string plumbing (no context-vars magic, so
+  thread handoffs can't silently drop it). Spans land in a bounded
+  in-process ring buffer, exportable as JSONL.
+- ``obs.metrics``: THE process-wide instrument set over the existing
+  ``utils.metrics.Registry`` text exporter. Every serving-plane metric
+  name is declared here (scripts/check_observability.py enforces it),
+  and ``render_metrics()`` is the one scrape path both ``ModelServer``
+  and the router serve at ``GET /metrics``.
+- ``obs.slo``: sliding-window per-tenant TTFT/TPOT attainment and
+  error-budget burn rate, computed online with the ``loadgen/slo.py``
+  predicate — the live counterpart of the offline scenario summary.
+"""
+
+from kubeflow_tpu.obs.build import build_stamp
+from kubeflow_tpu.obs.metrics import render_metrics
+from kubeflow_tpu.obs.slo import SloBurnTracker
+from kubeflow_tpu.obs.trace import (TRACER, Span, SpanSink, Tracer,
+                                    new_trace_id)
+
+__all__ = ["TRACER", "Span", "SpanSink", "Tracer", "new_trace_id",
+           "render_metrics", "SloBurnTracker", "build_stamp"]

@@ -28,6 +28,8 @@ import os
 import random
 import threading
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+
 
 class HeartbeatReporter:
     def __init__(self, address: str, job_gang: str, world: int, rank: int,
@@ -51,6 +53,10 @@ class HeartbeatReporter:
         self.last_error: str | None = None
         self.reporter_dead = False
         self.dropped = 0           # beats suppressed by an injected drop
+        # one reporter per worker process: the gauges describe the
+        # newest reporter (a fresh gang epoch resets the dead flag)
+        obs_metrics.HEARTBEAT_REPORTER_DEAD.set(0)
+        obs_metrics.HEARTBEAT_CONSECUTIVE_FAILURES.set(0)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"heartbeat-{job_gang}-{rank}")
@@ -78,18 +84,25 @@ class HeartbeatReporter:
             if self.injector is not None \
                     and self.injector.active("heartbeat_drop") is not None:
                 self.dropped += 1   # chaos: the beat is eaten in flight
+                obs_metrics.HEARTBEAT_EVENTS.inc(event="dropped")
                 continue
             try:
                 self._client.heartbeat(self.job_gang, self.rank)
                 self.consecutive_failures = 0
+                obs_metrics.HEARTBEAT_EVENTS.inc(event="sent")
+                obs_metrics.HEARTBEAT_CONSECUTIVE_FAILURES.set(0)
             except OSError as e:
                 self.consecutive_failures += 1
                 self.last_error = str(e)
+                obs_metrics.HEARTBEAT_EVENTS.inc(event="failed")
+                obs_metrics.HEARTBEAT_CONSECUTIVE_FAILURES.set(
+                    self.consecutive_failures)
                 if self.consecutive_failures \
                         >= self.max_consecutive_failures:
                     # coordinator persistently unreachable (job likely
                     # finishing / torn down): stop, but say so
                     self.reporter_dead = True
+                    obs_metrics.HEARTBEAT_REPORTER_DEAD.set(1)
                     return
 
     def stop(self, mark_done: bool = True) -> None:

@@ -44,6 +44,8 @@ import time
 import urllib.request
 from typing import Any, Callable
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.trace import TRACER
 from kubeflow_tpu.serving.model import (Model, ModelError, ModelRepository,
                                         load_model)
 from kubeflow_tpu.serving.storage import download
@@ -388,6 +390,9 @@ class EngineSupervisor:
             if self.degraded and self.shed_policy is not None \
                     and self.shed_policy.sheds(tenant):
                 self._counts["shed"] += 1
+                obs_metrics.SCHED_SHED.inc(engine="supervisor")
+                obs_metrics.REQUESTS.inc(component="supervisor",
+                                         event="shed")
                 raise TenantShed(
                     f"degraded mode: tenant {tenant!r} priority "
                     f"{self.shed_policy.priority_of(tenant)} is below the "
@@ -409,6 +414,8 @@ class EngineSupervisor:
             self._next_rid += 1
             self._journal[entry.rid] = entry
             self._counts["accepted"] += 1
+            obs_metrics.REQUESTS.inc(component="supervisor",
+                                     event="accepted")
             return entry.rid
 
     # -- the drive loop -------------------------------------------------------
@@ -474,6 +481,23 @@ class EngineSupervisor:
             self.outages.append({"cause": cause, "detected_s": now,
                                  "backoff_s": round(delay, 4),
                                  "recovered_s": None})
+            # `cause` is free-form past the first colon ("crash: ..."),
+            # so the counter label keeps only the bounded prefix
+            obs_metrics.SUPERVISOR_RESTARTS.inc(
+                cause=cause.split(":", 1)[0].strip())
+            # the engine died before emitting these requests' spans —
+            # the journal is the only witness of the original attempt,
+            # so the crash-replay chain (attempt → restart → resume)
+            # shows up under ONE trace id even though the engine's own
+            # retrospective spans never fired
+            for e in self._journal.values():
+                if not e.terminal:
+                    TRACER.record_span(
+                        "supervisor.attempt", "supervise",
+                        e.kw.get("trace"), e.submit_s, now,
+                        outcome="killed", cause=cause, tenant=e.tenant,
+                        tokens_delivered=(len(e.base_tokens)
+                                          + len(e.tokens)))
             if self._consec_failures > self.max_restarts:
                 self.failed = True
                 for e in self._journal.values():
@@ -528,12 +552,19 @@ class EngineSupervisor:
         remaining budget."""
         from kubeflow_tpu.serving.scheduler import QueueFull
 
+        tr = e.kw.get("trace")
+        t0 = time.monotonic()
+        TRACER.record_span(
+            "supervisor.restart", "restart", tr, self._last_crash, t0,
+            cause=(self.outages[-1]["cause"] if self.outages else None),
+            restarts=self._counts["restarts"])
         try:
             # a request with ANY delivered tokens (this generation's OR a
             # previous generation's base prefix — a second crash mid-retry
             # must not rewind the client's stream) resumes; only a truly
             # token-less one replays from scratch
             if e.deterministic or not (e.tokens or e.base_tokens):
+                mode = "replayed" if e.tokens else "resubmitted"
                 if e.tokens:
                     e.verify_prefix = list(e.base_tokens) + list(e.tokens)
                     e.chain.append("replayed")
@@ -545,6 +576,10 @@ class EngineSupervisor:
                 e.engine_seen = 0
                 e.engine_rid = self.engine.submit(
                     list(e.prompt), e.max_new, **e.kw)
+                TRACER.record_span(
+                    "supervisor.resume", "replay", tr, t0,
+                    time.monotonic(), mode=mode,
+                    replay_tokens=len(e.tokens))
             else:
                 done = e.base_tokens + e.tokens
                 remaining = e.max_new - len(done)
@@ -564,6 +599,10 @@ class EngineSupervisor:
                 e.engine_seen = 0
                 e.engine_rid = self.engine.submit(
                     list(e.prompt) + done, remaining, **e.kw)
+                TRACER.record_span(
+                    "supervisor.resume", "replay", tr, t0,
+                    time.monotonic(), mode="retried",
+                    resumed_over=len(done))
         except (QueueFull, ValueError):
             # the replacement engine cannot take it (queue full, or the
             # prompt+prefix resume outgrew the engine's buckets —
@@ -661,10 +700,21 @@ class EngineSupervisor:
         e.finish_s = now
         if reason in ("stop", "length"):
             self._counts["completed"] += 1
+            event = "completed"
         elif reason == "rejected":
             self._counts["rejected"] += 1
+            event = "rejected"
         else:
             self._counts["cancelled"] += 1
+            event = "cancelled"
+        obs_metrics.REQUESTS.inc(component="supervisor", event=event)
+        # the supervise span covers the whole journal lifetime — across
+        # restarts — with the usage chain as its crash-replay evidence
+        TRACER.record_span(
+            "supervisor.supervise", "supervise", e.kw.get("trace"),
+            e.submit_s, now, tenant=e.tenant, finish_reason=reason,
+            chain=list(e.chain),
+            n_tokens=len(e.base_tokens) + len(e.tokens))
 
     # -- request-side API (the engine surface the runner consumes) ------------
 

@@ -152,7 +152,9 @@ class _DisaggReq:
     prefill_rid: int | None = None
     decode_rid: int | None = None
     dispatch_s: float | None = None   # left the queue (phase epoch)
+    prefill_done_s: float | None = None   # prefill harvest (handoff epoch)
     handoff_s: float | None = None
+    trace: str | None = None          # obs trace id riding the pipeline
     blocks: int = 0               # blocks actually handed off
     bypass: bool = False
     reason: str | None = None     # local terminal reason (no decode rid)
@@ -312,7 +314,8 @@ class DisaggregatedEngine:
                frequency_penalty: float = 0.0,
                seed: int | None = None, stop=None,
                deadline_s: float | None = None,
-               tenant: str | None = None) -> int:
+               tenant: str | None = None,
+               trace: str | None = None) -> int:
         from kubeflow_tpu.serving.scheduler import QueueFull
 
         if self.failed:
@@ -331,13 +334,13 @@ class DisaggregatedEngine:
         kw = dict(temperature=temperature, adapter=adapter, top_k=top_k,
                   top_p=top_p, presence_penalty=presence_penalty,
                   frequency_penalty=frequency_penalty, seed=seed,
-                  stop=stop, tenant=tenant)
+                  stop=stop, tenant=tenant, trace=trace)
         now = time.monotonic()
         with self._lock:
             r = _DisaggReq(
                 rid=self._next_rid, prompt=list(prompt),
                 max_new=max_new_tokens, kw=kw, tenant=tenant,
-                adapter=adapter, submit_s=now,
+                adapter=adapter, submit_s=now, trace=trace,
                 deadline_at=(now + deadline_s if deadline_s is not None
                              else None))
             self._next_rid += 1
@@ -517,6 +520,7 @@ class DisaggregatedEngine:
                 self._blocks_inflight = max(
                     0, self._blocks_inflight - r.blocks_needed)
                 r.stage = "handoff"
+                r.prefill_done_s = time.monotonic()
                 finished.append((r, reason))
                 moved = True
             # 3) dispatch queued jobs under the inflight cap and decode-
@@ -542,7 +546,7 @@ class DisaggregatedEngine:
                 try:
                     job.prefill_rid = self.prefill.submit(
                         list(job.prompt), 1, adapter=job.adapter,
-                        tenant=job.tenant)
+                        tenant=job.tenant, trace=job.trace)
                 except Exception:
                     # prefill admission refused (queue full / shed /
                     # permanently failed): degrade to bypass
@@ -567,12 +571,32 @@ class DisaggregatedEngine:
                     continue
                 r.blocks = blocks
                 r.handoff_s = time.monotonic()
+                self._record_role_spans(r)
                 # a prefill-side rejection/cancellation (e.g. the
                 # replacement engine's queue refused the replay) still
                 # serves colocated-style on the decode worker
                 self._to_decode(r, bypass=reason not in ("stop",
                                                          "length"))
         return moved
+
+    def _record_role_spans(self, r: _DisaggReq) -> None:
+        """Retrospective queue/prefill/handoff spans from the phase
+        epochs the coordinator already keeps — emitted once at handoff
+        completion, never on the decode hot loop."""
+        from kubeflow_tpu.obs.trace import TRACER
+
+        if r.trace is None or not TRACER.sampled(r.trace):
+            return
+        TRACER.record_span("disagg.queue", "queue", r.trace,
+                           r.submit_s, r.dispatch_s, tenant=r.tenant)
+        TRACER.record_span("disagg.prefill", "prefill", r.trace,
+                           r.dispatch_s, r.prefill_done_s,
+                           role="prefill", prompt_len=len(r.prompt),
+                           blocks_needed=r.blocks_needed)
+        TRACER.record_span("disagg.handoff", "handoff", r.trace,
+                           r.prefill_done_s, r.handoff_s,
+                           blocks=r.blocks,
+                           handoff=type(self.handoff).__name__)
 
     def _pump_decode(self) -> None:
         """Decode-side bookkeeping (runs on the caller's step loop):
@@ -720,8 +744,15 @@ class DisaggregatedEngine:
         """The engine-shaped timing record, with the phase split mapped
         onto the disaggregated pipeline: queue_wait_ms = submit → the
         job leaving the coordinator's prefill queue; prefill_ms = queue
-        exit → first token (prefill-worker compute + handoff + the
-        decode-side tail continuation); decode_ms as always."""
+        exit → the prefill worker's KV harvest; handoff_ms = harvest →
+        first token (the KV transfer plus decode admission and the tail
+        continuation — the wall the ISSUE 17 bugfix stops folding into
+        prefill); decode_ms as always. The four phases partition
+        submit → finish exactly, so `queue_wait + prefill + handoff +
+        decode == end-to-end wall` is a testable identity. Bypass
+        requests (short prompt / dead prefill role) never harvest:
+        their prefill_ms keeps the legacy queue-exit → first-token
+        meaning and handoff_ms is None."""
         with self._lock:
             r = self._reqs[rid]
             first = fin = None
@@ -739,6 +770,7 @@ class DisaggregatedEngine:
             return (round((b - a) * 1e3, 3)
                     if a is not None and b is not None else None)
 
+        pdone = r.prefill_done_s
         return {
             "submit_s": r.submit_s,
             "first_token_s": first,
@@ -749,7 +781,10 @@ class DisaggregatedEngine:
             "cached_prefix_len": cached,
             "prefill_tokens": len(r.prompt) - cached,
             "queue_wait_ms": ms(r.submit_s, r.dispatch_s),
-            "prefill_ms": ms(r.dispatch_s, first),
+            "prefill_ms": (ms(r.dispatch_s, pdone) if pdone is not None
+                           else ms(r.dispatch_s, first)),
+            "handoff_ms": (ms(pdone, first) if pdone is not None
+                           else None),
             "decode_ms": ms(first, fin),
         }
 

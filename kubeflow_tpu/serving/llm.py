@@ -32,6 +32,8 @@ import numpy as np
 
 from kubeflow_tpu.kvcache import RadixKVCache
 from kubeflow_tpu.models import llama
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.trace import TRACER, StepAggregator
 from kubeflow_tpu.serving.scheduler import (DecodeAction, PrefillAction,
                                             PromptTooLong, make_scheduler)
 
@@ -158,6 +160,11 @@ class LLMEngine:
     """Continuous-batching generation over llama-family params: greedy by
     default, per-request temperature/top-k/top-p sampling, stop sequences,
     logprobs, and chunk-boundary cancellation."""
+
+    #: obs component label (overridden by role engines: prefill/decode/
+    #: stage_sharded) — the `component=` of every engine-side metric and
+    #: the role attribute of engine spans
+    role = "engine"
 
     def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
@@ -396,6 +403,20 @@ class LLMEngine:
         # is the TTFT/TPOT record the loadgen runner reads via
         # request_timing() BEFORE release())
         self._finish_t: dict[int, float] = {}
+        # -- observability (ISSUE 17): optional per-request trace ids and
+        # the hot-loop step AGGREGATOR (per-dispatch counter bumps only —
+        # the one decode span a request gets is emitted retrospectively
+        # at finish from timestamps already kept; check_observability.py
+        # lints that no span objects are minted on the step/_do_decode
+        # paths). _decode_mark snapshots the aggregator at first token so
+        # the finish span can report the request's decode-step window.
+        self._req_trace: dict[int, str] = {}
+        self._decode_agg = StepAggregator()
+        self._decode_mark: dict[int, tuple[int, int]] = {}
+        # queue-depth gauges are pull-model: refreshed from the scheduler
+        # at scrape time (weakref-held, so a dropped engine unregisters
+        # itself)
+        obs_metrics.add_scrape_hook(self, LLMEngine._obs_publish)
         # Guards submit vs. the engine-loop thread: held across
         # scheduler.submit + request-dict population so scheduler.next()
         # (also taken under it) can never hand out a prefill whose request
@@ -1350,7 +1371,8 @@ class LLMEngine:
                seed: int | None = None,
                stop: Sequence[Sequence[int]] | None = None,
                deadline_s: float | None = None,
-               tenant: str | None = None) -> int:
+               tenant: str | None = None,
+               trace: str | None = None) -> int:
         """Queue one request. top_k (0 = off) / top_p (1.0 = off) filter
         the sampled distribution inside the compiled programs (only when
         temperature > 0 — greedy rows stay bit-exact argmax).
@@ -1423,6 +1445,9 @@ class LLMEngine:
                 self._req_aids[req_id] = aid
             self._req_plen[req_id] = len(prompt)
             self._submit_t[req_id] = time.monotonic()
+            if trace is not None:
+                self._req_trace[req_id] = trace
+        obs_metrics.REQUESTS.inc(component=self.role, event="submitted")
         return req_id
 
     #: bound on distinct tenant names one engine tracks: the OpenAI
@@ -1485,6 +1510,7 @@ class LLMEngine:
                 self._req_stop.pop(rid, None)
                 self._req_aids.pop(rid, None)
                 self._deadlines.pop(rid, None)
+                self._obs_finish(rid)
 
     def step(self) -> bool:
         """One engine iteration: a prefill wave or a batched decode.
@@ -1847,6 +1873,17 @@ class LLMEngine:
         self.params = None
         gc.collect()
 
+    def _obs_publish(self) -> None:
+        """Scrape hook body: refresh this engine's queue-depth gauges
+        just before a /metrics render (see obs.metrics.add_scrape_hook;
+        exceptions are swallowed by the hook runner, so a closed engine
+        can't poison a scrape)."""
+        s = self.scheduler.stats()
+        obs_metrics.SCHED_QUEUED.set(s.queued, engine=self.role)
+        obs_metrics.SCHED_ACTIVE.set(s.active, engine=self.role)
+        obs_metrics.INFLIGHT.set(s.queued + s.active,
+                                 component=self.role)
+
     def is_done(self, req_id: int) -> bool:
         return req_id in self._done
 
@@ -1901,6 +1938,8 @@ class LLMEngine:
         self._cached_prefix.pop(req_id, None)
         self._req_plen.pop(req_id, None)
         self._prefill_start_t.pop(req_id, None)
+        self._req_trace.pop(req_id, None)
+        self._decode_mark.pop(req_id, None)
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: int = 32,
@@ -2355,6 +2394,10 @@ class LLMEngine:
         self._perf["dispatch_s"] += time.perf_counter() - t_dispatch
         self._perf["decode_chunks"] += 1
         self._perf["decode_steps"] += k
+        # obs: aggregate counters only on this path (no span objects —
+        # scripts/check_observability.py lints that invariant)
+        self._decode_agg.note_step(int(active.sum()) * k * per_tok,
+                                   steps=k)
         rows_added = np.where(active, k * per_tok, 0)
         self._inflight += rows_added
         prev = self._pending
@@ -2503,6 +2546,8 @@ class LLMEngine:
             now = time.monotonic()
             self._first_token_t[req_id] = now
             self._ttft_window.append(now - self._submit_t[req_id])
+            if req_id in self._req_trace:
+                self._decode_mark[req_id] = self._decode_agg.snapshot()
         res = self._results[req_id]
         res.append(token)
         self._logprobs[req_id].append(lp)
@@ -2544,7 +2589,52 @@ class LLMEngine:
             self._req_stop.pop(req_id, None)
             self._req_aids.pop(req_id, None)
             self._deadlines.pop(req_id, None)
+            self._obs_finish(req_id)
         return freed
+
+    def _obs_finish(self, req_id: int) -> None:
+        """Per-request telemetry, emitted ONCE at finish (never inside
+        the decode loop): lifecycle counter, TTFT/TPOT/queue-wait
+        histogram observations, and — when the request carried a SAMPLED
+        trace id — the retrospective queue/prefill/decode spans
+        reconstructed from the timestamps the engine already keeps for
+        request_timing()."""
+        reason = self._finish_reasons.get(req_id, "length")
+        obs_metrics.REQUESTS.inc(component=self.role, event=reason)
+        sub = self._submit_t.get(req_id)
+        pstart = self._prefill_start_t.get(req_id)
+        first = self._first_token_t.get(req_id)
+        fin = self._finish_t.get(req_id)
+        n_tok = len(self._results.get(req_id, ()))
+        if sub is not None and first is not None:
+            obs_metrics.TTFT_SECONDS.observe(first - sub,
+                                             component=self.role)
+        if sub is not None and pstart is not None:
+            obs_metrics.QUEUE_WAIT_SECONDS.observe(pstart - sub,
+                                                   component=self.role)
+        if first is not None and fin is not None and n_tok >= 2:
+            obs_metrics.TPOT_SECONDS.observe((fin - first) / (n_tok - 1),
+                                             component=self.role)
+        trace = self._req_trace.pop(req_id, None)
+        mark = self._decode_mark.pop(req_id, None)
+        if trace is None or not TRACER.sampled(trace):
+            return
+        tenant = self._req_tenant.get(req_id)
+        TRACER.record_span(f"{self.role}.queue", "queue", trace, sub,
+                           pstart, tenant=tenant)
+        TRACER.record_span(f"{self.role}.prefill", "prefill", trace,
+                           pstart, first,
+                           prompt_len=self._req_plen.get(req_id),
+                           cached_prefix_len=self._cached_prefix.get(
+                               req_id, 0))
+        attrs: dict[str, Any] = {"n_tokens": n_tok,
+                                 "finish_reason": reason,
+                                 "tenant": tenant}
+        if mark is not None:
+            attrs.update(StepAggregator.window(
+                mark, self._decode_agg.snapshot()))
+        TRACER.record_span(f"{self.role}.decode", "decode", trace,
+                           first, fin, **attrs)
 
 
 # -- disaggregated serving roles (ISSUE 13, ROADMAP #3) -----------------------

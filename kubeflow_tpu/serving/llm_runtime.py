@@ -73,6 +73,8 @@ class LLMModel(Model):
                  disagg: dict[str, Any] | None = None,
                  usage_timing: bool = False,
                  parallel: dict[str, Any] | None = None,
+                 trace_sample_rate: float | None = None,
+                 slo: dict[str, Any] | None = None,
                  **_ignored: Any):
         super().__init__(name)
         self._cfg_overrides = dict(model or {})
@@ -190,6 +192,28 @@ class LLMModel(Model):
         # window the connection stays provably alive instead of tripping
         # client/proxy read timeouts
         self._sse_keepalive_s = float(sse_keepalive_s)
+        # config.trace_sample_rate (ISSUE 17): fraction of trace ids the
+        # process keeps spans for (deterministic per-id hash, so router/
+        # supervisor/engine agree without coordination). None leaves the
+        # process tracer's current rate alone — the tracer is
+        # process-global, so only an explicit config value touches it.
+        if trace_sample_rate is not None:
+            from kubeflow_tpu.obs.trace import TRACER
+
+            TRACER.set_sample_rate(float(trace_sample_rate))
+        # config.slo {ttft_ms, tpot_ms, window_s, budget}: the online
+        # burn tracker behind /healthz's "slo" section and the
+        # slo_attainment / slo_burn_rate gauges
+        from kubeflow_tpu.obs.metrics import add_scrape_hook
+        from kubeflow_tpu.obs.slo import SloBurnTracker
+
+        slo_cfg = dict(slo or {})
+        self.slo_tracker = SloBurnTracker(
+            ttft_slo_ms=float(slo_cfg.get("ttft_ms", 2000.0)),
+            tpot_slo_ms=float(slo_cfg.get("tpot_ms", 200.0)),
+            window_s=float(slo_cfg.get("window_s", 300.0)),
+            budget=float(slo_cfg.get("budget", 0.01)))
+        add_scrape_hook(self.slo_tracker, SloBurnTracker.publish)
         self._seed = seed
         self._timeout_s = timeout_s
         self._engine = None
@@ -575,6 +599,13 @@ class LLMModel(Model):
         deadline = float(payload.get("deadline_s")
                          or (self._timeout_s + 10.0))
         seed = payload.get("seed")
+        # trace id: taken from the payload (the HTTP layer maps the
+        # X-Trace-Id header here; the router minted it upstream) or
+        # minted NOW — submit is the edge for direct predict()/gRPC
+        # callers. Whether spans actually record is the sampler's call.
+        from kubeflow_tpu.obs.trace import new_trace_id
+
+        trace = str(payload.get("trace") or new_trace_id())
         rid = self._engine.submit(
             prompt, max_new, temperature, adapter=adapter,
             top_k=int(payload.get("top_k", 0)),
@@ -584,7 +615,8 @@ class LLMModel(Model):
             seed=None if seed is None else int(seed),
             stop=self._encode_stops(payload.get("stop")),
             deadline_s=deadline,
-            tenant=payload.get("tenant"))
+            tenant=payload.get("tenant"),
+            trace=trace)
         self._wake.set()
         return rid
 
@@ -630,7 +662,29 @@ class LLMModel(Model):
         except Exception:
             return {}
         return {k: tm.get(k) for k in
-                ("queue_wait_ms", "prefill_ms", "decode_ms")}
+                ("queue_wait_ms", "prefill_ms", "handoff_ms",
+                 "decode_ms")
+                if k != "handoff_ms" or "handoff_ms" in tm}
+
+    def _slo_record(self, rid: int, reason: str) -> None:
+        """Feed one finished request into the burn tracker (read BEFORE
+        release, like _timing_fields). Never raises — SLO accounting must
+        not take down the serving path."""
+        try:
+            tm = self._engine.request_timing(rid)
+        except Exception:
+            return
+        sub = tm.get("submit_s")
+        first = tm.get("first_token_s")
+        fin = tm.get("finish_s")
+        n = tm.get("n_tokens") or 0
+        ttft = ((first - sub) * 1e3
+                if sub is not None and first is not None else None)
+        tpot = ((fin - first) / (n - 1) * 1e3
+                if first is not None and fin is not None and n >= 2
+                else None)
+        self.slo_tracker.record(tm.get("tenant"), ttft, tpot,
+                                completed=reason in ("stop", "length"))
 
     def _cached_tokens(self, rid: int) -> int | None:
         """None when the engine runs no prefix cache (the usage object
@@ -704,6 +758,7 @@ class LLMModel(Model):
                 info["timing"] = self._timing_fields(rid)
         if on_finish is not None:
             on_finish(reason)
+        self._slo_record(rid, reason)
         self._engine.release(rid)
 
     def complete(self, payload: Any) -> dict[str, Any]:
@@ -746,6 +801,7 @@ class LLMModel(Model):
             result["timing"] = self._timing_fields(rid)
         if self._logprobs_topk:
             result["top_logprobs"] = self._engine.result_top_logprobs(rid)
+        self._slo_record(rid, reason)
         self._engine.release(rid)  # long-lived server: drop request state
         return result if full else out
 

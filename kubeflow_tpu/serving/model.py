@@ -19,6 +19,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+
 
 class ModelError(Exception):
     pass
@@ -99,9 +101,13 @@ class ModelRepository:
         with self._lock:
             self._models[model.name] = model
         if load and not model.ready:
+            t0 = time.monotonic()
             model.load()
             if not model.ready:
                 model._mark_ready()
+            obs_metrics.MODEL_LOAD_SECONDS.observe(
+                time.monotonic() - t0, model=model.name)
+        obs_metrics.MODEL_READY.set(int(model.ready), model=model.name)
         return model
 
     def get(self, name: str) -> Model:
@@ -116,6 +122,7 @@ class ModelRepository:
             m = self._models.pop(name, None)
         if m is not None:
             m.unload()
+            obs_metrics.MODEL_READY.set(0, model=name)
 
     def names(self) -> list[str]:
         with self._lock:

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from kubeflow_tpu.kvcache import RadixKVCache, StagePartitionedKVCache
 from kubeflow_tpu.models import llama
+from kubeflow_tpu.obs.trace import TRACER
 from kubeflow_tpu.parallel.pipeline import (InferenceStagePlan, StageClock,
                                             split_stage_params, wavefront)
 from kubeflow_tpu.serving.llm import LLMEngine
@@ -560,6 +561,28 @@ class StageShardedEngine(LLMEngine):
         out = super().metrics()
         out["pipeline"] = self.pipeline_perf()
         return out
+
+    def _obs_finish(self, req_id: int) -> None:
+        """Base per-request spans plus one retrospective ``stage`` span
+        per pipeline stage over the request's decode window — emitted at
+        finish from the plan geometry, NEVER from inside the wavefront
+        loop (per-microbatch spans at decode rate are exactly what the
+        sampling design forbids)."""
+        trace = self._req_trace.get(req_id)
+        first = self._first_token_t.get(req_id)
+        fin = self._finish_t.get(req_id)
+        super()._obs_finish(req_id)
+        if trace is None or first is None or fin is None \
+                or not TRACER.sampled(trace):
+            return
+        perf = self._plan.perf
+        for s, (lo, hi) in enumerate(self._plan.bounds):
+            TRACER.record_span(
+                f"{self.role}.stage{s}", "stage", trace, first, fin,
+                stage=s, layers=[lo, hi],
+                microbatches=self._plan.n_microbatches,
+                tensor=self.tensor,
+                schedule_bubble_frac=perf.schedule_bubble_frac())
 
     def close(self) -> None:
         self._stage_progs.clear()

@@ -51,7 +51,12 @@ import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable
+from typing import Any, Callable
+
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.build import build_stamp
+from kubeflow_tpu.obs.metrics import render_metrics
+from kubeflow_tpu.obs.trace import TRACE_HEADER, TRACER, new_trace_id
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
@@ -77,16 +82,27 @@ class _Circuit:
     itself runs outside the lock)."""
 
     def __init__(self, failure_threshold: int, open_s: float,
-                 open_cap_s: float):
+                 open_cap_s: float, backend: str = ""):
         self.failure_threshold = failure_threshold
         self.base_open_s = open_s
         self.open_cap_s = open_cap_s
+        self.backend = backend       # metric label (the port, stringly)
         self.state = CLOSED
         self.failures = 0            # consecutive transport failures
         self.opened_count = 0        # times this circuit tripped (metric)
         self.open_until = 0.0
         self.open_s = open_s
         self.probing = False         # a half-open probe is in flight
+        obs_metrics.CIRCUIT_STATE.set(
+            obs_metrics.CIRCUIT_STATE_CODES[CLOSED], backend=backend)
+
+    def _transition(self, new: str) -> None:
+        if new == self.state:
+            return
+        self.state = new
+        obs_metrics.CIRCUIT_STATE.set(
+            obs_metrics.CIRCUIT_STATE_CODES[new], backend=self.backend)
+        obs_metrics.CIRCUIT_TRANSITIONS.inc(backend=self.backend, to=new)
 
     def admits(self, now: float) -> bool:
         """May a request be sent to this backend right now?"""
@@ -94,7 +110,7 @@ class _Circuit:
             return True
         if self.state == OPEN and now >= self.open_until:
             # hold-off over: become half-open, admit ONE probe
-            self.state = HALF_OPEN
+            self._transition(HALF_OPEN)
             self.probing = False
         if self.state == HALF_OPEN and not self.probing:
             return True
@@ -105,7 +121,7 @@ class _Circuit:
             self.probing = True
 
     def on_success(self) -> None:
-        self.state = CLOSED
+        self._transition(CLOSED)
         self.failures = 0
         self.probing = False
         self.open_s = self.base_open_s   # recovery resets the escalation
@@ -123,7 +139,7 @@ class _Circuit:
     def _trip(self, now: float) -> None:
         if self.state != OPEN:
             self.opened_count += 1
-        self.state = OPEN
+        self._transition(OPEN)
         self.open_until = now + self.open_s
 
     def retry_in(self, now: float) -> float:
@@ -177,6 +193,10 @@ class Router:
         # reports concurrency; here the router IS the queue-proxy)
         self.inflight = 0
         self.peak_inflight = 0
+        self._start_mono = time.monotonic()
+        # pull-model gauge refresh at scrape time (weakref-held: a
+        # stopped router drops out of the hook list by itself)
+        obs_metrics.add_scrape_hook(self, Router._obs_publish)
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -194,10 +214,12 @@ class Router:
                 if out is None:
                     return   # SSE relay already wrote this socket
                 code, body, extra = out
+                extra = dict(extra or {})
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", extra.pop(
+                    "Content-Type", "application/json"))
                 self.send_header("Content-Length", str(len(body)))
-                for k, v in (extra or {}).items():
+                for k, v in extra.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
@@ -233,7 +255,7 @@ class Router:
             for p in live:
                 self._circuits.setdefault(p, _Circuit(
                     self.failure_threshold, self.circuit_open_s,
-                    self.circuit_open_cap_s))
+                    self.circuit_open_cap_s, backend=str(p)))
             for p in list(self._circuits):
                 if p not in live:   # replaced replicas take their state away
                     del self._circuits[p]
@@ -265,6 +287,35 @@ class Router:
                 else:
                     out[p] = c.state
             return out
+
+    def _obs_publish(self) -> None:
+        """Scrape hook body: refresh the router's concurrency gauge just
+        before a /metrics render (circuit gauges update on transition,
+        not here)."""
+        obs_metrics.INFLIGHT.set(self.inflight, component="router")
+
+    def health(self) -> dict[str, Any]:
+        """The router's OWN liveness payload (served locally at
+        /healthz, never proxied — an ingress answering for a backend
+        would mask exactly the restarts fleet tooling is looking for).
+        Top-level ``uptime_s`` + ``build`` mirror the ModelServer
+        /healthz contract; backend state rides along as the breaker
+        summary."""
+        with self._lock:
+            counts = {"total": self.total_count,
+                      "canary": self.canary_count,
+                      "breaker_rejected": self.breaker_rejected,
+                      "stream_failovers": self.stream_failovers,
+                      "stream_midfailures": self.stream_midfailures,
+                      "affinity_hits": self.affinity_hits,
+                      "affinity_failovers": self.affinity_failovers,
+                      "inflight": self.inflight}
+        return {"alive": True, "router": self.name,
+                "uptime_s": round(time.monotonic() - self._start_mono, 3),
+                "build": build_stamp(),
+                "backends": {str(p): s
+                             for p, s in self.circuit_states().items()},
+                "counts": counts}
 
     def take_peak_inflight(self) -> int:
         """Peak concurrency since the last call (autoscaler signal)."""
@@ -489,9 +540,28 @@ class Router:
         candidate order IS the failover order, so a pinned session
         degrades to the next healthy replica and re-pins by itself once
         the affine circuit closes."""
+        if method == "GET" and path == "/metrics":
+            # router-local: the unified registry in Prometheus text, the
+            # same surface ModelServer serves (ISSUE 17 tentpole 2)
+            return 200, render_metrics().encode(), \
+                {"Content-Type": "text/plain; version=0.0.4"}
+        if method == "GET" and path == "/healthz":
+            return 200, json.dumps(self.health()).encode(), None
         self.last_request_time = time.time()
         session_key, wants_stream = self._request_meta(headers, body)
         wants_stream = wants_stream and sink is not None
+        # trace id: adopt the client's X-Trace-Id, mint one otherwise —
+        # the router is the edge, so every hop downstream (server →
+        # supervisor → engine → roles/stages) shares this id
+        trace = None
+        if headers:
+            for k, v in headers.items():
+                if k.lower() == TRACE_HEADER.lower() and v:
+                    trace = str(v)
+                    break
+        if trace is None:
+            trace = new_trace_id()
+        t_mono = time.monotonic()
         headers_sent = False   # SSE headers already on the client socket:
         # retries must continue the body, and errors must be SSE events
         candidates, is_canary, retry_in, affine = self._route(session_key)
@@ -529,7 +599,9 @@ class Router:
             self.peak_inflight = max(self.peak_inflight, self.inflight)
         try:
             last_err: str | None = None
+            hops = 0   # backends actually tried (failover depth)
             for port in candidates:
+                hops += 1
                 with self._lock:
                     c = self._circuits.get(port)
                     if c is not None:
@@ -558,7 +630,8 @@ class Router:
                 try:
                     conn.request(method, path, body=body or None,
                                  headers={"Content-Type":
-                                          "application/json"})
+                                          "application/json",
+                                          TRACE_HEADER: trace})
                     resp = conn.getresponse()
                 except OSError as e:
                     self._record(port, False)
@@ -600,6 +673,11 @@ class Router:
                                 self.affinity_hits += 1
                             else:
                                 self.affinity_failovers += 1
+                    TRACER.record_span(
+                        "router.relay", "http", trace, t_mono,
+                        time.monotonic(), backend=port, hops=hops,
+                        canary=is_canary, streamed=True, outcome=outcome,
+                        tokens_delivered=delivered)
                     return None   # the socket is already written
                 if headers_sent:
                     # the SSE body already started but this retry
@@ -647,6 +725,10 @@ class Router:
                             self.affinity_hits += 1
                         else:
                             self.affinity_failovers += 1
+                TRACER.record_span(
+                    "router.relay", "http", trace, t_mono,
+                    time.monotonic(), backend=port, hops=hops,
+                    canary=is_canary, streamed=False, status=resp.status)
                 return resp.status, data, None
             if headers_sent:
                 # candidates exhausted AFTER the SSE body started: the
@@ -654,6 +736,10 @@ class Router:
                 self._stream_error_event(
                     sink, 0, 0, f"all backends unreachable: {last_err}")
                 return None
+            TRACER.record_span(
+                "router.relay", "http", trace, t_mono, time.monotonic(),
+                hops=hops, canary=is_canary, outcome="unreachable",
+                error=last_err)
             return 502, json.dumps(
                 {"error": f"backend unreachable: {last_err}"}
             ).encode(), None
@@ -674,5 +760,5 @@ class Router:
                 self._default_ports = self._ports(port)
                 self._circuits.setdefault(port, _Circuit(
                     self.failure_threshold, self.circuit_open_s,
-                    self.circuit_open_cap_s))
+                    self.circuit_open_cap_s, backend=str(port)))
         return port

@@ -26,6 +26,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.build import build_stamp
+from kubeflow_tpu.obs.metrics import render_metrics
+from kubeflow_tpu.obs.trace import TRACE_HEADER, TRACER, new_trace_id
 from kubeflow_tpu.serving.batching import DynamicBatcher
 from kubeflow_tpu.serving.model import Model, ModelError, ModelRepository
 from kubeflow_tpu.serving.protocol import (InferRequest, InferResponse,
@@ -105,6 +109,18 @@ class ModelServer:
 
             def do_GET(self):
                 try:
+                    if self.path == "/metrics":
+                        # prometheus text exposition from the ONE process
+                        # registry (ISSUE 17) — not JSON, not per-server
+                        # dict merging
+                        body = render_metrics().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     self._send(*server._handle_get(self.path))
                 except Exception as e:
                     self._send(500, {"error": str(e)})
@@ -124,10 +140,17 @@ class ModelServer:
                         if not isinstance(body, dict):
                             return self._send(
                                 400, {"error": "body must be an object"})
+                        # trace id: the router's X-Trace-Id header, or
+                        # minted here — this IS the edge for direct
+                        # clients. Sampling decides later whether any
+                        # span records for it.
+                        trace = (self.headers.get(TRACE_HEADER)
+                                 or new_trace_id())
                         if body.get("stream"):
                             return server._stream_completion(self, body,
-                                                             chat)
-                        return self._send(*server._completion(body, chat))
+                                                             chat, trace)
+                        return self._send(
+                            *server._completion(body, chat, trace))
                     self._send(*server._handle_post(self.path, raw))
                 except Exception as e:
                     self._send(500, {"error": str(e)})
@@ -186,17 +209,32 @@ class ModelServer:
         round-trip."""
         body: dict[str, Any] = {
             "alive": self.alive, "name": self.name,
-            "uptime_s": round(time.monotonic() - self._t_start, 3)}
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            # version/runtime identification (ISSUE 17): kubeflow_tpu +
+            # jax/jaxlib versions and the device the process landed on —
+            # a fleet operator ties a misbehaving replica to its build
+            # without shelling into the pod
+            "build": build_stamp()}
         caches: dict[str, Any] = {}
         sups: dict[str, Any] = {}
         disaggs: dict[str, Any] = {}
         meshes: dict[str, Any] = {}
+        slos: dict[str, Any] = {}
         for mname in self.repository.names():
             try:
-                mm = self.repository.get(mname).metrics()
+                model = self.repository.get(mname)
+                mm = model.metrics()
             except Exception:
                 continue   # liveness must answer even if a model is
                 # mid-load/broken — health first, detail best-effort
+            trk = getattr(model, "slo_tracker", None)
+            if trk is not None:
+                try:
+                    s = trk.summary()
+                    if s["aggregate"]["n"]:
+                        slos[mname] = s
+                except Exception:
+                    pass   # burn accounting is detail, never liveness
             pc = (mm or {}).get("prefix_cache")
             if pc:
                 caches[mname] = pc
@@ -248,6 +286,8 @@ class ModelServer:
             body["disagg"] = disaggs
         if meshes:
             body["mesh"] = meshes
+        if slos:
+            body["slo"] = slos
         return body
 
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:
@@ -519,11 +559,14 @@ class ModelServer:
             choice["text"] = text
         return choice
 
-    def _completion(self, body: dict[str, Any], chat: bool = False
-                    ) -> tuple[int, dict[str, Any]]:
+    def _completion(self, body: dict[str, Any], chat: bool = False,
+                    trace: str | None = None) -> tuple[int, dict[str, Any]]:
         t0 = time.perf_counter()
+        t_mono = time.monotonic()
         try:
             m, payload = self._completion_request(body, chat)
+            if trace:
+                payload["trace"] = str(trace)
             best_of = payload.get("best_of", 1)
             if best_of <= 1:
                 results = [m.complete(payload)]
@@ -539,6 +582,9 @@ class ModelServer:
         except self._completion_exceptions() as e:
             return self._completion_error(e)
         self._observe(m.name, "completions", time.perf_counter() - t0)
+        TRACER.record_span("server.http", "http", trace, t_mono,
+                           time.monotonic(), model=m.name,
+                           verb="completions", streamed=False)
         n_choices = payload.get("n", 1)
         if len(results) > 1:
             # OpenAI best_of: return the n best by per-token logprob
@@ -596,7 +642,8 @@ class ModelServer:
             "usage": usage}
 
     def _stream_completion(self, handler, body: dict[str, Any],
-                           chat: bool = False) -> None:
+                           chat: bool = False,
+                           trace: str | None = None) -> None:
         """Server-sent events: one `data: {...}` chunk per token carrying
         the incremental TEXT delta (multi-byte sequences decode across
         chunk boundaries), a final chunk with finish_reason, then
@@ -607,8 +654,11 @@ class ModelServer:
         from kubeflow_tpu.serving.tokenizer import StreamDecoder
 
         finish: list[str] = []
+        t_mono = time.monotonic()
         try:
             m, payload = self._completion_request(body, chat)
+            if trace:
+                payload["trace"] = str(trace)
             if payload.get("best_of", 1) > 1 or payload.get("n", 1) > 1:
                 raise ProtocolError(
                     "streaming supports n=1 / best_of=1 only")
@@ -730,6 +780,10 @@ class ModelServer:
             # the live-generator case (disconnect) cancels + releases
             token_iter.close()
         self._observe(m.name, "completions", time.perf_counter() - t0)
+        TRACER.record_span("server.http", "http", trace, t_mono,
+                           time.monotonic(), model=m.name,
+                           verb="completions", streamed=True,
+                           tokens_sent=n_sent)
 
     # -- dataplanes -----------------------------------------------------------
 
@@ -751,6 +805,12 @@ class ModelServer:
             key = (model, verb)
             self.request_count[key] = self.request_count.get(key, 0) + 1
             self.latency_sum[model] = self.latency_sum.get(model, 0.0) + dt
+        # the same observation feeds the process registry (GET /metrics
+        # prometheus text); the per-instance dicts above stay the
+        # metrics() JSON view so its shape survives multi-server tests
+        # sharing one process registry
+        obs_metrics.HTTP_REQUESTS.inc(model=model, verb=verb)
+        obs_metrics.HTTP_LATENCY.observe(dt, model=model, verb=verb)
 
     def _logged(self, name: str, t0: float, code: int,
                 resp: dict[str, Any], rid: str | None

@@ -241,6 +241,30 @@ def test_request_timing_phase_split_disagg(disagg):
     disagg.release(rid)
 
 
+def test_request_timing_handoff_split_partitions_wall(disagg):
+    """ISSUE 17 satellite: handoff_ms is its own phase (KV transfer +
+    decode admission), no longer folded into prefill — and the four
+    phases partition submit → finish EXACTLY (only the per-phase 3-dp
+    rounding separates their sum from the wall)."""
+    rid = disagg.submit(list(range(1, 20)), 6)   # >=1 block: harvests
+    disagg.run_until_idle()
+    tm = disagg.request_timing(rid)
+    assert tm["handoff_ms"] is not None and tm["handoff_ms"] >= 0
+    total_ms = (tm["finish_s"] - tm["submit_s"]) * 1e3
+    parts = (tm["queue_wait_ms"] + tm["prefill_ms"]
+             + tm["handoff_ms"] + tm["decode_ms"])
+    assert parts == pytest.approx(total_ms, abs=0.01)
+    disagg.release(rid)
+    # bypass (shorter than one block): never harvests — handoff_ms is
+    # None and prefill_ms keeps its legacy queue-exit → first-token span
+    rid = disagg.submit([5, 6, 7], 4)
+    disagg.run_until_idle()
+    tm = disagg.request_timing(rid)
+    assert tm["handoff_ms"] is None
+    assert tm["prefill_ms"] is not None
+    disagg.release(rid)
+
+
 def test_cancel_in_every_stage(disagg):
     # queued: never dispatched (pump has not run)
     rid = disagg.submit(list(range(1, 20)), 8)

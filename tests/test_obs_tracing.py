@@ -1,0 +1,522 @@
+"""Request-scoped tracing + unified /metrics + SLO burn (ISSUE 17
+tentpole). Unit half: the obs primitives (bounded span ring,
+deterministic sampling, JSONL export, SLO burn math, weakref scrape
+hooks). E2E half, over real sockets: ONE trace id minted at the router
+rides `X-Trace-Id` through router relay → server handler → supervisor
+journal → engine phases, and a supervisor crash-replay keeps the
+original attempt, the restart, and the resumed generation under the
+SAME trace id. Plus the /metrics Prometheus-text and /healthz payload
+shapes on both frontends, and the heartbeat / circuit-breaker series
+under injected chaos."""
+
+from __future__ import annotations
+
+import gc
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.chaos import (FaultInjector, FaultScriptConfig,
+                                FaultSpec, generate_fault_script)
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs.metrics import render_metrics
+from kubeflow_tpu.obs.slo import SloBurnTracker
+from kubeflow_tpu.obs.trace import (TRACE_HEADER, TRACER, NOOP_SPAN,
+                                    SpanSink, StepAggregator, Tracer,
+                                    new_trace_id)
+from kubeflow_tpu.serving.llm_runtime import LLMModel
+from kubeflow_tpu.serving.model import ModelRepository, load_model
+from kubeflow_tpu.serving.router import OPEN, Router
+from kubeflow_tpu.serving.server import ModelServer
+
+# -- unit: span ring + sampling ----------------------------------------------
+
+
+def test_span_ring_is_bounded_and_counts_drops():
+    sink = SpanSink(capacity=4)
+    tr = Tracer(sink=sink, sample_rate=1.0)
+    for i in range(6):
+        tr.record_span(f"s{i}", "queue", "t" * 8, 0.0, 1.0)
+    assert len(sink) == 4
+    assert sink.dropped == 2
+    assert [s.name for s in sink.spans()] == ["s2", "s3", "s4", "s5"]
+    sink.clear()
+    assert len(sink) == 0 and sink.dropped == 0
+
+
+def test_sampling_is_deterministic_per_trace_id():
+    """The keep/drop verdict is a pure function of the trace id: two
+    independent tracers at the same rate agree on every id — how the
+    router, supervisor, and engine reach one decision with no shared
+    state."""
+    a = Tracer(sample_rate=0.5)
+    b = Tracer(sample_rate=0.5)
+    ids = [new_trace_id() for _ in range(400)]
+    verdicts = [a.sampled(t) for t in ids]
+    assert verdicts == [b.sampled(t) for t in ids]
+    kept = sum(verdicts)
+    assert 100 < kept < 300          # ~0.5, loose bound
+    assert all(Tracer(sample_rate=1.0).sampled(t) for t in ids)
+    assert not any(Tracer(sample_rate=0.0).sampled(t) for t in ids)
+    assert not a.sampled(None) and not a.sampled("")
+
+
+def test_sampled_out_spans_cost_nothing_and_guards_hold():
+    sink = SpanSink()
+    tr = Tracer(sink=sink, sample_rate=0.0)
+    assert tr.span("x", "queue", new_trace_id()) is NOOP_SPAN
+    NOOP_SPAN.set(a=1).end()          # absorbs silently
+    tr.record_span("x", "queue", new_trace_id(), 0.0, 1.0)
+    tr.set_sample_rate(1.0)
+    tr.record_span("x", "queue", "tid", None, 1.0)   # half-open: dropped
+    tr.record_span("x", "queue", "tid", 0.0, None)
+    assert len(sink) == 0
+    sp = tr.span("y", "decode", "tid", start_s=1.0)
+    sp.end(end_s=3.0)
+    sp.end(end_s=9.0)                 # idempotent: exports once
+    assert len(sink) == 1
+    assert sink.spans()[0].duration_ms() == 2000.0
+    assert tr.set_sample_rate(7.0) == 1.0    # clamped
+    assert tr.set_sample_rate(-1.0) == 0.0
+
+
+def test_jsonl_export_filters_and_roundtrips(tmp_path):
+    sink = SpanSink()
+    tr = Tracer(sink=sink, sample_rate=1.0)
+    t1, t2 = new_trace_id(), new_trace_id()
+    tr.record_span("a", "queue", t1, 0.0, 1.0, backend="x")
+    tr.record_span("b", "decode", t2, 1.0, 2.0)
+    tr.record_span("c", "http", t1, 2.0, 3.0)
+    text = sink.export_jsonl()
+    lines = [json.loads(ln) for ln in text.splitlines()]
+    assert [ln["name"] for ln in lines] == ["a", "b", "c"]
+    assert lines[0]["attrs"] == {"backend": "x"}
+    only_t1 = sink.export_jsonl(trace_id=t1)
+    assert [json.loads(ln)["name"]
+            for ln in only_t1.splitlines()] == ["a", "c"]
+    p = tmp_path / "trace.jsonl"
+    sink.export_jsonl(path=str(p), trace_id=t2)
+    assert json.loads(p.read_text())["name"] == "b"
+
+
+def test_step_aggregator_window():
+    agg = StepAggregator()
+    before = agg.snapshot()
+    agg.note_step(8, steps=2)
+    agg.note_step(3)
+    w = StepAggregator.window(before, agg.snapshot())
+    assert w == {"decode_steps": 3, "decode_tokens": 11}
+
+
+# -- unit: SLO burn -----------------------------------------------------------
+
+
+def test_slo_burn_tracker_math():
+    """Hand-computable: 4 requests, 1 TTFT miss → attainment 0.75,
+    burn = (1 - 0.75) / 0.01 budget = 25x."""
+    slo = SloBurnTracker(ttft_slo_ms=100.0, tpot_slo_ms=10.0,
+                         window_s=300.0, budget=0.01)
+    for ttft in (50.0, 80.0, 90.0):
+        slo.record("t0", ttft, 5.0)
+    slo.record("t0", 500.0, 5.0)              # TTFT miss
+    s = slo.summary()
+    assert s["slo"] == {"ttft_ms": 100.0, "tpot_ms": 10.0,
+                        "error_budget": 0.01}
+    t0 = s["tenants"]["t0"]
+    assert t0["n"] == 4 and t0["met"] == 3
+    assert t0["attainment"] == pytest.approx(0.75)
+    assert t0["burn_rate"] == pytest.approx(25.0)
+    assert s["aggregate"]["n"] == 4
+    # a not-completed request is a miss even with perfect latencies
+    slo.record("t1", 10.0, 1.0, completed=False)
+    assert slo.summary()["tenants"]["t1"]["met"] == 0
+    # window: samples age out
+    old = SloBurnTracker(ttft_slo_ms=100.0, tpot_slo_ms=10.0,
+                         window_s=1.0)
+    old.record("t", 500.0, 5.0, now=time.monotonic() - 10.0)
+    assert "t" not in old.summary()["tenants"]
+
+
+def test_slo_burn_publishes_gauges_through_scrape_hook():
+    slo = SloBurnTracker(ttft_slo_ms=100.0, tpot_slo_ms=10.0)
+    slo.record("tenantA", 50.0, 5.0)
+    obs_metrics.add_scrape_hook(slo, type(slo).publish)
+    try:
+        text = render_metrics()
+        assert 'slo_attainment{tenant="tenantA"} 1' in text
+        assert 'slo_burn_rate{tenant="tenantA"} 0' in text
+        assert 'slo_attainment{tenant="_aggregate"}' in text
+    finally:
+        obs_metrics.remove_scrape_hooks(slo)
+
+
+# -- unit: scrape hooks + render shape ---------------------------------------
+
+
+def test_scrape_hooks_are_weakref_and_crash_isolated():
+    class Owner:
+        def publish(self):
+            obs_metrics.INFLIGHT.set(7, component="hooktest")
+
+    calls = []
+    owner = Owner()
+    obs_metrics.add_scrape_hook(owner, Owner.publish)
+
+    class Bomb:
+        def boom(self):
+            calls.append(1)
+            raise RuntimeError("dying component")
+
+    bomb = Bomb()
+    obs_metrics.add_scrape_hook(bomb, Bomb.boom)
+    try:
+        text = render_metrics()     # bomb raises; render survives
+        assert calls == [1]
+        assert 'serving_inflight{component="hooktest"} 7' in text
+        del owner
+        gc.collect()
+        obs_metrics.INFLIGHT.set(0, component="hooktest")
+        text = render_metrics()
+        # the collected owner's hook is gone: nothing re-set the gauge
+        assert 'serving_inflight{component="hooktest"} 0' in text
+    finally:
+        obs_metrics.remove_scrape_hooks(bomb)
+
+
+def test_render_metrics_is_prometheus_text():
+    obs_metrics.REQUESTS.inc(component="unittest", event="completed")
+    text = render_metrics()
+    assert "# HELP serving_requests_total" in text
+    assert "# TYPE serving_requests_total counter" in text
+    assert re.search(r'serving_requests_total\{component="unittest",'
+                     r'event="completed"\} \d+', text)
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert "trace_buffer_spans" in text
+    assert text.endswith("\n")
+
+
+# -- e2e: one trace id across router → server → supervisor → engine -----------
+
+PROMPT = [72, 105, 33]
+MAX_TOKENS = 12
+
+
+def _crash_now(seed: int = 1):
+    return generate_fault_script(FaultScriptConfig(
+        seed=seed, duration_s=1.0,
+        faults=(FaultSpec("backend_crash", 1, (0.0, 0.0)),)), name="now")
+
+
+@pytest.fixture(scope="module")
+def llm_server():
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=64, attention_impl="xla",
+                            dtype=jnp.float32, remat=False)
+    m = LLMModel("llm", model={k: getattr(cfg, k) for k in
+                               ("vocab_size", "d_model", "n_layers",
+                                "n_heads", "n_kv_heads", "d_ff",
+                                "max_seq_len", "attention_impl",
+                                "remat")},
+                 n_slots=2, max_len=64, buckets=(8, 16), seed=0,
+                 decode_chunk=2,
+                 supervisor={"stall_timeout_s": 30.0,
+                             "backoff_base_s": 0.3,
+                             "backoff_cap_s": 0.6,
+                             "rewarm": False},
+                 sse_keepalive_s=0.05)
+    repo = ModelRepository()
+    repo.register(m)
+    server = ModelServer(repo).start()
+    yield m, server
+    server.stop()
+    m.unload()
+
+
+def _post_completion(port: int, trace_id: str, timeout=120.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/openai/v1/completions",
+        data=json.dumps({"model": "llm", "prompt": PROMPT,
+                         "max_tokens": MAX_TOKENS,
+                         "temperature": 0.0}).encode(),
+        headers={"Content-Type": "application/json",
+                 TRACE_HEADER: trace_id}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+def test_one_trace_id_spans_router_to_engine(llm_server):
+    """THE tentpole acceptance path: a trace id presented to the ROUTER
+    is honored (not re-minted) and every layer's span lands under it —
+    router relay, server handler, supervisor journal lifetime, engine
+    queue/prefill/decode — exportable as one JSONL chain."""
+    m, server = llm_server
+    r = Router("t/obs")
+    trace_id = "ab" * 16
+    try:
+        r.set_backends(server.port)
+        body = _post_completion(r.port, trace_id)
+        assert body["choices"][0]["text"]
+    finally:
+        r.stop()
+    spans = TRACER.sink.spans(trace_id)
+    names = {s.name for s in spans}
+    assert {"router.relay", "server.http", "supervisor.supervise",
+            "engine.queue", "engine.prefill",
+            "engine.decode"} <= names, names
+    by_name = {s.name: s for s in spans}
+    assert by_name["router.relay"].kind == "http"
+    assert by_name["router.relay"].attrs["backend"] == server.port
+    assert by_name["engine.decode"].kind == "decode"
+    # the decode span carries the aggregate step counters, never
+    # per-token children
+    # the first token comes from prefill, the window covers the rest
+    assert by_name["engine.decode"].attrs["decode_tokens"] >= MAX_TOKENS - 1
+    assert by_name["engine.decode"].attrs["decode_steps"] >= 1
+    kinds = {s.kind for s in spans}
+    assert "decode" in kinds and "http" in kinds and "supervise" in kinds
+    # exported JSONL carries the whole chain under the one id
+    lines = [json.loads(ln) for ln in
+             TRACER.sink.export_jsonl(trace_id=trace_id).splitlines()]
+    assert {ln["trace_id"] for ln in lines} == {trace_id}
+    assert {ln["name"] for ln in lines} >= names
+
+
+@pytest.mark.slow
+def test_crash_replay_stays_under_one_trace_id(llm_server):
+    """A request that survives a mid-generation engine crash (journal
+    replay) keeps its ORIGINAL trace id: the exported chain shows the
+    killed first attempt, the restart window, and the resumed
+    generation as one story — even though the crashed engine never got
+    to emit its own spans (the journal is the only witness)."""
+    import http.client
+    import threading
+
+    m, server = llm_server
+    trace_id = "cd" * 16
+    sup = m.supervisor
+    replayed0 = sup.accounting()["replayed"]
+    out_box: list[list[int]] = []
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=120)
+        conn.request(
+            "POST", "/openai/v1/completions",
+            body=json.dumps({"model": "llm", "prompt": PROMPT,
+                             "max_tokens": MAX_TOKENS,
+                             "temperature": 0.0,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: trace_id})
+        resp = conn.getresponse()
+        toks: list[int] = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):].strip()
+            if data == b"[DONE]":
+                break
+            for c in json.loads(data).get("choices", ()):
+                if c.get("token_id") is not None:
+                    toks.append(int(c["token_id"]))
+        out_box.append(toks)
+        conn.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    # arm on server-side truth: >=2 tokens journaled and in flight, so
+    # the kill provably lands mid-generation (the chaos-test idiom)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with sup._lock:
+            n = max((len(e.base_tokens) + len(e.tokens)
+                     for e in sup._journal.values() if not e.terminal),
+                    default=None)
+        if n is not None and n >= 2:
+            break
+        time.sleep(0.001)
+    else:
+        pytest.fail("stream never reached 2 in-flight tokens")
+    sup.arm_faults(_crash_now(seed=31))
+    t.join(timeout=120)
+    assert not t.is_alive(), "stream hung through the crash"
+    assert len(out_box[0]) == MAX_TOKENS
+    assert sup.accounting()["replayed"] >= replayed0 + 1
+    spans = TRACER.sink.spans(trace_id)
+    names = {s.name: s for s in spans}
+    assert "supervisor.attempt" in names      # the killed first attempt
+    att = names["supervisor.attempt"]
+    assert att.attrs["outcome"] == "killed"
+    assert att.attrs["tokens_delivered"] >= 2
+    assert "supervisor.restart" in names      # the restart window
+    assert names["supervisor.resume"].attrs["mode"] == "replayed"
+    assert "engine.decode" in names           # the resumed generation
+    assert {s.trace_id for s in spans} == {trace_id}
+    assert "replayed" in names["supervisor.supervise"].attrs["chain"]
+
+
+def test_server_metrics_and_healthz_payloads(llm_server):
+    m, server = llm_server
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert "text/plain" in r.headers.get("Content-Type", "")
+        text = r.read().decode()
+    assert "# TYPE serving_requests_total counter" in text
+    assert 'serving_http_requests_total{model="llm",verb="completions"}' \
+        in text
+    assert re.search(r'supervisor_restarts_total\{cause=', text)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["alive"] is True
+    assert health["uptime_s"] >= 0
+    assert health["build"]["kubeflow_tpu"]
+    assert "platform" in health["build"]
+    assert "slo" in health
+    # the pre-obs JSON metrics view survives unchanged for callers
+    mm = server._metrics()
+    assert "request_count" in mm and "latency_sum_s" in mm
+
+
+def test_router_metrics_and_healthz_payloads():
+    repo = ModelRepository()
+    repo.register(load_model("mean", "m"))
+    a = ModelServer(repo).start()
+    r = Router("t/obs-metrics")
+    try:
+        r.set_backends(a.port)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers.get("Content-Type", "")
+            text = resp.read().decode()
+        assert f'router_circuit_state{{backend="{a.port}"}} 0' in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["alive"] is True and health["router"] == "t/obs-metrics"
+        assert health["uptime_s"] >= 0
+        assert health["build"]["kubeflow_tpu"]
+        assert health["backends"] == {str(a.port): "closed"}
+    finally:
+        r.stop()
+        a.stop()
+
+
+# -- chaos-driven metric series ----------------------------------------------
+
+
+def _metric_value(text: str, series: str) -> float | None:
+    m = re.search(rf"^{re.escape(series)} ([0-9.e+-]+)$", text,
+                  flags=re.M)
+    return float(m.group(1)) if m else None
+
+
+def test_circuit_breaker_transitions_visible_in_metrics():
+    """An injected router↔backend partition trips the breaker: the
+    per-backend state gauge walks closed→open→half_open→closed and the
+    transitions counter records each entry — all readable from
+    /metrics while it happens."""
+    repo = ModelRepository()
+    repo.register(load_model("mean", "m"))
+    a = ModelServer(repo).start()
+    script = generate_fault_script(FaultScriptConfig(
+        seed=7, duration_s=10.0,
+        faults=(FaultSpec("partition", 1, (0.0, 0.0), (0.6, 0.6)),)),
+        name="part")
+    inj = FaultInjector(script)
+    r = Router("t/obs-cb", failure_threshold=1, circuit_open_s=0.2)
+    series = f'router_circuit_transitions_total{{backend="{a.port}"'
+    try:
+        r.set_backends(a.port)
+        r.set_fault_injector(inj)
+        inj.start()
+        req = urllib.request.Request(
+            r.url + "/v1/models/m:predict",
+            data=json.dumps({"instances": [[1.0, 3.0]]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError:
+            pass                      # 502: partitioned single backend
+        text = render_metrics()
+        assert _metric_value(
+            text, f'router_circuit_state{{backend="{a.port}"}}') == 2
+        opens = _metric_value(text, series + ',to="open"}')
+        assert opens and opens >= 1
+        time.sleep(0.75)              # partition over, hold-off expired
+        assert r.circuit_states()[a.port] != OPEN
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200     # the half-open probe closes it
+        text = render_metrics()
+        assert _metric_value(
+            text, f'router_circuit_state{{backend="{a.port}"}}') == 0
+        assert _metric_value(text, series + ',to="half_open"}') >= 1
+        assert _metric_value(text, series + ',to="closed"}') >= 1
+    finally:
+        r.stop()
+        a.stop()
+
+
+def test_heartbeat_metrics_under_drop_chaos():
+    """heartbeat_drop chaos suppresses sends: the dropped counter grows
+    while consecutive_failures stays 0 (drops are not failures); a
+    genuinely failing reporter walks the failure gauge up and latches
+    reporter_dead — each step visible in /metrics."""
+    from kubeflow_tpu.runtime.heartbeat import HeartbeatReporter
+    from kubeflow_tpu.runtime.rendezvous import PyCoordinatorServer
+
+    srv = PyCoordinatorServer(hb_ttl_s=5.0)
+    script = generate_fault_script(FaultScriptConfig(
+        seed=11, duration_s=10.0,
+        faults=(FaultSpec("heartbeat_drop", 1, (0.0, 0.0),
+                          (0.6, 0.6)),)), name="drop")
+    inj = FaultInjector(script)
+    inj.start()
+    text0 = render_metrics()
+    dropped0 = _metric_value(
+        text0, 'heartbeat_events_total{event="dropped"}') or 0
+    hb = HeartbeatReporter(srv.address, "hb-obs", 1, 0, "10.0.0.1:5000",
+                           0.15, max_consecutive_failures=2,
+                           injector=inj)
+    try:
+        deadline = time.monotonic() + 10
+        while hb.dropped < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hb.dropped >= 2, "no beats dropped"
+        text = render_metrics()
+        assert _metric_value(
+            text, 'heartbeat_events_total{event="dropped"}') \
+            >= dropped0 + 2
+        assert _metric_value(text, "heartbeat_consecutive_failures") == 0
+        assert _metric_value(text, "heartbeat_reporter_dead") == 0
+
+        def always_fail(gang, rank):
+            raise ConnectionResetError("injected: coordinator gone")
+
+        hb._client.heartbeat = always_fail
+        deadline = time.monotonic() + 10
+        while not hb.reporter_dead and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hb.reporter_dead
+        text = render_metrics()
+        assert _metric_value(text, "heartbeat_reporter_dead") == 1
+        assert _metric_value(text, "heartbeat_consecutive_failures") >= 2
+        failed = _metric_value(
+            text, 'heartbeat_events_total{event="failed"}')
+        assert failed and failed >= 2
+    finally:
+        hb.stop(mark_done=False)
+        srv.stop()

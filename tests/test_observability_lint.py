@@ -1,0 +1,158 @@
+"""Fast-lane observability lint (ISSUE 17 satellite): metric names are
+minted only in the central registry modules, and decode hot paths never
+create spans (StepAggregator is the only hot-loop recorder).
+scripts/check_observability.py is the CI entrypoint; these tests run it
+in-process so the fast lane fails the moment either invariant breaks."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_observability", os.path.join(REPO, "scripts",
+                                            "check_observability.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_observability_is_clean():
+    lint = _load_lint()
+    findings = lint.check()
+    assert findings == [], "\n".join(findings)
+
+
+def test_lint_runs_as_a_script():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_observability.py")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "check_observability: ok" in out.stdout
+
+
+def test_lint_flags_instrument_minted_outside_registry(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "from kubeflow_tpu.utils.metrics import REGISTRY\n"
+        "MY_COUNTER = REGISTRY.counter('rogue_requests_total', 'oops')\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "rogue.py:2" in findings[0]
+    assert "rogue_requests_total" in findings[0]
+    assert "central registry" in findings[0]
+
+
+def test_lint_allows_instruments_in_registry_modules(tmp_path):
+    lint = _load_lint()
+    obs = tmp_path / "kubeflow_tpu" / "obs"
+    obs.mkdir(parents=True)
+    (obs / "metrics.py").write_text(
+        "from kubeflow_tpu.utils.metrics import REGISTRY\n"
+        "FINE = REGISTRY.counter('fine_total', 'fine')\n"
+        "G = REGISTRY.gauge('fine_gauge', 'fine')\n"
+        "H = REGISTRY.histogram('fine_seconds', 'fine')\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_allows_instrument_use_everywhere(tmp_path):
+    """Bumping an imported instrument is the sanctioned pattern — only
+    CREATION (a string-literal name) is pinned to the registry
+    modules."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "fine.py").write_text(
+        "from kubeflow_tpu.obs import metrics as obs_metrics\n"
+        "def handle():\n"
+        "    obs_metrics.REQUESTS.inc(component='engine', "
+        "event='completed')\n"
+        "    obs_metrics.TTFT.observe(0.1, component='engine')\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_flags_span_in_decode_hot_path(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "llm.py").write_text(
+        "from kubeflow_tpu.obs.trace import TRACER\n"
+        "class LLMEngine:\n"
+        "    def _do_decode(self):\n"
+        "        for step in range(4):\n"
+        "            TRACER.record_span('tok', 'decode', 'tid', 0.0, "
+        "1.0)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "llm.py:5" in findings[0]
+    assert "StepAggregator.note_step" in findings[0]
+
+
+def test_lint_flags_span_in_nested_hot_helper(tmp_path):
+    """Lexical nesting counts: a closure defined inside step() is on
+    the hot path even though its own name is innocuous."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "llm.py").write_text(
+        "from kubeflow_tpu.obs.trace import TRACER\n"
+        "class LLMEngine:\n"
+        "    def step(self):\n"
+        "        def emit():\n"
+        "            with TRACER.span('s', 'decode', 'tid'):\n"
+        "                pass\n"
+        "        emit()\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "step/emit" in findings[0]
+
+
+def test_lint_allows_retrospective_span_at_finish(tmp_path):
+    """_obs_finish is off the hot path: the one retrospective span per
+    request per phase is exactly the sanctioned design."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "llm.py").write_text(
+        "from kubeflow_tpu.obs.trace import TRACER\n"
+        "class LLMEngine:\n"
+        "    def _do_decode(self):\n"
+        "        self._decode_agg.note_step(4, steps=1)\n"
+        "    def _obs_finish(self, req_id):\n"
+        "        TRACER.record_span('engine.decode', 'decode', 'tid',\n"
+        "                           0.0, 1.0)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_hot_rule_scoped_to_engine_files(tmp_path):
+    """A step() in some unrelated module is not a decode loop — the
+    hot-path rule binds (file, function) pairs, not bare names."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "runtime"
+    pkg.mkdir(parents=True)
+    (pkg / "other.py").write_text(
+        "from kubeflow_tpu.obs.trace import TRACER\n"
+        "def step():\n"
+        "    with TRACER.span('s', 'http', 'tid'):\n"
+        "        pass\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []

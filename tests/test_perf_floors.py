@@ -270,6 +270,69 @@ def test_kernel_floors_gated_on_schema_9(tmp_path):
                for f in bench.check_floors(str(p)))
 
 
+def test_observability_floors_gated_on_schema_10(tmp_path):
+    """serving_observability's floors (r16) only bind records new
+    enough to carry the tracing-on-vs-off A/B: every pre-r16 committed
+    record stays valid, a schema-10 record missing the section fails
+    loudly, and a schema-10 record holding both contracts is green.
+    Parity is exact (0.99 fails); the overhead ratio floors at 0.95
+    (tracing may cost at most ~5% TPOT)."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 10   # committed record predates r16
+    assert not any(f.startswith("obs_")
+                   for f in bench.check_floors(_RECORD))
+
+    rec10 = json.loads(json.dumps(rec))
+    rec10["schema"] = 10
+    p = tmp_path / "rec10.json"
+    p.write_text(json.dumps(rec10))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("obs_greedy_parity") for f in fails)
+    assert any(f.startswith("obs_tpot_overhead_ratio") for f in fails)
+
+    rec10["extras"]["serving_observability"] = {
+        "obs_greedy_parity": 1.0, "obs_tpot_overhead_ratio": 1.01}
+    p.write_text(json.dumps(rec10))
+    assert not any(f.startswith("obs_")
+                   for f in bench.check_floors(str(p)))
+
+    rec10["extras"]["serving_observability"]["obs_greedy_parity"] = 0.99
+    rec10["extras"]["serving_observability"][
+        "obs_tpot_overhead_ratio"] = 0.90
+    p.write_text(json.dumps(rec10))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("obs_greedy_parity") for f in fails)
+    assert any(f.startswith("obs_tpot_overhead_ratio") for f in fails)
+
+
+def test_slo_burn_summary_reads_the_record(tmp_path):
+    """--check's SLO-burn line: None for records predating the section,
+    the aggregate + worst-tenant reduction once it exists."""
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps({"headline": {"value": 1}, "extras": {}}))
+    assert bench.slo_burn_summary(str(p)) is None
+    p.write_text(json.dumps({
+        "schema": 10, "headline": {"value": 1},
+        "extras": {"serving_observability": {"slo_burn": {
+            "window_s": 300.0,
+            "slo": {"ttft_ms": 2000.0, "tpot_ms": 500.0},
+            "aggregate": {"n": 10, "met": 9, "attainment": 0.9,
+                          "burn_rate": 10.0},
+            "tenants": {
+                "t0": {"n": 5, "met": 5, "attainment": 1.0,
+                       "burn_rate": 0.0},
+                "t1": {"n": 5, "met": 4, "attainment": 0.8,
+                       "burn_rate": 20.0}}}}}}))
+    burn = bench.slo_burn_summary(str(p))
+    assert burn["aggregate"]["burn_rate"] == 10.0
+    assert burn["worst_tenant"]["tenant"] == "t1"
+    assert burn["worst_tenant"]["burn_rate"] == 20.0
+    assert burn["n_tenants"] == 2
+
+
 def test_schema_gates_table_matches_floors(tmp_path):
     """SCHEMA_GATES drives the --check 'gated out' report: every gated
     name must be a real floor, and gated_out_floors() must list exactly

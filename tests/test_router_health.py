@@ -47,8 +47,9 @@ def _get(url: str, path: str = "/v1/models/m:predict",
 
 
 def _served_count(server: ModelServer) -> int:
-    with urllib.request.urlopen(server.url + "/metrics", timeout=5) as r:
-        m = json.loads(r.read())
+    # /metrics is Prometheus text now (ISSUE 17); the per-instance JSON
+    # view survives as ModelServer._metrics()
+    m = server._metrics()
     return sum(m["request_count"].values())
 
 

@@ -10,6 +10,7 @@ import http.client
 import json
 import threading
 import time
+import urllib.request
 
 import numpy as np
 import pytest
@@ -127,7 +128,15 @@ class TestModelServer:
         assert code == 404 and "explain" in out["error"]
         http_json(server.url, "POST", "/v1/models/sq:predict",
                   {"instances": [[1]]})
-        _, metrics = http_json(server.url, "GET", "/metrics")
+        # GET /metrics now serves the unified registry in Prometheus
+        # text (ISSUE 17); the JSON view survives as model.metrics()
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=5) as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert ('serving_http_requests_total{model="sq",'
+                'verb="predict"}') in text
+        metrics = server._metrics()
         assert metrics["request_count"]["sq:predict"] >= 1
 
 
