@@ -311,6 +311,12 @@ def main() -> None:
         except Exception as e:
             extras["serving_observability_error"] = \
                 f"{type(e).__name__}: {e}"
+    if _budget_gate(extras, budget, "serving_paged_kv"):
+        try:
+            extras["serving_paged_kv"] = serving_paged_kv_bench(
+                on_tpu, budget)
+        except Exception as e:
+            extras["serving_paged_kv_error"] = f"{type(e).__name__}: {e}"
     extras["budget"] = {"total_s": budget.total_s,
                         "used_s": round(budget.elapsed(), 1),
                         "env": BUDGET_ENV}
@@ -358,11 +364,14 @@ def main() -> None:
         # exact parity contract); schema 10 adds serving_observability
         # (the tracing-on-vs-off A/B: byte parity under sampled traces
         # + bounded TPOT overhead + the SLO-burn summary `--check`
-        # prints). The floor gate only demands a
+        # prints); schema 11 adds serving_paged_kv (the slab-vs-paged
+        # equal-KV-bytes A/B on the long_tail_mix trace: byte parity
+        # incl. forced eviction + oversubscription, peak in-flight
+        # streams, goodput-per-GiB-of-KV). The floor gate only demands a
         # section's metrics from records new enough to know about it
         # (older committed records stay valid under --check; `--check`
         # lists which floors a record's schema gates out).
-        json.dump({"schema": 10, "headline": headline, "extras": extras},
+        json.dump({"schema": 11, "headline": headline, "extras": extras},
                   f, indent=1)
         f.write("\n")
     failures = check_floors(extras_path) if on_tpu else []
@@ -494,6 +503,22 @@ PERF_FLOORS = {
     # span design (aggregate counters only in the decode loop, spans
     # minted once per request at finish) should hold it trivially.
     "obs_tpot_overhead_ratio": 0.95,
+    # serving_paged_kv (r17): enforced only on schema>=11 records.
+    # EXACT contract, not a perf number: greedy AND seeded tokens
+    # through the paged engine (block-table KV, radix-owned pool) must
+    # be byte-identical to the slab engine's — including recompute-
+    # from-prefix after a forced full eviction and an oversubscribed
+    # burst where admission holds + retries through eviction. All-or-
+    # nothing product, floor exactly 1.0.
+    "paged_greedy_parity": 1.0,
+    # THE acceptance product (ISSUE 19): at EQUAL KV bytes (paged pool
+    # = the slab engine's token budget, +1 trash block) the paged
+    # engine at 4S slots must hold 4x the slab engine's peak in-flight
+    # streams on the heavy-tailed long_tail_mix trace. Both engines
+    # saturate their slot tables under the pinned offered load, so the
+    # ratio is structurally 4S/S — the floor guards the admission path
+    # ever failing to fund what the freed tail bytes can hold.
+    "paged_concurrency_gain": 4.0,
 }
 
 #: floor name → the record schema that introduced it (names absent here
@@ -517,6 +542,8 @@ SCHEMA_GATES = {
     "kernel_greedy_parity": 9,
     "obs_greedy_parity": 10,
     "obs_tpot_overhead_ratio": 10,
+    "paged_greedy_parity": 11,
+    "paged_concurrency_gain": 11,
 }
 
 
@@ -627,6 +654,10 @@ def check_floors(path: str) -> list[str]:
          as_frac(get(ex, "serving_observability", "obs_greedy_parity"))),
         ("obs_tpot_overhead_ratio",
          get(ex, "serving_observability", "obs_tpot_overhead_ratio")),
+        ("paged_greedy_parity",
+         as_frac(get(ex, "serving_paged_kv", "paged_greedy_parity"))),
+        ("paged_concurrency_gain",
+         get(ex, "serving_paged_kv", "concurrency_gain")),
     ]
     schema = rec.get("schema", 1)
     failures = []
@@ -2523,6 +2554,241 @@ def serving_kernels_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
     return out
 
 
+def serving_paged_kv_bench(on_tpu: bool, budget: Budget | None = None) -> dict:
+    """Paged-KV A/B record (ISSUE 19, schema>=11): the SAME model and
+    byte-pinned long_tail_mix trace served twice — once by the slab
+    engine at S slots, once by the paged engine (serving/paged.py) at
+    4S slots over a block pool holding the SLAB'S byte budget (pool
+    blocks = S x max_len/bt, +1 trash block) — so the tentpole's claim
+    ("the same HBM admits multiples of the streams") is a committed
+    number, not an argument. Committed:
+
+    - per layout: replayed TTFT/TPOT percentiles, decode throughput,
+      peak in-flight streams (slots concurrently owned by admitted
+      requests, sampled every runner loop), KV bytes resident, and
+      goodput-per-GiB-of-KV (throughput / kv_gib — the metric the
+      heavy-tailed trace exists to move);
+    - `concurrency_gain` (floor 4.0 on schema>=11): paged peak
+      in-flight / slab peak in-flight at equal KV bytes. The heavy
+      tail strands slab slots sized for max_len; block-granular
+      funding turns that stranding into admitted streams;
+    - `paged_greedy_parity` (floor exactly 1.0): greedy AND seeded
+      byte parity slab-vs-paged on probes covering the radix-hit and
+      chunked (> largest bucket) prompts, PLUS the two eviction
+      contracts — recompute-from-prefix after a forced full eviction
+      reproduces the never-evicted stream, and an oversubscribed burst
+      (more streams than the pool funds at once, admission holding and
+      retrying through radix eviction) delivers every request's tokens
+      exactly once, byte-identical to slab. All must hold.
+
+    On CPU this is a smoke at toy dims (f32 activations so byte
+    comparison is not an accumulation-order coin flip; int8 KV stays ON
+    — the per-token scales ride the pool blocks); the committed TPU
+    numbers await the open-item-#1 hardware run (the established
+    convention)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.loadgen import (generate_trace, load_scenario,
+                                      miniature, trace_sha256)
+    from kubeflow_tpu.loadgen.runner import run_trace
+    from kubeflow_tpu.serving.llm import LLMEngine
+    from kubeflow_tpu.serving.paged import PagedLLMEngine
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=3584, max_seq_len=1024, remat=False)
+        slab_slots, max_len, buckets = 8, 512, (64, 256)
+        common = dict(decode_chunk=8, prefix_cache=True,
+                      prefix_cache_blocks=128, kv_quantize="int8",
+                      quantize="int8", warm_cont_pairs=None)
+        mini = None
+        max_new = 32
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=8,
+            n_kv_heads=4, d_ff=128, max_seq_len=256, dtype=jnp.float32)
+        slab_slots, max_len, buckets = 2, 64, (8, 16)
+        common = dict(decode_chunk=4, prefix_cache=True,
+                      prefix_cache_blocks=64, kv_quantize="int8")
+        mini = dict(vocab=cfg.vocab_size, max_prompt_len=40,
+                    duration_s=3.0, rate_rps=30.0, max_output=8)
+        max_new = 8
+    bt = math.gcd(*buckets)
+    paged_slots = 4 * slab_slots
+    # the equal-HBM construction: the paged pool holds exactly the slab
+    # engine's KV token budget (S x max_len), +1 trash sentinel block
+    pool_blocks = slab_slots * (max_len // bt)
+    params = llama.init(jax.random.key(0), cfg)
+    scenario = load_scenario("long_tail_mix")
+    if mini is not None:
+        scenario = miniature(scenario, **mini)
+    trace = generate_trace(scenario.trace)
+    out: dict = {
+        "engine": {"model": f"d{cfg.d_model}xL{cfg.n_layers}",
+                   "dtype": str(getattr(cfg.dtype, "__name__", cfg.dtype)),
+                   "max_len": max_len, "buckets": buckets,
+                   "block_tokens": bt,
+                   "slab_slots": slab_slots, "paged_slots": paged_slots,
+                   "pool_blocks": pool_blocks, **common},
+        "scenario": scenario.name,
+        "trace_sha256": trace_sha256(trace),
+        "n_requests": len(trace.requests),
+    }
+    if not on_tpu:
+        out["note"] = ("cpu smoke: parity + machinery + the equal-bytes "
+                       "concurrency construction are the committed "
+                       "claims; throughput numbers await the on-TPU "
+                       "record")
+
+    def expired() -> bool:
+        return budget is not None and budget.expired()
+
+    class _PeakProbe:
+        """Runner controller hook abused as a sampler: every runner
+        loop, count slots owned by an admitted request (held-but-
+        unfunded prefills own their slot too — residency IS the
+        admission claim)."""
+
+        def __init__(self):
+            self.peak = 0
+
+        def observe(self, ttft_ms):
+            pass
+
+        def maybe_adjust(self, engine, now_s):
+            n = sum(1 for s in range(engine.n_slots)
+                    if engine.scheduler.slot_request(s) >= 0)
+            self.peak = max(self.peak, n)
+
+    def kv_bytes(engine) -> int:
+        return sum(int(v.nbytes) for k, v in engine.cache.items()
+                   if k in ("k", "v", "k_s", "v_s"))
+
+    def replay(engine) -> dict:
+        wall = scenario.trace.duration_s * 4.0 + 60.0
+        if budget is not None:
+            wall = max(5.0, min(wall, budget.remaining()))
+        probe = _PeakProbe()
+        res = run_trace(engine, trace, controller=probe, max_wall_s=wall)
+        ttfts = [r.ttft_ms() for r in res["records"]]
+        tpots = [r.tpot_ms() for r in res["records"]]
+
+        def pct(vals, q):
+            vals = [v for v in vals if v is not None]
+            return (round(float(np.percentile(vals, q)), 3)
+                    if vals else None)
+
+        agg = res["summary"]["aggregate"]
+        gib = kv_bytes(engine) / 2**30
+        tput = agg["throughput_tok_per_s"]
+        return {
+            "ttft_p50_ms": pct(ttfts, 50), "ttft_p99_ms": pct(ttfts, 99),
+            "tpot_p50_ms": pct(tpots, 50), "tpot_p99_ms": pct(tpots, 99),
+            "throughput_tok_per_s": tput,
+            "completed": agg["completed"],
+            "timed_out": res["timed_out"],
+            "peak_inflight_streams": probe.peak,
+            "kv_bytes": kv_bytes(engine),
+            "goodput_per_gib_kv": (round(tput / gib, 1)
+                                   if gib and tput is not None else None),
+        }
+
+    engines: dict = {}
+    try:
+        for layout in ("slab", "paged"):
+            if expired():
+                out.setdefault("skipped_for_budget", []).append(layout)
+                continue
+            t0 = time.perf_counter()
+            if layout == "slab":
+                eng = LLMEngine(params, cfg, n_slots=slab_slots,
+                                max_len=max_len, buckets=buckets, **common)
+            else:
+                eng = PagedLLMEngine(params, cfg, n_slots=paged_slots,
+                                     max_len=max_len, buckets=buckets,
+                                     pool_blocks=pool_blocks, **common)
+            engines[layout] = eng   # registered BEFORE warmup (leak guard)
+            eng.warmup()
+            rec = replay(eng)
+            rec["warmup_s"] = round(time.perf_counter() - t0, 1)
+            if layout == "paged":
+                rec["kv_pool"] = eng.metrics()["kv_pool"]
+            out[layout] = rec
+        if "slab" in out and "paged" in out:
+            out["kv_bytes_ratio"] = round(
+                out["paged"]["kv_bytes"] / out["slab"]["kv_bytes"], 4)
+            if out["slab"]["peak_inflight_streams"]:
+                out["concurrency_gain"] = round(
+                    out["paged"]["peak_inflight_streams"]
+                    / out["slab"]["peak_inflight_streams"], 4)
+            if (out["slab"]["goodput_per_gib_kv"]
+                    and out["paged"]["goodput_per_gib_kv"]):
+                out["goodput_per_gib_ratio"] = round(
+                    out["paged"]["goodput_per_gib_kv"]
+                    / out["slab"]["goodput_per_gib_kv"], 4)
+        # -- the exact parity contract (floor 1.0, schema>=11) --------
+        parity: dict[str, bool] = {}
+        if "slab" in engines and "paged" in engines and not expired():
+            es, ep = engines["slab"], engines["paged"]
+            shared = [(i * 7) % (cfg.vocab_size - 1) + 1
+                      for i in range(2 * bt + bt // 2)]
+            probes = [shared + [17, 23, 5],
+                      shared + [101, 9],          # second use: radix HIT
+                      [7, 9, 11],
+                      list(range(3, buckets[-1] + 10))]   # chunked
+            parity["greedy"] = bool(all(
+                es.generate(list(p), max_new) == ep.generate(list(p),
+                                                             max_new)
+                for p in probes))
+            parity["seeded"] = bool(all(
+                es.generate(list(p), max_new, temperature=0.8, seed=99)
+                == ep.generate(list(p), max_new, temperature=0.8,
+                               seed=99)
+                for p in probes))
+            # forced full eviction, then the SAME prompt: the recompute-
+            # from-prefix path must reproduce the never-evicted stream
+            want = es.generate(list(probes[0]), max_new)
+            evicted = ep.kvcache.evict(10**9)
+            ep._flush_derefs()
+            parity["evict_recompute"] = \
+                ep.generate(list(probes[0]), max_new) == want
+            out["evicted_blocks"] = evicted
+            # oversubscribed burst: every stream needs blocks the pool
+            # cannot fund all at once — admission must hold + retry
+            # through eviction and still deliver every token exactly
+            # once (the zero-lost/zero-duplicate contract)
+            burst = [[(j * 11 + i) % (cfg.vocab_size - 1) + 1
+                      for i in range(2 * bt + 2)]
+                     for j in range(2 * paged_slots)]
+            want_burst = [es.generate(list(p), max_new) for p in burst]
+            fail0 = ep.metrics()["kv_pool"]["alloc_failures"]
+            rids = [ep.submit(list(p), max_new) for p in burst]
+            for _ in range(10_000):
+                if all(ep.is_done(r) for r in rids):
+                    break
+                ep.step()
+            got_burst = [ep.result(r) for r in rids]
+            parity["oversubscribed"] = got_burst == want_burst
+            out["oversubscribed"] = {
+                "streams": len(burst),
+                "exact": parity["oversubscribed"],
+                "alloc_failures": (ep.metrics()["kv_pool"]
+                                   ["alloc_failures"] - fail0),
+                "held_at_end": ep.metrics()["held_prefills"],
+            }
+            ep._pool.check_invariants()
+        if parity:
+            out["parity"] = parity
+            out["paged_greedy_parity"] = (
+                1.0 if all(parity.values()) else 0.0)
+    finally:
+        for eng in engines.values():
+            eng.close()
+    return out
+
+
 def serving_observability_bench(on_tpu: bool,
                                 budget: Budget | None = None) -> dict:
     """Tracing-on vs tracing-off A/B on the byte-pinned
@@ -3137,5 +3403,12 @@ if __name__ == "__main__":
         out = serving_observability_bench(
             "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
         print(json.dumps({"serving_observability": out}, indent=1))
+        sys.exit(0)
+    if "serving_paged_kv" in sys.argv:
+        # section-only entry (the ISSUE 19 A/B): slab-vs-paged
+        # equal-KV-bytes record standalone
+        out = serving_paged_kv_bench(
+            "tpu" in str(jax.devices()[0].device_kind).lower(), Budget())
+        print(json.dumps({"serving_paged_kv": out}, indent=1))
         sys.exit(0)
     main()

@@ -12,9 +12,10 @@ prefix. The loadgen `shared_prefix` trace family measures the whole
 loop honestly.
 """
 
+from kubeflow_tpu.kvcache.pool import BlockPool
 from kubeflow_tpu.kvcache.radix import (Block, MatchResult, RadixKVCache,
                                         StageMatchResult,
                                         StagePartitionedKVCache)
 
-__all__ = ["Block", "MatchResult", "RadixKVCache", "StageMatchResult",
-           "StagePartitionedKVCache"]
+__all__ = ["Block", "BlockPool", "MatchResult", "RadixKVCache",
+           "StageMatchResult", "StagePartitionedKVCache"]

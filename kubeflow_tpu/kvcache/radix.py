@@ -119,6 +119,21 @@ class RadixKVCache:
         self._acct: dict[str, dict[str, int]] = {}
         self._evicted_blocks = 0
         self._inserted_blocks = 0
+        # paged mode (ISSUE 19): payloads are pool block ids, not device
+        # arrays. `evict_hook(payload)` runs on every eviction BEFORE the
+        # payload is dropped — the paged engine derefs the pool block
+        # there, so trie eviction returns HBM to the free list. The
+        # attached pool also becomes the source of truth for the
+        # free_blocks/watermark_frac gauges in stats().
+        self.evict_hook: Callable[[Any], None] | None = None
+        self._pool = None
+
+    def attach_pool(self, pool) -> None:
+        """Bind the device block pool whose free-block watermark the
+        stats() gauges should report (paged engines). Without one, the
+        gauges fall back to this trie's own index headroom."""
+        with self._lock:
+            self._pool = pool
 
     # -- structure -----------------------------------------------------------
 
@@ -260,11 +275,28 @@ class RadixKVCache:
         if victim is None:
             return False
         del victim.parent.children[victim.key]
+        if self.evict_hook is not None:
+            self.evict_hook(victim.block.payload)
         victim.block.payload = None   # drop the device arrays NOW
         self._n_blocks -= 1
         self._evicted_blocks += 1
         obs_metrics.PREFIX_EVENTS.inc(event="evict")
         return True
+
+    def evict(self, n_blocks: int) -> int:
+        """The admission PRESSURE VALVE (ISSUE 19): forcibly evict up to
+        `n_blocks` LRU unpinned leaves — with an `evict_hook` attached,
+        each eviction derefs its pool block, so this is how an
+        oversubscribed paged engine turns cached-but-idle prefix KV back
+        into admission headroom (the evicted prefix is recomputable from
+        tokens; the radix parity contract keeps the recompute
+        byte-identical). Returns how many blocks were evicted — fewer
+        than asked when everything left is pinned or interior."""
+        freed = 0
+        with self._lock:
+            while freed < n_blocks and self._evict_one(set()):
+                freed += 1
+        return freed
 
     # -- accounting ----------------------------------------------------------
 
@@ -321,12 +353,25 @@ class RadixKVCache:
                     pinned += 1
                 elif not n.children:
                     evictable += 1
+            # free_blocks / watermark_frac: the ADMISSION gauges
+            # (ISSUE 19). With a device pool attached (paged engines)
+            # they report the pool's free-block watermark — the signal
+            # the oversubscribed admission gate keys on; otherwise they
+            # degrade to this trie's own index headroom.
+            if self._pool is not None:
+                free = self._pool.free_blocks
+                cap = self._pool.capacity_blocks
+            else:
+                free = self.capacity_blocks - self._n_blocks
+                cap = self.capacity_blocks
             return {
                 "block_tokens": self.block_tokens,
                 "capacity_blocks": self.capacity_blocks,
                 "blocks": self._n_blocks,
                 "pinned_blocks": pinned,
                 "evictable_blocks": evictable,
+                "free_blocks": free,
+                "watermark_frac": round(free / cap, 4) if cap else 0.0,
                 "hits": hits,
                 "misses": misses,
                 "hit_rate": (round(hits / (hits + misses), 4)

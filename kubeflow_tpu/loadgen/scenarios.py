@@ -21,6 +21,10 @@ engine: buckets 64/128/256, 8 slots):
 - slo_chase         — the ttft_target_ms knob live: the SLO controller
                       re-picks decode_chunk under load and commits its
                       trajectory.
+- long_tail_mix     — heavy-tailed (bounded-Pareto) prompt/output
+                      lengths: the paged-KV A/B scenario — slab HBM is
+                      sized for the tail, paged admission turns the
+                      stranded difference into concurrency.
 """
 
 from __future__ import annotations
@@ -109,6 +113,8 @@ def miniature(scenario: Scenario, *, vocab: int, max_prompt_len: int,
         # numbers mean) survives the shrink
         orig_max = (t.template_len[1]
                     + t.turns[1] * t.turn_user_len[1])
+    elif t.long_tail:
+        orig_max = t.tail_prompt_len[1]
     else:
         orig_max = max(hi for _, hi, _ in t.prompt_len_mix)
     scale = max_prompt_len / orig_max
@@ -138,6 +144,16 @@ def miniature(scenario: Scenario, *, vocab: int, max_prompt_len: int,
             turn_gap_s=(t.turn_gap_s[0] * dur_scale,
                         max(t.turn_gap_s[0] * dur_scale,
                             t.turn_gap_s[1] * dur_scale)),
+        )
+    if t.long_tail:
+        # the Pareto SHAPE (alpha) survives untouched — only the
+        # bounded support rescales, so the short/long imbalance the
+        # scenario exists to exercise is intact on the tiny engine
+        mini = mini.replace(
+            tail_prompt_len=(max(1, int(t.tail_prompt_len[0] * scale)),
+                             max(1, int(t.tail_prompt_len[1] * scale))),
+            tail_output_len=(min(t.tail_output_len[0], max_output),
+                             min(t.tail_output_len[1], max_output)),
         )
     return scenario.replace(trace=mini,
                             control_interval_s=max(

@@ -162,12 +162,29 @@ class TraceConfig:
     turns: tuple[int, int] = (1, 1)
     turn_user_len: tuple[int, int] = (8, 32)
     turn_gap_s: tuple[float, float] = (0.5, 2.0)
+    # -- long_tail family (the paged-KV tentpole's honest workload):
+    # long_tail=True replaces BOTH uniform length draws with bounded
+    # Pareto (power-law) ones — most requests are short, a heavy tail
+    # is 10-50x longer. Exactly the shape that strands slab HBM (every
+    # slot is sized for the tail) and that paged block ownership turns
+    # into oversubscribed concurrency. tail_alpha is the prompt shape,
+    # tail_output_alpha the output-budget shape (lower = heavier);
+    # supports are the inclusive [lo, hi] bounds.
+    long_tail: bool = False
+    tail_alpha: float = 1.1
+    tail_prompt_len: tuple[int, int] = (4, 480)
+    tail_output_alpha: float = 1.3
+    tail_output_len: tuple[int, int] = (1, 256)
 
     #: shared_prefix-family fields, emitted in to_json only when the
     #: family is enabled: configs (and thus traces) predating it keep
     #: their committed byte-identity / sha256 pins
     _FAMILY_FIELDS = ("n_templates", "template_len", "template_skew",
                       "turns", "turn_user_len", "turn_gap_s")
+    #: long_tail-family fields, same emission rule (and so the same
+    #: byte-identity story) as the shared_prefix family
+    _LT_FIELDS = ("long_tail", "tail_alpha", "tail_prompt_len",
+                  "tail_output_alpha", "tail_output_len")
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -183,6 +200,12 @@ class TraceConfig:
         else:
             for k in self._FAMILY_FIELDS:
                 d.pop(k, None)
+        if self.long_tail:
+            d["tail_prompt_len"] = list(self.tail_prompt_len)
+            d["tail_output_len"] = list(self.tail_output_len)
+        else:
+            for k in self._LT_FIELDS:
+                d.pop(k, None)
         return d
 
     @staticmethod
@@ -193,7 +216,8 @@ class TraceConfig:
             tuple(m) for m in kw["prompt_len_mix"])
         kw["output_len"] = tuple(kw["output_len"])
         kw["cancel_after_s"] = tuple(kw["cancel_after_s"])
-        for k in ("template_len", "turns", "turn_user_len", "turn_gap_s"):
+        for k in ("template_len", "turns", "turn_user_len", "turn_gap_s",
+                  "tail_prompt_len", "tail_output_len"):
             if k in kw:
                 kw[k] = tuple(kw[k])
         return TraceConfig(**kw)
@@ -247,6 +271,17 @@ def _round6(x: float) -> float:
     return round(float(x), 6)
 
 
+def _pareto_int(rng: _SplitMix, lo: int, hi: int, alpha: float) -> int:
+    """Bounded-Pareto integer draw (inverse CDF) in [lo, hi]. The pow()
+    result is quantized before the floor, the same argument as the
+    thinning acceptance: a last-ulp libm difference flips the integer
+    only when the true value sits within ~1e-16 of a rounding boundary."""
+    u = rng.random()
+    frac = 1.0 - u * (1.0 - (lo / hi) ** alpha)
+    x = round(lo * frac ** (-1.0 / alpha), 6)
+    return min(hi, int(x))
+
+
 def generate_trace(cfg: TraceConfig) -> Trace:
     """Deterministic trace from one seeded PCG64 stream. Draw order is
     part of the format: arrivals first (thinning), then per-request
@@ -274,6 +309,17 @@ def generate_trace(cfg: TraceConfig) -> Trace:
                 raise ValueError(f"bad {name} range {(lo, hi)}")
         if not 0 <= cfg.turn_gap_s[0] <= cfg.turn_gap_s[1]:
             raise ValueError(f"bad turn_gap_s range {cfg.turn_gap_s}")
+    if cfg.long_tail:
+        if cfg.n_templates:
+            raise ValueError(
+                "long_tail and shared_prefix families do not compose "
+                "(each owns the per-request length draws)")
+        if cfg.tail_alpha <= 0 or cfg.tail_output_alpha <= 0:
+            raise ValueError("tail alphas must be positive")
+        for name in ("tail_prompt_len", "tail_output_len"):
+            lo, hi = getattr(cfg, name)
+            if not 1 <= lo <= hi:
+                raise ValueError(f"bad {name} range {(lo, hi)}")
     rng = _SplitMix(cfg.seed)
 
     # -- arrivals: Lewis-Shedler thinning against the peak rate
@@ -319,11 +365,23 @@ def generate_trace(cfg: TraceConfig) -> Trace:
             a_idx = rng.choice(adapter_cum)
             if use_adapter:
                 adapter = cfg.adapters[a_idx]
-        b = rng.choice(mix_cum)
-        lo, hi, _ = cfg.prompt_len_mix[b]
-        plen = rng.integers(lo, hi + 1)
-        prompt = tuple(rng.integers(1, cfg.vocab) for _ in range(plen))
-        max_new = rng.integers(cfg.output_len[0], cfg.output_len[1] + 1)
+        if cfg.long_tail:
+            # family draw order (part of the format): prompt length,
+            # prompt tokens, output budget — one Pareto draw replaces
+            # the (bucket, uniform) pair of the base mixture
+            plen = _pareto_int(rng, *cfg.tail_prompt_len, cfg.tail_alpha)
+            prompt = tuple(rng.integers(1, cfg.vocab)
+                           for _ in range(plen))
+            max_new = _pareto_int(rng, *cfg.tail_output_len,
+                                  cfg.tail_output_alpha)
+        else:
+            b = rng.choice(mix_cum)
+            lo, hi, _ = cfg.prompt_len_mix[b]
+            plen = rng.integers(lo, hi + 1)
+            prompt = tuple(rng.integers(1, cfg.vocab)
+                           for _ in range(plen))
+            max_new = rng.integers(cfg.output_len[0],
+                                   cfg.output_len[1] + 1)
         cancel = None
         # same alignment rule: both draws always happen
         will_cancel = rng.random() < cfg.cancel_frac

@@ -621,6 +621,8 @@ def verify_step(params: Params, tokens: jax.Array, cache: Params,
     x = params["embed"].astype(cfg.dtype)[tokens]  # [B, S_v, D]
     cache_keys = (("k", "v", "k_s", "v_s") if "k_s" in cache
                   else ("k", "v"))
+    if "tbl" in cache:   # paged KV (ISSUE 19): the block tables ride along
+        cache_keys = cache_keys + ("tbl",)
     cache_in = {name: cache[name] for name in cache_keys}
     x, new_cache = verify_inner(params["layers"], x, cache_in, lengths,
                                 cfg, span=span, lora=lora, ids=ids)
@@ -638,7 +640,8 @@ def resolve_decode_attn(cfg: LlamaConfig) -> str:
 
 def decode_attention(cfg: LlamaConfig, q: jax.Array, ck: jax.Array,
                      cv: jax.Array, cks, cvs, positions: jax.Array,
-                     impl: str | None = None) -> jax.Array:
+                     impl: str | None = None,
+                     tables: jax.Array | None = None) -> jax.Array:
     """Grouped-query decode/verify attention over a span-sliced KV cache
     slab — THE pluggable seam of the serving hot loop (ISSUE 15).
 
@@ -654,6 +657,14 @@ def decode_attention(cfg: LlamaConfig, q: jax.Array, ck: jax.Array,
     einsum operands, f32 softmax); "flash" — the fused Pallas kernel
     (ops/flash_decode.py; interpret-mode off-TPU, so the differential
     tests run on CPU); None resolves cfg.decode_attention_impl.
+
+    PAGED mode (ISSUE 19): with `tables` [B, span//bt] int32, ck/cv are
+    the POOL layer `[N_blocks, bt, kv, hd]` (cks/cvs `[N_blocks, bt,
+    kv]`) and row b's logical span is the concatenation of its table's
+    blocks. The flash kernel indirects its kv-block grid axis through
+    the scalar-prefetched table; the XLA path gathers the same blocks
+    into the contiguous slab view and falls into the identical einsum —
+    the parity anchor that makes slab vs paged byte-comparable.
     """
     if impl is None:
         impl = resolve_decode_attn(cfg)
@@ -664,8 +675,21 @@ def decode_attention(cfg: LlamaConfig, q: jax.Array, ck: jax.Array,
 
         out = flash_decode_attention(q, ck, cv, positions[:, 0],
                                      k_scale=cks, v_scale=cvs,
-                                     scale=1.0 / (hd ** 0.5))
+                                     scale=1.0 / (hd ** 0.5),
+                                     tables=tables)
         return out.reshape(b, s_v, nh * hd)
+    if tables is not None:
+        # XLA gather twin: jnp.take stages the table's blocks as the
+        # [B, span, kv, hd] slab view (the transient copy the
+        # `kv_gather` breakdown bucket measures), then the SAME einsum
+        # below runs unchanged — one masking/softmax body for slab and
+        # paged, so the layouts can never diverge numerically.
+        bt, nb = ck.shape[1], tables.shape[1]
+        ck = jnp.take(ck, tables, axis=0).reshape(b, nb * bt, nkv, hd)
+        cv = jnp.take(cv, tables, axis=0).reshape(b, nb * bt, nkv, hd)
+        if cks is not None:
+            cks = jnp.take(cks, tables, axis=0).reshape(b, nb * bt, nkv)
+            cvs = jnp.take(cvs, tables, axis=0).reshape(b, nb * bt, nkv)
     # XLA reference: grouped-query attention WITHOUT repeat_kv — q
     # regroups to [B, kv, g, Sv, hd] and both einsums contract against
     # the [B, span, kv, hd] cache directly; materializing the 4x
@@ -716,7 +740,19 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
     the identity). `lengths` is per-ROW of x (already sliced to the
     microbatch)."""
     b, s_v = x.shape[:2]
-    max_len = cache["k"].shape[2]
+    paged = "tbl" in cache
+    if paged:
+        # paged KV (ISSUE 19): cache holds the POOL arrays [L, N_blocks,
+        # bt, kv, hd] plus the per-slot block tables "tbl" [n_slots,
+        # max_len // bt]. The tables are carried alongside (never
+        # written per layer — pop them from the scan carry) and logical
+        # coordinates indirect through them everywhere below.
+        cache = dict(cache)
+        tbl = cache.pop("tbl")
+        bt = cache["k"].shape[2]
+        max_len = tbl.shape[1] * bt
+    else:
+        max_len = cache["k"].shape[2]
     span = max_len if span is None else min(span, max_len)
     quantized = "k_s" in cache
     rows = slot_start + jnp.arange(b)
@@ -725,6 +761,23 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
     # writes must vanish, not clamp onto the last live row
     idx = (rows[:, None], positions)
     full_batch = slot_start == 0 and cache["k"].shape[1] == b
+    if paged:
+        if span % bt:
+            raise ValueError(
+                f"paged span {span} must divide by block_tokens {bt}")
+        # this batch's table rows, clipped to the attention span
+        tbl_b = tbl[slot_start:slot_start + b, :span // bt]
+        # write coordinates: position p of row r lands at block
+        # tbl[r, p // bt], offset p % bt. Positions at/past max_len
+        # (inactive slots' junk) — and any position whose table entry
+        # was never allocated — indirect to block 0, the pool's trash
+        # sentinel: the paged twin of the slab path's mode="drop".
+        pos_c = jnp.minimum(positions, max_len - 1)
+        blk = jnp.where(positions < max_len,
+                        tbl[rows[:, None], pos_c // bt], 0)
+        w_idx = (blk, positions % bt)
+    else:
+        w_idx = idx
     # resolved ONCE per trace (static): the whole compiled menu of an
     # engine runs one decode-attention impl — xla einsum or the fused
     # Pallas flash-decode kernel (cfg.decode_attention_impl)
@@ -751,13 +804,15 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
             writes = {"k": k_new.astype(cache_c["k"].dtype),
                       "v": v_new.astype(cache_c["v"].dtype)}
         cache_c = {
-            name: buf.at[(li,) + idx].set(writes[name], mode="drop")
+            name: buf.at[(li,) + w_idx].set(writes[name], mode="drop")
             for name, buf in cache_c.items()}
         def layer_span(name):
             # index the layer FIRST, then slice the span: the other order
             # would stage an [L, B, span, ...] temp of the whole cache
             rows_all = jax.lax.dynamic_index_in_dim(
                 cache_c[name], li, axis=0, keepdims=False)
+            if paged:            # pool layer [N, bt, ...]: the TABLE does
+                return rows_all  # the span slicing (tbl_b is span-clipped)
             if not full_batch:   # microbatch: this batch's slot window
                 rows_all = jax.lax.slice_in_dim(
                     rows_all, slot_start, slot_start + b, axis=0)
@@ -772,7 +827,8 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
             cfg, q, layer_span("k"), layer_span("v"),
             layer_span("k_s") if quantized else None,
             layer_span("v_s") if quantized else None,
-            positions, impl=attn_impl)
+            positions, impl=attn_impl,
+            tables=tbl_b if paged else None)
         x = x + _wo(cfg, out, layer, ll, ids)
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return (x, cache_c), None
@@ -782,6 +838,8 @@ def verify_inner(layers: Params, x: jax.Array, cache: Params,
     xs = ((layers, layer_idx, lora) if lora is not None
           else (layers, layer_idx))
     (x, new_cache), _ = jax.lax.scan(body, (x, cache), xs)
+    if paged:
+        new_cache = dict(new_cache, tbl=tbl)   # tables pass through
     return x, new_cache
 
 

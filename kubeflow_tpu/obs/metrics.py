@@ -94,6 +94,14 @@ SUPERVISOR_RESTARTS = REGISTRY.counter(
 PREFIX_EVENTS = REGISTRY.counter(
     "kvcache_prefix_events_total",
     "Radix prefix-cache events (hit/miss/insert/evict)", ["event"])
+KV_FREE_BLOCKS = REGISTRY.gauge(
+    "kvcache_free_blocks",
+    "Allocatable KV blocks currently free (paged: pool free list; "
+    "slab: radix store headroom)", ["engine"])
+KV_WATERMARK_FRAC = REGISTRY.gauge(
+    "kvcache_watermark_frac",
+    "Free fraction of allocatable KV capacity (the paged admission "
+    "signal: 1.0 = empty, 0.0 = fully committed)", ["engine"])
 
 # -- heartbeat ----------------------------------------------------------------
 HEARTBEAT_EVENTS = REGISTRY.counter(

@@ -122,8 +122,16 @@ def _out_shape(shape, dtype, *xs):
                                       pallas_compat.collect_vma(*xs))
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, s_v, block_kv,
-                   t_real, scale, quantized):
+def _decode_kernel(len_ref, *refs, s_v, block_kv, t_real, scale,
+                   quantized, paged=False):
+    if paged:
+        # block-table mode (ISSUE 19): the table ref is scalar-prefetch
+        # arg 2 — it steers the k/v/scale BlockSpec index_maps (the
+        # indirection happens in the pipeline, before the body runs),
+        # so the body itself never reads it: by the time a block is in
+        # VMEM, k_start below is its LOGICAL span offset either way.
+        _tbl_ref, *refs = refs
+    q_ref, k_ref, v_ref, *rest = refs
     if quantized:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -197,7 +205,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, s_v, block_kv,
 
 
 def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
-                           scale=None, block_kv=None, interpret=None):
+                           scale=None, block_kv=None, interpret=None,
+                           tables=None):
     """Fused GQA decode/verify attention over a KV cache slab.
 
     q: [B, S_v, heads, hd] (model dtype); k/v: [B, T, kv_heads, hd] —
@@ -209,10 +218,19 @@ def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
     T is padded up to a block multiple only when it isn't one already
     (toy test dims; the engine's span menu is powers of two >= 128,
     which the default block divides — no production pad, no copy).
+
+    PAGED mode (ISSUE 19): with `tables` [B, n_blocks_per_slot] int32,
+    k/v are the block POOL `[N_blocks, bt, kv_heads, hd]` (scales
+    `[N_blocks, bt, kv_heads]`) and slot b's logical span is its
+    table's blocks concatenated. The grid already walks (slot, kv_head,
+    kv_block); paged just indirects the kv-block axis of the k/v/scale
+    BlockSpecs through the scalar-prefetched table — the kernel body,
+    its masking, and the online-softmax recurrence are byte-identical
+    to slab mode, which is what keeps the layouts parity-comparable.
     """
     b, s_v, nh, hd = q.shape
-    t = k.shape[1]
-    nkv = k.shape[2]
+    paged = tables is not None
+    nkv = k.shape[-2]
     if nh % nkv:
         raise ValueError(f"heads {nh} must divide by kv_heads {nkv}")
     g = nh // nkv
@@ -221,17 +239,27 @@ def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
         raise ValueError("k_scale and v_scale must be passed together")
     interpret = _resolve_interpret(interpret)
     scale = 1.0 / (hd ** 0.5) if scale is None else scale
-    block_kv = DEFAULT_BLOCK_KV if block_kv is None else block_kv
-    block_kv = min(block_kv, _round_up(t, 128))
-    t_pad = _round_up(t, block_kv)
-    if t_pad != t:
-        pad = ((0, 0), (0, t_pad - t), (0, 0), (0, 0))
-        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
-        if quantized:
-            spad = ((0, 0), (0, t_pad - t), (0, 0))
-            k_scale = jnp.pad(k_scale, spad)
-            v_scale = jnp.pad(v_scale, spad)
-    n_k = t_pad // block_kv
+    if paged:
+        # the block size IS the pool's block_tokens; the span is the
+        # table width — always block-aligned, so no pad path exists
+        n_pool, block_kv = k.shape[0], k.shape[1]
+        if tables.shape[0] != b:
+            raise ValueError(f"tables rows {tables.shape[0]} != batch {b}")
+        n_k = tables.shape[1]
+        t = t_pad = n_k * block_kv
+    else:
+        t = k.shape[1]
+        block_kv = DEFAULT_BLOCK_KV if block_kv is None else block_kv
+        block_kv = min(block_kv, _round_up(t, 128))
+        t_pad = _round_up(t, block_kv)
+        if t_pad != t:
+            pad = ((0, 0), (0, t_pad - t), (0, 0), (0, 0))
+            k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+            if quantized:
+                spad = ((0, 0), (0, t_pad - t), (0, 0))
+                k_scale = jnp.pad(k_scale, spad)
+                v_scale = jnp.pad(v_scale, spad)
+        n_k = t_pad // block_kv
 
     # regroup q heads onto their kv heads: [B, S_v, nh, hd] →
     # [B, kv, g*S_v, hd] (kv-major head split, the verify_inner
@@ -246,27 +274,46 @@ def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
     # the kv-head axis folds into the lane dimension via a metadata-only
     # reshape, so the h grid index picks head h's hd-wide column block
     # without ever staging a transposed copy of the payload
-    k3 = k.reshape(b, t_pad, nkv * hd)
-    v3 = v.reshape(b, t_pad, nkv * hd)
+    if paged:
+        k3 = k.reshape(n_pool, block_kv, nkv * hd)
+        v3 = v.reshape(n_pool, block_kv, nkv * hd)
+        # the table steers the kv-block axis: grid step (b_, h, j)
+        # pipelines pool block tables[b_, j] — the ONLY difference from
+        # slab mode, expressed entirely in the index_map
+        kv_spec = pl.BlockSpec(
+            (1, block_kv, hd),
+            lambda b_, h, j, len_ref, tbl_ref: (tbl_ref[b_, j], 0, h))
+        sc_spec = pl.BlockSpec(
+            (1, 1, block_kv),
+            lambda b_, h, j, len_ref, tbl_ref: (tbl_ref[b_, j], h, 0))
+    else:
+        k3 = k.reshape(b, t_pad, nkv * hd)
+        v3 = v.reshape(b, t_pad, nkv * hd)
+        kv_spec = pl.BlockSpec((1, block_kv, hd),
+                               lambda b_, h, j, *_: (b_, j, h))
+        sc_spec = pl.BlockSpec((1, 1, block_kv),
+                               lambda b_, h, j, *_: (b_, h, j))
 
     extra_specs, extra_args = [], []
     if quantized:
-        # scales ARE transposed ([B, kv, T] — lane-major per head): 4/hd
-        # of the payload bytes, the price of a tiling-legal scale block
-        sspec = pl.BlockSpec((1, 1, block_kv),
-                             lambda b_, h, j, *_: (b_, h, j))
-        extra_specs = [sspec, sspec]
-        extra_args = [jnp.swapaxes(k_scale, 1, 2).astype(jnp.float32),
-                      jnp.swapaxes(v_scale, 1, 2).astype(jnp.float32)]
+        # scales ARE transposed (slab [B, kv, T] / pool [N, kv, bt] —
+        # lane-major per head): 4/hd of the payload bytes, the price of
+        # a tiling-legal scale block
+        extra_specs = [sc_spec, sc_spec]
+        extra_args = [jnp.swapaxes(k_scale, -2, -1).astype(jnp.float32),
+                      jnp.swapaxes(v_scale, -2, -1).astype(jnp.float32)]
 
+    prefetch = [jnp.asarray(lengths, jnp.int32)]
+    if paged:
+        prefetch.append(jnp.asarray(tables, jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(prefetch),
         grid=(b, nkv, n_k),
         in_specs=[
             pl.BlockSpec((1, 1, r_pad, hd),
                          lambda b_, h, j, *_: (b_, h, 0, 0)),
-            pl.BlockSpec((1, block_kv, hd), lambda b_, h, j, *_: (b_, j, h)),
-            pl.BlockSpec((1, block_kv, hd), lambda b_, h, j, *_: (b_, j, h)),
+            kv_spec,
+            kv_spec,
             *extra_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, r_pad, hd),
@@ -279,7 +326,7 @@ def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
     )
     kernel = functools.partial(
         _decode_kernel, s_v=s_v, block_kv=block_kv, t_real=t, scale=scale,
-        quantized=quantized)
+        quantized=quantized, paged=paged)
     from kubeflow_tpu.ops.pallas_compat import tpu_compiler_params
 
     itemsize = jnp.dtype(k.dtype).itemsize
@@ -295,7 +342,7 @@ def flash_decode_attention(q, k, v, lengths, *, k_scale=None, v_scale=None,
             transcendentals=b * nh * s_v * t_pad,
         ),
         interpret=interpret,
-    )(jnp.asarray(lengths, jnp.int32), qg, k3, v3, *extra_args)
+    )(*prefetch, qg, k3, v3, *extra_args)
     out = out[:, :, :rows]                           # [B, kv, g*S_v, hd]
     return out.reshape(b, nkv, g, s_v, hd).transpose(
         0, 3, 1, 2, 4).reshape(b, s_v, nh, hd)

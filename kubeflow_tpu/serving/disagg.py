@@ -271,6 +271,16 @@ class DisaggregatedEngine:
                 f"{peng.prefix_block_tokens} vs decode "
                 f"{deng.prefix_block_tokens}): handed-off chains would "
                 "never match")
+        if "paged" in (getattr(peng, "kv_layout", "slab"),
+                       getattr(deng, "kv_layout", "slab")):
+            # paged radix payloads are block IDS into one engine's own
+            # pool — meaningless across roles until the roles share a
+            # pool (the block-table splice handoff, a follow-up). Fail
+            # loudly rather than hand off dangling integers.
+            raise ValueError(
+                "disaggregated serving requires kv_layout=slab roles: "
+                "paged payloads are pool-local block ids, not portable "
+                "KV (serving/paged.py)")
         self._bt = deng.prefix_block_tokens
         if isinstance(handoff, str):
             try:

@@ -166,6 +166,19 @@ class LLMEngine:
     #: the role attribute of engine spans
     role = "engine"
 
+    #: KV residency: "slab" = preallocated [n_slots, max_len] rows;
+    #: serving/paged.py overrides to "paged" (block pool + tables)
+    kv_layout = "slab"
+
+    #: the prefix banker extracts raw slot KV (slab layout), so warmup
+    #: pre-compiles the raw-extract menu; the paged engine banks block
+    #: ids instead (zero-copy) and has no such menu to warm
+    _bank_uses_raw_extract = True
+    #: continuation programs re-write the prefix KV into the slot rows
+    #: (slab layout); the paged engine's spliced table blocks already
+    #: hold those bytes, so it skips the write
+    _cont_writes_prefix = True
+
     def __init__(self, params, cfg: llama.LlamaConfig, *, n_slots: int = 4,
                  max_len: int = 512, buckets: Sequence[int] = (64, 128, 256),
                  max_queue: int = 1024, eos_id: int | None = None,
@@ -875,8 +888,9 @@ class LLMEngine:
         cache = dict(cache)
         lasts = []
         for i in range(tokens.shape[0]):   # W is static: unrolled updates
-            cache = self._cache_write(cache, slots[i], 0, p,
-                                      k_prefix[:, i], v_prefix[:, i])
+            if self._cont_writes_prefix:
+                cache = self._cache_write(cache, slots[i], 0, p,
+                                          k_prefix[:, i], v_prefix[:, i])
             cache = self._cache_write(cache, slots[i], p, t_bucket,
                                       ks[:, i], vs[:, i])
             lengths = lengths.at[slots[i]].set(prompt_lens[i])
@@ -1555,6 +1569,26 @@ class LLMEngine:
             # chunked chain keeps its FIRST pop — the whole chain is one
             # prefill phase)
             self._prefill_start_t.setdefault(a.req_id, t_prefill)
+        actions = self._admit_prefills(actions)
+        if actions:
+            self._run_prefill_actions(actions)
+        return True
+
+    def _admit_prefills(self, actions: list) -> list:
+        """Admission hook between the scheduler pop and the wave
+        dispatch. The slab engine admits everything — its KV rows are
+        preallocated per slot, so a popped action is always fundable.
+        The paged engine (serving/paged.py) overrides this to reserve
+        KV blocks against the free-block watermark, run the radix
+        eviction valve under pressure, and HOLD BACK actions it cannot
+        fund yet (their slots stay assigned; the held prefill
+        dispatches on a later step once blocks free up)."""
+        return actions
+
+    def _run_prefill_actions(self, actions: list) -> None:
+        """Dispatch one admitted prefill burst and replay its tokens.
+        Factored out of step() so the paged engine's held-action retry
+        can dispatch without re-entering the scheduler pop."""
         # prompts longer than the largest bucket peel off into chained
         # chunked prefills; prefix-cache hits into continuation programs
         # (tail-only compute); everything else groups by bucket, one
@@ -1626,7 +1660,6 @@ class LLMEngine:
                 tok, lp, top = self._unpack_out(out_np[i])
                 self._record_token(a.req_id, a.slot, tok, lp, top,
                                    first_token=True)
-        return True
 
     def _chunk_plan_from(self, n: int, start: int
                          ) -> list[tuple[int, int]] | None:
@@ -1772,9 +1805,11 @@ class LLMEngine:
             # the banking path's raw-extract programs are cheap slice
             # jits, but a cold one still stalls the engine thread
             # mid-replay — warm every block multiple the banker can ask
-            # for (aligned prompt prefixes up to max_len)
-            for p in range(bt, self.max_len, bt):
-                self._extract_raw_fn(p)(self.cache, 0)
+            # for (aligned prompt prefixes up to max_len). The paged
+            # engine banks block ids (no extraction) and skips this.
+            if self._bank_uses_raw_extract:
+                for p in range(bt, self.max_len, bt):
+                    self._extract_raw_fn(p)(self.cache, 0)
             extracts = {}
             for p, t in pairs:
                 if p not in extracts:
@@ -1883,6 +1918,12 @@ class LLMEngine:
         obs_metrics.SCHED_ACTIVE.set(s.active, engine=self.role)
         obs_metrics.INFLIGHT.set(s.queued + s.active,
                                  component=self.role)
+        if self.kvcache is not None:
+            st = self.kvcache.stats()
+            obs_metrics.KV_FREE_BLOCKS.set(st["free_blocks"],
+                                           engine=self.role)
+            obs_metrics.KV_WATERMARK_FRAC.set(st["watermark_frac"],
+                                              engine=self.role)
 
     def is_done(self, req_id: int) -> bool:
         return req_id in self._done
@@ -2068,6 +2109,9 @@ class LLMEngine:
                # /healthz read this, so a record can never misreport
                # which kernel path produced its numbers)
                "decode_attention_impl": llama.resolve_decode_attn(self.cfg),
+               # which KV residency this engine runs (serving/paged.py
+               # overrides to "paged" and adds the pool gauges)
+               "kv_layout": self.kv_layout,
                "mesh": self.mesh_info()}
         out["prefill_tokens_computed"] = self._prefill_computed_tokens
         if self.prefix_cache_enabled and self.kvcache is not None:
@@ -2301,9 +2345,13 @@ class LLMEngine:
                     self.spec and all(n <= likely for n in need)):
                 self._drain_pending()
                 return
-        slot_req = [self.scheduler.slot_request(s)
-                    for s in range(self.n_slots)]
+        slot_req = self._mask_unfunded(
+            [self.scheduler.slot_request(s) for s in range(self.n_slots)])
         active = np.array([r >= 0 for r in slot_req], bool)
+        if not active.any():
+            # every live slot is admission-held (paged engine under
+            # block pressure): nothing has KV to decode against yet
+            return
         # adaptive draft length: the per-slot acceptance EMAs of the
         # DRAFTING slots (greedy, penalty-free — sampled/penalized rows
         # draft nothing by contract) set this round's k; a batch with no
@@ -2406,6 +2454,14 @@ class LLMEngine:
             self._drain_pending()
         elif prev is not None:
             self._replay(prev)
+
+    def _mask_unfunded(self, slot_req: list[int]) -> list[int]:
+        """Decode-planning hook: the paged engine masks slots whose
+        prefill is admission-HELD (slot assigned by the scheduler, no KV
+        funded yet) to -1, so chunk sizing, the active mask, and replay
+        treat them as empty until their prefill lands. Slab engines have
+        no held state — identity."""
+        return slot_req
 
     def _constrain_cnt(self, cnt):
         """Pin the penalty-count layout under a mesh (see _shard_over)."""

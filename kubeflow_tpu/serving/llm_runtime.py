@@ -72,6 +72,8 @@ class LLMModel(Model):
                  disaggregated: bool = False,
                  disagg: dict[str, Any] | None = None,
                  usage_timing: bool = False,
+                 kv_layout: str | None = None,
+                 pool_blocks: int | None = None,
                  parallel: dict[str, Any] | None = None,
                  trace_sample_rate: float | None = None,
                  slo: dict[str, Any] | None = None,
@@ -183,6 +185,36 @@ class LLMModel(Model):
         self._pp, self._tp = pp, tp
         if pp == 1 and tp > 1:
             self._mesh = {"tensor": tp}
+        # config.kv_layout (ISSUE 19): "slab" (the preallocated
+        # [n_slots, max_len] rows — the default) or "paged"
+        # (block-granular pool + per-slot block tables with
+        # oversubscribed admission, serving/paged.py). Explicit config
+        # wins over the KTPU_KV_LAYOUT env (the fleet-wide rollout
+        # lever); unset resolves slab. config.pool_blocks sizes the
+        # paged pool (None = the slab's exact HBM footprint).
+        import os
+
+        resolved = kv_layout or os.environ.get("KTPU_KV_LAYOUT") or "slab"
+        if resolved not in ("slab", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'slab' or 'paged', got {resolved!r}")
+        if resolved == "paged":
+            if pp > 1:
+                raise ValueError(
+                    "kv_layout=paged does not compose with "
+                    "parallel.stage > 1 yet: the stage-sharded engine "
+                    "keeps per-stage KV slabs (serving/multichip.py)")
+            if self._mesh:
+                raise ValueError(
+                    "kv_layout=paged does not compose with a mesh yet: "
+                    "the block pool has no GSPMD layout")
+            if self._disaggregated:
+                raise ValueError(
+                    "kv_layout=paged does not compose with disaggregated "
+                    "serving yet: the prefill->decode handoff moves slab "
+                    "rows (serving/disagg.py)")
+        self._kv_layout = resolved
+        self._pool_blocks = pool_blocks
         # config.usage_timing: surface the request_timing() phase split
         # (queue_wait_ms / prefill_ms / decode_ms) in the OpenAI usage
         # object; off (default) keeps the usage shape byte-unchanged
@@ -319,6 +351,12 @@ class LLMModel(Model):
 
                 eng = StageShardedEngine(params, cfg, stage=self._pp,
                                          tensor=self._tp, **engine_kw)
+            elif self._kv_layout == "paged":
+                from kubeflow_tpu.serving.paged import PagedLLMEngine
+
+                eng = PagedLLMEngine(params, cfg,
+                                     pool_blocks=self._pool_blocks,
+                                     **engine_kw)
             else:
                 eng = LLMEngine(params, cfg, **engine_kw)
             if rewarm or not warmed:

@@ -86,6 +86,14 @@ class StageShardedEngine(LLMEngine):
                 "StageShardedEngine owns its mesh: pass stage=/tensor=, "
                 "not mesh=")
         kw.pop("mesh", None)
+        if kw.pop("kv_layout", "slab") != "slab":
+            # ISSUE 19 boundary: the paged block pool is single-device
+            # (one pool, one table, one donation chain); per-stage
+            # pools are a follow-up. Stage KV stays slab rows.
+            raise ValueError(
+                "StageShardedEngine keeps per-stage KV SLABS: "
+                "kv_layout=paged is not supported with stage "
+                "parallelism (serving/paged.py is single-program)")
         if tensor > 1 and cfg.n_kv_heads % tensor:
             raise ValueError(
                 f"n_kv_heads={cfg.n_kv_heads} must divide by the tensor "

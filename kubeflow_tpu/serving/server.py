@@ -237,7 +237,11 @@ class ModelServer:
                     pass   # burn accounting is detail, never liveness
             pc = (mm or {}).get("prefix_cache")
             if pc:
-                caches[mname] = pc
+                # tagged with the KV residency (slab rows vs paged block
+                # pool) so the free_blocks/watermark_frac gauges read in
+                # the right units at a glance
+                caches[mname] = dict(
+                    pc, kv_layout=(mm or {}).get("kv_layout", "slab"))
             mesh = (mm or {}).get("mesh")
             if mesh:
                 # multichip observability (ISSUE 14): layout name, axis

@@ -286,10 +286,14 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
     # KVHandoff interface the disaggregated coordinator uses — so the
     # handoff's price sits NEXT TO weight-read/attention/sampling in the
     # committed breakdown instead of folding into dispatch-RTT. None on
-    # engines without a prefix cache (no blocks to move).
+    # engines without a prefix cache (no blocks to move), and on paged
+    # engines — paged banking is refcount bookkeeping on pool blocks
+    # (serving/paged.py _bank_prefix_blocks), there is no slice-out
+    # handoff program to time.
     kv_handoff_ms = None
     if getattr(engine, "prefix_cache_enabled", False) \
-            and engine.kvcache is not None:
+            and engine.kvcache is not None \
+            and getattr(engine, "_bank_uses_raw_extract", True):
         from kubeflow_tpu.kvcache import RadixKVCache
         from kubeflow_tpu.serving.disagg import KVHandoff
 
@@ -317,6 +321,7 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
     # xla-vs-flash A/B needs to be explainable per bucket.
     attn_kernel_ms = None
     attn_dequant_ms = None
+    kv_gather_ms = None
     cfg = getattr(engine, "cfg", None)
     cache_obj = getattr(engine, "cache", None)
     if (cfg is not None and getattr(engine, "mesh", None) is None
@@ -326,6 +331,13 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         from kubeflow_tpu.models import llama as _llama
 
         quantized = "k_s" in cache_obj
+        # paged engines (serving/paged.py) keep pool blocks, not slot
+        # rows: the probes read KV through the slot block tables — the
+        # same indirection the decode program pays
+        paged = "tbl" in cache_obj
+        bt_blk = int(cache_obj["k"].shape[2]) if paged else 0
+        nb = min(span // bt_blk, int(cache_obj["tbl"].shape[1])) \
+            if paged else 0
         n_layers = int(cache_obj["k"].shape[0])
         q_probe = jax.random.normal(
             jax.random.key(7),
@@ -334,11 +346,14 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         def _layer_span(cache, name, li):
             rows_all = jax.lax.dynamic_index_in_dim(
                 cache[name], li, axis=0, keepdims=False)
+            if paged:
+                return rows_all   # whole pool layer; the table slices
             return jax.lax.slice_in_dim(rows_all, 0, span, axis=1)
 
         @jax.jit
         def attn_probe(cache, lengths):
             positions = lengths[:, None]   # S_v=1: one decode step
+            tbl_b = cache["tbl"][:, :nb] if paged else None
 
             def body(acc, li):
                 out = _llama.decode_attention(
@@ -347,7 +362,7 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
                     _layer_span(cache, "v", li),
                     _layer_span(cache, "k_s", li) if quantized else None,
                     _layer_span(cache, "v_s", li) if quantized else None,
-                    positions)
+                    positions, tables=tbl_b)
                 return acc + jnp.sum(out.astype(jnp.float32)), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0.0),
@@ -360,16 +375,26 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         run_attn()   # compile + fault pages, untimed
         attn_kernel_ms = round(
             max(_median_time(run_attn, iters) - t_rtt, 0.0) * 1e3, 4)
+
+        def _gathered_span(cache, name, li):
+            """The slot×span KV volume through the block tables (the
+            paged read path): [slots, nb*bt, ...]."""
+            pool = jax.lax.dynamic_index_in_dim(
+                cache[name], li, axis=0, keepdims=False)
+            g = jnp.take(pool, cache["tbl"][:, :nb], axis=0)
+            return g.reshape((g.shape[0], nb * bt_blk) + g.shape[3:])
+
         if quantized:
             @jax.jit
             def dequant_probe(cache):
                 def body(acc, li):
+                    read = _gathered_span if paged else _layer_span
                     k = _llama.dequantize_kv(
-                        _layer_span(cache, "k", li),
-                        _layer_span(cache, "k_s", li), cfg.dtype)
+                        read(cache, "k", li),
+                        read(cache, "k_s", li), cfg.dtype)
                     v = _llama.dequantize_kv(
-                        _layer_span(cache, "v", li),
-                        _layer_span(cache, "v_s", li), cfg.dtype)
+                        read(cache, "v", li),
+                        read(cache, "v_s", li), cfg.dtype)
                     return acc + (jnp.sum(k.astype(jnp.float32))
                                   + jnp.sum(v.astype(jnp.float32))), None
 
@@ -386,6 +411,55 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
                 4)
         else:
             attn_dequant_ms = 0.0   # nothing to dequantize, by definition
+
+        if paged:
+            # kv_gather (ISSUE 19 satellite): what the block-table
+            # INDIRECTION itself costs — the same slot×span KV volume
+            # read once through the tables (jnp.take over the block
+            # axis) and once as a contiguous block range. The
+            # difference is the tax paged residency puts on every
+            # decode step's KV read; None on slab engines, where reads
+            # are contiguous by construction.
+            vol = min(n_slots * nb, int(cache_obj["k"].shape[1]))
+
+            @jax.jit
+            def gather_read(cache):
+                def body(acc, li):
+                    gk = _gathered_span(cache, "k", li)
+                    gv = _gathered_span(cache, "v", li)
+                    return acc + (jnp.sum(gk.astype(jnp.float32))
+                                  + jnp.sum(gv.astype(jnp.float32))), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                      jnp.arange(n_layers))
+                return acc
+
+            @jax.jit
+            def contig_read(cache):
+                def body(acc, li):
+                    kl = jax.lax.dynamic_index_in_dim(
+                        cache["k"], li, axis=0, keepdims=False)
+                    vl = jax.lax.dynamic_index_in_dim(
+                        cache["v"], li, axis=0, keepdims=False)
+                    ck = jax.lax.slice_in_dim(kl, 0, vol, axis=0)
+                    cv = jax.lax.slice_in_dim(vl, 0, vol, axis=0)
+                    return acc + (jnp.sum(ck.astype(jnp.float32))
+                                  + jnp.sum(cv.astype(jnp.float32))), None
+
+                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                      jnp.arange(n_layers))
+                return acc
+
+            def run_gather():
+                float(np.asarray(gather_read(engine.cache)))
+
+            def run_contig():
+                float(np.asarray(contig_read(engine.cache)))
+
+            run_gather(); run_contig()   # compile, untimed
+            kv_gather_ms = round(
+                max(_median_time(run_gather, iters)
+                    - _median_time(run_contig, iters), 0.0) * 1e3, 4)
 
     per_step = 1e3 / steps
     dev_full_ms = max(t_full - t_rtt, 0.0) * per_step
@@ -425,6 +499,11 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
             # per BLOCK handed off, not per step: the handoff rides
             # prefill completion, so its cadence is per-request
             "kv_handoff": kv_handoff_ms,
+            # block-table indirection tax on the decode-span KV read
+            # (gather through slot tables minus contiguous read of the
+            # same volume); None on slab engines, whose reads are
+            # contiguous by construction
+            "kv_gather": kv_gather_ms,
             # per-stage idle wall per decode step (stage-sharded
             # engines with stage_timing armed; None elsewhere)
             "pipeline_bubble": pipe_bubble_ms,

@@ -16,7 +16,12 @@ Rules (AST, no imports of the checked code):
    must not reference any engine class at all — they speak to engines
    only through the `Model` abstraction, whose engine is the supervisor
    (or the disaggregated coordinator).
-3. `bench.py` may build bare engines for raw-engine perf points, but its
+3. (ISSUE 19) `make_block_pool_buffers` — the single sanctioned
+   construction site for paged KV block-pool device buffers — may only
+   be called from inside `kubeflow_tpu/kvcache/`. Everyone else
+   (PagedLLMEngine included) takes buffers from a `BlockPool`, so the
+   pool's free-list/refcounts are the ONLY owner of KV memory.
+4. `bench.py` may build bare engines for raw-engine perf points, but its
    chaos/HTTP dataplane sections must go through `EngineSupervisor` /
    `LLMModel`; the repo-root bench is therefore out of scope here by
    path, not by oversight (rule 1's scope is the library package).
@@ -41,7 +46,15 @@ PKG = os.path.join(REPO, "kubeflow_tpu")
 #: multichip engine crashing without a supervisor strands pp device
 #: groups at once)
 ENGINE_NAMES = ("LLMEngine", "PrefillEngine", "DecodeEngine",
-                "StageShardedEngine")
+                "StageShardedEngine", "PagedLLMEngine")
+
+#: the single sanctioned construction site for paged KV block-pool
+#: device buffers (ISSUE 19): only `kubeflow_tpu/kvcache/` may call it.
+#: A module allocating pool buffers directly would create KV memory the
+#: BlockPool's refcounts/free-list cannot see — the exact
+#: double-ownership the paged design removes.
+POOL_CTOR = "make_block_pool_buffers"
+POOL_OWNER_DIR = os.path.join("kubeflow_tpu", "kvcache")
 
 #: frontends that must stay engine-blind (rule 2)
 ENGINE_BLIND = (
@@ -66,6 +79,7 @@ class _EngineCallVisitor(ast.NodeVisitor):
     def __init__(self):
         self.stack: list[str] = []
         self.calls: list[tuple[int, str, list[str]]] = []
+        self.pool_calls: list[int] = []
 
     def _visit_func(self, node):
         self.stack.append(node.name)
@@ -81,6 +95,8 @@ class _EngineCallVisitor(ast.NodeVisitor):
                 else fn.attr if isinstance(fn, ast.Attribute) else None)
         if name in ENGINE_NAMES:
             self.calls.append((node.lineno, name, list(self.stack)))
+        if name == POOL_CTOR:
+            self.pool_calls.append(node.lineno)
         self.generic_visit(node)
 
 
@@ -90,6 +106,7 @@ def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
     engine_defs = (
         os.path.join("kubeflow_tpu", "serving", "llm.py"),
         os.path.join("kubeflow_tpu", "serving", "multichip.py"),
+        os.path.join("kubeflow_tpu", "serving", "paged.py"),
     )
     for path in sorted(_py_files(pkg_root)):
         rel = os.path.relpath(path, repo_root)
@@ -101,8 +118,6 @@ def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
             findings.append(
                 f"{rel}: references {n} — frontends must speak "
                 "through the Model abstraction (supervised engine)")
-        if rel in engine_defs:
-            continue
         try:
             tree = ast.parse(src, filename=rel)
         except SyntaxError as e:
@@ -110,13 +125,21 @@ def check(pkg_root: str = PKG, repo_root: str = REPO) -> list[str]:
             continue
         v = _EngineCallVisitor()
         v.visit(tree)
-        for lineno, cls, stack in v.calls:
-            if any("factory" in name for name in stack):
-                continue   # the sanctioned pattern: a supervisor factory
-            findings.append(
-                f"{rel}:{lineno}: bare {cls} construction outside a "
-                "supervisor factory — wrap it in an EngineSupervisor "
-                "(build it inside a *factory* function handed to one)")
+        if rel not in engine_defs:
+            for lineno, cls, stack in v.calls:
+                if any("factory" in name for name in stack):
+                    continue   # the sanctioned pattern: supervisor factory
+                findings.append(
+                    f"{rel}:{lineno}: bare {cls} construction outside a "
+                    "supervisor factory — wrap it in an EngineSupervisor "
+                    "(build it inside a *factory* function handed to one)")
+        if not rel.startswith(POOL_OWNER_DIR + os.sep):
+            for lineno in v.pool_calls:
+                findings.append(
+                    f"{rel}:{lineno}: {POOL_CTOR} called outside "
+                    f"{POOL_OWNER_DIR}/ — only the kvcache package may "
+                    "construct block-pool buffers; everything else takes "
+                    "them from a BlockPool (kvcache/pool.py)")
     return findings
 
 

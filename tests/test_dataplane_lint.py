@@ -178,6 +178,72 @@ def test_lint_flags_stage_engine_aware_frontend(tmp_path):
     assert any("StageShardedEngine" in f for f in findings)
 
 
+def test_lint_flags_bare_paged_engine(tmp_path):
+    """ISSUE 19 satellite: the paged engine is under the same
+    factory-only rule — a bare PagedLLMEngine outside a supervisor
+    factory is the unsupervised crash hole plus a leaked block pool."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue_paged.py").write_text(
+        "from kubeflow_tpu.serving.paged import PagedLLMEngine\n"
+        "def serve(params, cfg):\n"
+        "    return PagedLLMEngine(params, cfg)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "PagedLLMEngine" in findings[0]
+    assert "supervisor factory" in findings[0]
+
+
+def test_lint_allows_paged_engine_factory(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "fine_paged.py").write_text(
+        "from kubeflow_tpu.serving.paged import PagedLLMEngine\n"
+        "from kubeflow_tpu.serving.agent import EngineSupervisor\n"
+        "def supervised(params, cfg):\n"
+        "    def engine_factory():\n"
+        "        return PagedLLMEngine(params, cfg)\n"
+        "    return EngineSupervisor(engine_factory)\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
+def test_lint_flags_pool_buffer_construction_outside_kvcache(tmp_path):
+    """ISSUE 19 satellite: make_block_pool_buffers outside kvcache/
+    creates KV memory the BlockPool's refcounts cannot see — flagged
+    anywhere in the package, supervisor factory or not."""
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue_pool.py").write_text(
+        "from kubeflow_tpu.kvcache.pool import make_block_pool_buffers\n"
+        "def engine_factory(cfg):\n"
+        "    return make_block_pool_buffers(2, 8, 16, 2, 4, 'float32')\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert len(findings) == 1
+    assert "rogue_pool.py:3" in findings[0]
+    assert "only the kvcache package" in findings[0]
+
+
+def test_lint_allows_pool_buffer_construction_inside_kvcache(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "kubeflow_tpu" / "kvcache"
+    pkg.mkdir(parents=True)
+    (pkg / "mypool.py").write_text(
+        "def make_block_pool_buffers(*a, **k):\n"
+        "    return {}\n"
+        "def build():\n"
+        "    return make_block_pool_buffers(2, 8, 16, 2, 4, 'float32')\n")
+    findings = lint.check(pkg_root=str(tmp_path / "kubeflow_tpu"),
+                          repo_root=str(tmp_path))
+    assert findings == []
+
+
 # -- kernel-path lint (ISSUE 15 satellite: scripts/check_kernels.py) ----------
 # An untestable-on-CPU Pallas kernel must never land: every ops module
 # calling pallas_call must pass interpret= at each call site, expose the

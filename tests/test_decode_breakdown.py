@@ -83,6 +83,41 @@ def test_breakdown_attn_dequant_measured_on_int8_cache():
     assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
 
 
+def test_breakdown_kv_gather_none_on_slab(engine):
+    """kv_gather (ISSUE 19 satellite) prices the block-table
+    indirection on the decode-span KV read — slab engines read
+    contiguously by construction, so the bucket is None there."""
+    bd = serving_decode_breakdown(engine, steps=1, iters=2)
+    assert "kv_gather" in bd["buckets_ms"]
+    assert bd["buckets_ms"]["kv_gather"] is None
+
+
+def test_breakdown_kv_gather_measured_on_paged_engine():
+    """A paged engine gets a real kv_gather number (gather-through-
+    tables minus contiguous read of the same volume), the attention
+    probes read through the live block tables, and the kv_handoff
+    probe — which times the slab slice-out program — stays None:
+    paged banking is refcount bookkeeping, not a copy."""
+    from kubeflow_tpu.serving.paged import PagedLLMEngine
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    eng = PagedLLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8,),
+                         decode_chunk=2, kv_quantize="int8",
+                         prefix_cache=True)
+    try:
+        bd = serving_decode_breakdown(eng, steps=1, iters=2)
+        b = bd["buckets_ms"]
+        assert isinstance(b["kv_gather"], float) and b["kv_gather"] >= 0
+        assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
+        assert b["attn_dequant"] is not None and b["attn_dequant"] >= 0
+        assert b["kv_handoff"] is None
+        # profiling leaves the paged engine serviceable
+        assert len(eng.generate([1, 2, 3], 6)) == 6
+    finally:
+        eng.close()
+
+
 def test_breakdown_records_analytic_floor_when_bandwidth_given(engine):
     bd = serving_decode_breakdown(engine, steps=1, iters=2, hbm_gbps=100.0)
     assert bd["weight_read_floor_ms"] > 0

@@ -281,3 +281,91 @@ def test_family_validation():
         generate_trace(SP_CFG.replace(turns=(3, 2)))
     with pytest.raises(ValueError):
         generate_trace(SP_CFG.replace(turn_gap_s=(-1.0, 1.0)))
+
+
+# -- long_tail family (ISSUE 19: the paged-KV workload) -----------------------
+
+LT_CFG = TraceConfig(seed=19, duration_s=20.0, base_rate_rps=2.0,
+                     n_tenants=2, vocab=512, long_tail=True,
+                     tail_alpha=1.1, tail_prompt_len=(4, 200),
+                     tail_output_alpha=1.3, tail_output_len=(2, 64))
+
+
+def test_long_tail_deterministic_and_round_trips():
+    a, b = generate_trace(LT_CFG), generate_trace(LT_CFG)
+    assert trace_bytes(a) == trace_bytes(b)
+    assert Trace.from_json(json.loads(trace_bytes(a))) == a
+    assert TraceConfig.from_json(
+        json.loads(json.dumps(LT_CFG.to_json()))) == LT_CFG
+
+
+def test_long_tail_sha_pins_across_processes():
+    """Byte-identity in a FRESH interpreter — the committed-scenario
+    contract extended to the r19 family (the bounded-Pareto pow() draws
+    are quantized like the thinning acceptance, so no libm last-ulp can
+    flip a length between platforms)."""
+    prog = (
+        "from kubeflow_tpu.loadgen.trace import *\n"
+        f"cfg = TraceConfig.from_json({LT_CFG.to_json()!r})\n"
+        "print(trace_sha256(generate_trace(cfg)))\n")
+    out = subprocess.run([sys.executable, "-c", prog],
+                        capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == trace_sha256(generate_trace(LT_CFG))
+
+
+def test_long_tail_fields_gated_in_json():
+    """Configs predating the family serialize WITHOUT its fields: every
+    committed pre-r19 trace sha (and the BENCH records carrying them)
+    stays byte-valid."""
+    d = CFG.to_json()
+    assert "long_tail" not in d and "tail_alpha" not in d
+    lt = LT_CFG.to_json()
+    assert lt["long_tail"] is True and lt["tail_prompt_len"] == [4, 200]
+    # pre-family trace bytes are untouched by the family's existence
+    assert trace_bytes(generate_trace(CFG)) == \
+        trace_bytes(generate_trace(TraceConfig.from_json(CFG.to_json())))
+
+
+def test_long_tail_is_actually_heavy_tailed():
+    """The property the scenario exists for: most requests are short
+    (median near the floor), the tail reaches an order of magnitude
+    longer — the shape that strands slab HBM."""
+    tr = generate_trace(LT_CFG.replace(duration_s=120.0))
+    lens = sorted(len(r.prompt) for r in tr.requests)
+    lo, hi = LT_CFG.tail_prompt_len
+    assert lens[0] >= lo and lens[-1] <= hi
+    median = lens[len(lens) // 2]
+    assert median <= 3 * lo          # bulk hugs the floor
+    assert lens[-1] >= 10 * median   # the tail dwarfs the typical
+    outs = [r.max_new_tokens for r in tr.requests]
+    assert min(outs) >= LT_CFG.tail_output_len[0]
+    assert max(outs) <= LT_CFG.tail_output_len[1]
+
+
+def test_long_tail_scenario_committed_and_miniature():
+    s = scenarios.load_scenario("long_tail_mix")
+    assert s.trace.long_tail
+    tr = generate_trace(s.trace)
+    assert trace_sha256(tr) == trace_sha256(generate_trace(s.trace))
+    # prompts + worst-case output fit the d1024 bench engine (max_len
+    # 512 — admission reservations must be satisfiable)
+    assert max(len(r.prompt) for r in tr.requests) \
+        + 1 <= 512
+    m = scenarios.miniature(s, vocab=128, max_prompt_len=40,
+                            duration_s=3.0, rate_rps=6.0)
+    tm = generate_trace(m.trace)
+    assert all(len(r.prompt) <= 40 for r in tm.requests)
+    assert all(t < 128 for r in tm.requests for t in r.prompt)
+    # the shrink keeps the Pareto shape knobs
+    assert m.trace.long_tail and m.trace.tail_alpha == s.trace.tail_alpha
+
+
+def test_long_tail_validation():
+    with pytest.raises(ValueError):
+        generate_trace(LT_CFG.replace(tail_alpha=0.0))
+    with pytest.raises(ValueError):
+        generate_trace(LT_CFG.replace(tail_prompt_len=(0, 10)))
+    with pytest.raises(ValueError):
+        generate_trace(LT_CFG.replace(tail_output_len=(8, 2)))
+    with pytest.raises(ValueError):   # families own the length draws
+        generate_trace(LT_CFG.replace(n_templates=2))

@@ -308,6 +308,45 @@ def test_observability_floors_gated_on_schema_10(tmp_path):
     assert any(f.startswith("obs_tpot_overhead_ratio") for f in fails)
 
 
+def test_paged_floors_gated_on_schema_11(tmp_path):
+    """serving_paged_kv's floors (r17) only bind records new enough to
+    carry the slab-vs-paged A/B: every pre-r17 committed record stays
+    valid, a schema-11 record missing the section fails loudly, and a
+    schema-11 record holding both contracts is green. Parity is exact
+    (0.99 fails — it folds in the forced-eviction and oversubscription
+    probes); the concurrency gain floors at 4.0 (4S paged slots vs S
+    slab slots at equal KV bytes, both saturated by the pinned
+    long_tail_mix load)."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 11   # committed record predates r17
+    assert not any(f.startswith("paged_")
+                   for f in bench.check_floors(_RECORD))
+
+    rec11 = json.loads(json.dumps(rec))
+    rec11["schema"] = 11
+    p = tmp_path / "rec11.json"
+    p.write_text(json.dumps(rec11))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("paged_greedy_parity") for f in fails)
+    assert any(f.startswith("paged_concurrency_gain") for f in fails)
+
+    rec11["extras"]["serving_paged_kv"] = {
+        "paged_greedy_parity": 1.0, "concurrency_gain": 4.0}
+    p.write_text(json.dumps(rec11))
+    assert not any(f.startswith("paged_")
+                   for f in bench.check_floors(str(p)))
+
+    rec11["extras"]["serving_paged_kv"]["paged_greedy_parity"] = 0.99
+    rec11["extras"]["serving_paged_kv"]["concurrency_gain"] = 3.5
+    p.write_text(json.dumps(rec11))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("paged_greedy_parity") for f in fails)
+    assert any(f.startswith("paged_concurrency_gain") for f in fails)
+
+
 def test_slo_burn_summary_reads_the_record(tmp_path):
     """--check's SLO-burn line: None for records predating the section,
     the aggregate + worst-tenant reduction once it exists."""
