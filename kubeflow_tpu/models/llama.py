@@ -76,6 +76,15 @@ class LlamaConfig:
     # env overrides the default). Orthogonal to attention_impl, which
     # governs the TRAINING/prefill full-sequence attention.
     decode_attention_impl: str = "auto"
+    # serving PREFILL chunk attention (ISSUE 20): "xla" the reference
+    # mha einsum, "flash" the fused Pallas chunked-prefill kernel
+    # (ops/flash_prefill.py — online softmax over KV blocks, q_offset
+    # causal masking, int8 dequant fused at the block load), "auto" the
+    # selection policy (flash on TPU, xla elsewhere; KTPU_PREFILL_ATTN
+    # env overrides the default). Governs the serving prefill_inner/
+    # prefill_continue_inner bodies — TRAINING attention stays on
+    # attention_impl.
+    prefill_attention_impl: str = "auto"
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash", "ring", "ulysses"):
@@ -83,6 +92,9 @@ class LlamaConfig:
         if self.decode_attention_impl not in ("auto", "xla", "flash"):
             raise ValueError("unknown decode_attention_impl "
                              f"{self.decode_attention_impl!r}")
+        if self.prefill_attention_impl not in ("auto", "xla", "flash"):
+            raise ValueError("unknown prefill_attention_impl "
+                             f"{self.prefill_attention_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -474,12 +486,16 @@ def prefill_inner(layers: Params, x: jax.Array, positions: jax.Array,
     body is what makes stage-sharded serving byte-exact against the
     single-program engine."""
     b, s = x.shape[:2]
+    # resolved ONCE per trace (static): the whole compiled prefill menu
+    # of an engine runs one prefill-attention impl — the mha einsum or
+    # the fused Pallas chunked-prefill kernel (cfg.prefill_attention_impl)
+    attn_impl = resolve_prefill_attn(cfg)
 
     def body(carry, inp):
         x = carry
         layer, ll = inp if lora is not None else (inp, None)
         q, k, v = _project_qkv(cfg, layer, x, positions, ll, ids)
-        out = mha(q, k, v, causal=True)
+        out = prefill_attention(cfg, q, k, v, q_offset=0, impl=attn_impl)
         x = x + _wo(cfg, out.reshape(b, s, -1), layer, ll, ids)
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, (k, v)
@@ -548,6 +564,8 @@ def prefill_continue_inner(layers: Params, x: jax.Array,
     and prefix-KV slab."""
     b, t = x.shape[:2]
     p = k_prefix.shape[2]
+    # static impl resolution, like prefill_inner: one impl per trace
+    attn_impl = resolve_prefill_attn(cfg)
 
     def body(carry, inp):
         x = carry
@@ -558,7 +576,8 @@ def prefill_continue_inner(layers: Params, x: jax.Array,
         q, k_new, v_new = _project_qkv(cfg, layer, x, positions, ll, ids)
         k_full = jnp.concatenate([kp.astype(cfg.dtype), k_new], axis=1)
         v_full = jnp.concatenate([vp.astype(cfg.dtype), v_new], axis=1)
-        out = mha(q, k_full, v_full, causal=True, q_offset=p)
+        out = prefill_attention(cfg, q, k_full, v_full, q_offset=p,
+                                impl=attn_impl)
         x = x + _wo(cfg, out.reshape(b, t, -1), layer, ll, ids)
         x = _serving_mlp(cfg, x, layer, ll, ids)
         return x, (k_new, v_new)
@@ -636,6 +655,77 @@ def resolve_decode_attn(cfg: LlamaConfig) -> str:
     from kubeflow_tpu.ops import flash_decode
 
     return flash_decode.resolve_impl(cfg.decode_attention_impl)
+
+
+def resolve_prefill_attn(cfg: LlamaConfig) -> str:
+    """The prefill-attention impl this config resolves to ("xla"/
+    "flash") under the ops/flash_prefill selection policy — static per
+    trace, the prefill twin of resolve_decode_attn."""
+    from kubeflow_tpu.ops import flash_prefill
+
+    return flash_prefill.resolve_impl(cfg.prefill_attention_impl)
+
+
+def prefill_attention(cfg: LlamaConfig, q: jax.Array, k: jax.Array,
+                      v: jax.Array, cks=None, cvs=None, *,
+                      q_offset: int = 0, impl: str | None = None,
+                      tables: jax.Array | None = None) -> jax.Array:
+    """Causal GQA chunk attention for prefill — the prefill twin of
+    decode_attention, THE pluggable seam of the TTFT hot path (ISSUE 20).
+
+    q: [B, S_chunk, nh, hd] (post-RoPE, cfg.dtype) — row i sits at
+    absolute position `q_offset + i` (a static python int: full prefill
+    at 0, continuation chunks and radix prefix-cache-hit starts at the
+    prefix length p — the engine groups continuation waves by (p, t));
+    k/v: [B, T, kv, hd] prefix+chunk KV covering positions 0..T-1, in
+    cfg.dtype or int8 with cks/cvs [B, T, kv] f32 per-token scales (the
+    breakdown probe's cache-direct shape; the engine bodies pass float
+    KV). Key position t is visible to row i iff t <= q_offset + i.
+    Returns [B, S_chunk, nh, hd] in cfg.dtype (mha's shape contract, so
+    the prefill bodies swap in without reshapes).
+
+    impl: "xla" — the reference ops/attention.mha einsum; "flash" — the
+    fused Pallas chunked-prefill kernel (ops/flash_prefill.py;
+    interpret-mode off-TPU, so the differential tests run on CPU); None
+    resolves cfg.prefill_attention_impl.
+
+    PAGED mode: with `tables` [B, T//bt] int32, k/v are the POOL layer
+    `[N_blocks, bt, kv, hd]` (cks/cvs `[N_blocks, bt, kv]`). The flash
+    kernel indirects its kv-block grid axis through the scalar-
+    prefetched table; the XLA path gathers the same blocks into the
+    contiguous slab view and falls into the identical mha — the parity
+    anchor that keeps slab and paged byte-comparable, exactly like
+    decode_attention's twin paths.
+    """
+    if impl is None:
+        impl = resolve_prefill_attn(cfg)
+    b = q.shape[0]
+    nkv, hd = cfg.n_kv_heads, cfg.head_dim
+    if impl == "flash":
+        from kubeflow_tpu.ops.flash_prefill import flash_prefill_attention
+
+        return flash_prefill_attention(q, k, v, q_offset=q_offset,
+                                       k_scale=cks, v_scale=cvs,
+                                       scale=1.0 / (hd ** 0.5),
+                                       tables=tables)
+    if tables is not None:
+        # XLA gather twin (see decode_attention): stage the table's
+        # blocks as the contiguous [B, T, kv, hd] slab view, then the
+        # SAME mha below runs unchanged.
+        bt, nb = k.shape[1], tables.shape[1]
+        k = jnp.take(k, tables, axis=0).reshape(b, nb * bt, nkv, hd)
+        v = jnp.take(v, tables, axis=0).reshape(b, nb * bt, nkv, hd)
+        if cks is not None:
+            cks = jnp.take(cks, tables, axis=0).reshape(b, nb * bt, nkv)
+            cvs = jnp.take(cvs, tables, axis=0).reshape(b, nb * bt, nkv)
+    if cks is not None:
+        # int8 cache probe path: dequantize the chunk's KV view in
+        # cfg.dtype — prefill reads each key once (unlike decode's
+        # re-reads), so the einsum reference keeps the simple form
+        k = k.astype(cfg.dtype) * cks[..., None].astype(cfg.dtype)
+        v = v.astype(cfg.dtype) * cvs[..., None].astype(cfg.dtype)
+    return mha(q, k.astype(cfg.dtype), v.astype(cfg.dtype), causal=True,
+               q_offset=q_offset)
 
 
 def decode_attention(cfg: LlamaConfig, q: jax.Array, ck: jax.Array,

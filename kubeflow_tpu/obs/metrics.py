@@ -114,6 +114,13 @@ HEARTBEAT_REPORTER_DEAD = REGISTRY.gauge(
     "heartbeat_reporter_dead", "1 = reporter exhausted its retry budget",
     [])
 
+# -- attention impls (scrape-hook fed) ----------------------------------------
+ATTENTION_IMPL = REGISTRY.gauge(
+    "serving_attention_impl_info",
+    "Resolved attention impl per engine phase (info-style: one series "
+    "per (engine, phase=prefill|decode, impl=xla|flash), value 1)",
+    ["engine", "phase", "impl"])
+
 # -- scheduler (scrape-hook fed) ----------------------------------------------
 SCHED_QUEUED = REGISTRY.gauge(
     "scheduler_queued", "Requests waiting for admission", ["engine"])

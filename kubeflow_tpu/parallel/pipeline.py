@@ -32,6 +32,7 @@ is automatically the reverse pipeline (activations rotate back up the ring).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Sequence
 
@@ -40,6 +41,31 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "stage"
+
+#: env override for the serving stage-pipeline schedule (ISSUE 20):
+#: "overlapped"/"1" or "sync"/"0". An EXPLICIT engine arg wins over the
+#: env; the env wins over the default ("sync" — jax 0.4.37 boxes carry
+#: pre-existing shard_map failures, so overlap is opt-in like
+#: KTPU_DECODE_ATTN was before its TPU default flipped).
+SCHEDULE_ENV = "KTPU_STAGE_OVERLAP"
+
+
+def resolve_schedule(configured: str | None = None) -> str:
+    """Stage-schedule selection policy: explicit config ("sync"/
+    "overlapped") > KTPU_STAGE_OVERLAP env > "sync". Static per engine —
+    the decode drivers bake the schedule into their dispatch loop."""
+    if configured is not None:
+        if configured not in ("sync", "overlapped"):
+            raise ValueError(
+                f"unknown stage schedule {configured!r} "
+                "(want 'sync' or 'overlapped')")
+        return configured
+    env = os.environ.get(SCHEDULE_ENV, "").strip().lower()
+    if env in ("overlapped", "1", "on"):
+        return "overlapped"
+    if env in ("sync", "0", "off", ""):
+        return "sync"
+    return "sync"
 
 
 def gpipe(
@@ -332,6 +358,11 @@ class StagePerf:
 
     def __init__(self, n_stages: int):
         self.n_stages = n_stages
+        #: which dispatch schedule produced the busy numbers ("sync":
+        #: per-program blocking brackets; "overlapped": per-stage
+        #: dispatch→drain windows — overlap-inclusive, so the measured
+        #: bubble reflects the schedule the live engine actually runs)
+        self.schedule = "sync"
         self.reset()
 
     def reset(self) -> None:
@@ -375,6 +406,7 @@ class StagePerf:
         return {
             "stages": self.n_stages,
             "steps": self.steps,
+            "schedule": self.schedule,
             "stage_busy_s": [round(b, 4) for b in self.stage_busy_s],
             "window_s": round(self.window_s, 4),
             "bubble_frac": self.bubble_frac(),
@@ -498,3 +530,85 @@ class StageClock:
         jax.block_until_ready(out)
         self.perf.record_stage(stage, time.perf_counter() - t0)
         return out
+
+
+# -- collective matmul (overlapped tensor-stage seam, ISSUE 20) ---------------
+
+def collective_matmul(x_shard: jax.Array, w_shard: jax.Array, *,
+                      axis_name: str = AXIS,
+                      shift: Callable[[jax.Array], jax.Array] | None = None,
+                      axis_size: int | None = None,
+                      axis_index=None) -> jax.Array:
+    """All-gather-form collective matmul: overlap the ring transfer of
+    row-sharded activations with per-chunk matmuls against the local
+    weight shard, instead of all-gather-then-matmul.
+
+    Inside shard_map each device holds ``x_shard`` = rows
+    ``[idx*rows_per : (idx+1)*rows_per]`` of the gathered activation and
+    the full (replicated or column-sharded) ``w_shard``. The classic
+    decomposition computes ``allgather(x) @ w`` as ``size`` chunk
+    matmuls, rotating ``x_shard`` around the ring between them so
+    transfer j+1 rides under matmul j. The result is BIT-EXACT with the
+    unoverlapped form — each output row block is one untouched
+    ``chunk @ w`` (row/column slicing only, no float-sum reassociation),
+    so greedy token parity survives the schedule flip.
+
+    ``shift``/``axis_size``/``axis_index`` are injectable so the chunk
+    schedule is unit-testable in a single process (tests feed successive
+    chunks through a closure); production use inside shard_map leaves
+    them None and gets ppermute receive-from-next semantics.
+    """
+    size = axis_size if axis_size is not None else jax.lax.psum(
+        jnp.ones((), jnp.int32), axis_name)
+    if axis_size is not None:
+        size = int(axis_size)
+    idx = axis_index if axis_index is not None else jax.lax.axis_index(
+        axis_name)
+    if shift is None:
+        def shift(cur):
+            # receive from the NEXT device: after j rotations this
+            # device holds chunk (idx + j) % size, matching the output
+            # row-block index below.
+            perm = [(i, (i - 1) % size) for i in range(size)]
+            return jax.lax.ppermute(cur, axis_name, perm)
+    rows = x_shard.shape[0]
+    out = jnp.zeros((rows * size,) + w_shard.shape[1:],
+                    dtype=jnp.result_type(x_shard.dtype, w_shard.dtype))
+    cur = x_shard
+    for j in range(size):
+        part = cur @ w_shard
+        dst = ((idx + j) % size) * rows
+        out = jax.lax.dynamic_update_slice_in_dim(out, part, dst, axis=0)
+        if j != size - 1:
+            cur = shift(cur)
+    return out
+
+
+_SHARD_MAP_OK: bool | None = None
+
+
+def shard_map_overlap_supported() -> bool:
+    """Cached runtime probe: can this jax build run a trivial
+    shard_map + ppermute? jax 0.4.37 on some hosts fails inside
+    shard_map tracing (pre-existing, tracked in ROADMAP), so every
+    collective-matmul path/test that actually engages shard_map gates on
+    this instead of crashing the suite."""
+    global _SHARD_MAP_OK
+    if _SHARD_MAP_OK is not None:
+        return _SHARD_MAP_OK
+    try:
+        from jax.experimental.shard_map import shard_map
+
+        devs = jax.devices()[:1]
+        mesh = Mesh(devs, ("probe",))
+
+        def body(x):
+            return jax.lax.ppermute(x, "probe", [(0, 0)])
+
+        fn = shard_map(body, mesh=mesh, in_specs=P("probe"),
+                       out_specs=P("probe"))
+        jax.jit(fn)(jnp.zeros((len(devs), 2), jnp.float32))
+        _SHARD_MAP_OK = True
+    except Exception:
+        _SHARD_MAP_OK = False
+    return _SHARD_MAP_OK

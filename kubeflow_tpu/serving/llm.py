@@ -190,6 +190,7 @@ class LLMEngine:
                  warm_cont_pairs: int | None = 4,
                  kv_quantize: str | None = None,
                  decode_attention_impl: str | None = None,
+                 prefill_attention_impl: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
                  spec_adaptive: bool = True,
@@ -288,6 +289,26 @@ class LLMEngine:
 
             cfg = dataclasses.replace(
                 cfg, decode_attention_impl=decode_attention_impl)
+        if prefill_attention_impl is not None:
+            # the prefill twin (ISSUE 20): same convenience override,
+            # same static-per-engine pinning below
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, prefill_attention_impl=prefill_attention_impl)
+        if mesh is not None and cfg.prefill_attention_impl == "auto":
+            # same GSPMD boundary as decode below: no SPMD rule for the
+            # pallas call — sharded-cache prefill programs keep the mha
+            # einsum unless the operator explicitly claims "flash"
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, prefill_attention_impl="xla")
+        if cfg.prefill_attention_impl == "auto":
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg,
+                prefill_attention_impl=llama.resolve_prefill_attn(cfg))
         if mesh is not None and cfg.decode_attention_impl == "auto":
             # GSPMD tensor-parallel serving: a pallas custom call has no
             # SPMD partitioning rule, so "auto" must not hand the
@@ -1918,6 +1939,15 @@ class LLMEngine:
         obs_metrics.SCHED_ACTIVE.set(s.active, engine=self.role)
         obs_metrics.INFLIGHT.set(s.queued + s.active,
                                  component=self.role)
+        # resolved attention impls as info-style gauges (ISSUE 20): one
+        # series per (engine, phase, impl), value 1 — a scrape can alert
+        # on a fleet member silently falling back to the einsum path
+        obs_metrics.ATTENTION_IMPL.set(
+            1, engine=self.role, phase="decode",
+            impl=llama.resolve_decode_attn(self.cfg))
+        obs_metrics.ATTENTION_IMPL.set(
+            1, engine=self.role, phase="prefill",
+            impl=llama.resolve_prefill_attn(self.cfg))
         if self.kvcache is not None:
             st = self.kvcache.stats()
             obs_metrics.KV_FREE_BLOCKS.set(st["free_blocks"],
@@ -2109,6 +2139,10 @@ class LLMEngine:
                # /healthz read this, so a record can never misreport
                # which kernel path produced its numbers)
                "decode_attention_impl": llama.resolve_decode_attn(self.cfg),
+               # ...and its prefill twin (ISSUE 20): the impl the
+               # prefill/continuation chunk programs run
+               "prefill_attention_impl":
+                   llama.resolve_prefill_attn(self.cfg),
                # which KV residency this engine runs (serving/paged.py
                # overrides to "paged" and adds the pool gauges)
                "kv_layout": self.kv_layout,

@@ -56,6 +56,7 @@ class LLMModel(Model):
                  quantize: str | None = None,
                  kv_quantize: str | None = None,
                  decode_attention_impl: str | None = None,
+                 prefill_attention_impl: str | None = None,
                  speculative: int | None = None,
                  spec_ngram: int = 3,
                  spec_adaptive: bool = True,
@@ -109,6 +110,12 @@ class LLMModel(Model):
         if decode_attention_impl is not None:
             self._cfg_overrides["decode_attention_impl"] = \
                 decode_attention_impl
+        # config.prefill_attention_impl (ISSUE 20): the chunked-prefill
+        # twin — same spelling rules and env kill-switch
+        # (KTPU_PREFILL_ATTN) as decode_attention_impl.
+        if prefill_attention_impl is not None:
+            self._cfg_overrides["prefill_attention_impl"] = \
+                prefill_attention_impl
         self._speculative = speculative
         self._spec_ngram = spec_ngram
         # config.spec_adaptive (default on): per-slot EMA acceptance
@@ -349,8 +356,13 @@ class LLMModel(Model):
                 from kubeflow_tpu.serving.multichip import \
                     StageShardedEngine
 
-                eng = StageShardedEngine(params, cfg, stage=self._pp,
-                                         tensor=self._tp, **engine_kw)
+                # config.parallel.stage_schedule (ISSUE 20): "sync" |
+                # "overlapped" wavefront dispatch; None defers to the
+                # KTPU_STAGE_OVERLAP env, then the sync default
+                eng = StageShardedEngine(
+                    params, cfg, stage=self._pp, tensor=self._tp,
+                    stage_schedule=self._parallel.get("stage_schedule"),
+                    **engine_kw)
             elif self._kv_layout == "paged":
                 from kubeflow_tpu.serving.paged import PagedLLMEngine
 

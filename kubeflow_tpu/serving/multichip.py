@@ -55,6 +55,7 @@ from kubeflow_tpu.kvcache import RadixKVCache, StagePartitionedKVCache
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.obs.trace import TRACER
 from kubeflow_tpu.parallel.pipeline import (InferenceStagePlan, StageClock,
+                                            resolve_schedule,
                                             split_stage_params, wavefront)
 from kubeflow_tpu.serving.llm import LLMEngine
 
@@ -70,7 +71,7 @@ class StageShardedEngine(LLMEngine):
 
     def __init__(self, params, cfg: llama.LlamaConfig, *, stage: int = 2,
                  tensor: int = 1, devices=None, stage_timing: bool = False,
-                 **kw):
+                 stage_schedule: str | None = None, **kw):
         if kw.get("speculative"):
             raise ValueError(
                 "speculative decoding is not supported with stage "
@@ -109,10 +110,26 @@ class StageShardedEngine(LLMEngine):
             import dataclasses
 
             cfg = dataclasses.replace(cfg, decode_attention_impl="xla")
+        if tensor > 1 and cfg.prefill_attention_impl == "auto":
+            # same boundary for the prefill kernel (ISSUE 20): "auto"
+            # pins to the mha einsum under tensor sharding; an explicit
+            # "flash" is honored — the operator owns the layout claim
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, prefill_attention_impl="xla")
+        # -- stage schedule (ISSUE 20): "sync" walks the wavefront with
+        # per-program blocking when timing is armed (the r13 shape);
+        # "overlapped" keeps every dispatch async — stage s's program
+        # for microbatch m+1 enters the queue while m's outputs are
+        # still in flight — and times per-stage dispatch→drain windows
+        # instead. Resolution: explicit ctor arg > KTPU_STAGE_OVERLAP
+        # env > sync (default off — the KTPU_DECODE_ATTN seam pattern).
+        self.stage_schedule = resolve_schedule(stage_schedule)
         # geometry + placement first: _alloc_cache/_put run inside the
         # base __init__ and need the plan
         self._plan = InferenceStagePlan(cfg.n_layers, stage, n_slots,
                                         tensor=tensor, devices=devices)
+        self._plan.perf.schedule = self.stage_schedule
         self.n_stages = self._plan.n_stages
         self.tensor = self._plan.tensor
         self.stage_timing = bool(stage_timing)
@@ -426,10 +443,12 @@ class StageShardedEngine(LLMEngine):
 
     def _decode_driver(self, steps: int, span: int, sample: bool):
         S, M = self.n_stages, self._plan.n_microbatches
+        overlapped = self.stage_schedule == "overlapped"
 
         def driver(_params, cache, lengths, last_tokens, samp, key_,
                    active):
-            clk = StageClock(self._plan.perf, self.stage_timing)
+            clk = StageClock(self._plan.perf,
+                             self.stage_timing and not overlapped)
             stages = cache["stages"]
             outs = []
             for _step in range(steps):
@@ -442,14 +461,37 @@ class StageShardedEngine(LLMEngine):
                              for s in range(S)]
                 lt0 = self._plan.to_stage(last_tokens, 0)
                 acts: list = [None] * M
+                # overlapped timing: per-stage dispatch→drain windows
+                # (first dispatch timestamp, last output blocked AFTER
+                # the whole wavefront is in flight) instead of sync
+                # mode's serializing per-program brackets — the windows
+                # overlap, which is exactly what the bubble re-measure
+                # is after (ISSUE 20)
+                t_first: list = [None] * S
+                last_out: list = [None] * S
                 for _tick, s, m in wavefront(M, S):
                     prog = self._stage_dec_prog(s, m, span)
                     x_in = (lt0 if s == 0
                             else self._plan.to_stage(acts[m], s))
-                    res = clk.run(s, lambda p=prog, x=x_in, s=s:
-                                  p(self._slabs[s], stages[s], x,
-                                    lengths_s[s]))
+                    if overlapped:
+                        # async dispatch, never block mid-wavefront:
+                        # stage s's program for microbatch m+1 enters
+                        # the stream while m's outputs are in flight
+                        if t_first[s] is None:
+                            t_first[s] = time.perf_counter()
+                        res = prog(self._slabs[s], stages[s], x_in,
+                                   lengths_s[s])
+                    else:
+                        res = clk.run(s, lambda p=prog, x=x_in, s=s:
+                                      p(self._slabs[s], stages[s], x,
+                                        lengths_s[s]))
                     stages[s], acts[m] = res
+                    last_out[s] = acts[m]
+                if overlapped and self.stage_timing:
+                    for s in range(S):
+                        jax.block_until_ready(last_out[s])
+                        self._plan.perf.record_stage(
+                            s, time.perf_counter() - t_first[s])
                 logits = (acts[0] if M == 1
                           else jnp.concatenate(acts, axis=0))
                 (lengths, last_tokens, key_, cache["cnt"], out) = \

@@ -333,23 +333,81 @@ class PagedLLMEngine(LLMEngine):
         max_new = self._max_new.get(action.req_id, 1)
         return -(-min(self.max_len, plen + max_new) // self._bt)
 
+    def _cached_prefix_match(self, action):
+        """(match, block_ids) for the radix-cached prefix the DISPATCH
+        will actually splice for this action — so funding can reserve
+        only the uncached suffix. Mirrors the two dispatch paths'
+        legality clamps exactly (nothing mutates the trie between
+        admission and dispatch, the same determinism
+        _dispatch_chunked_prefill already leans on): the chunked chain
+        shrinks to a schedulable plan boundary, the continuation wave to
+        a tail bucket that fits max_len. The returned match is PINNED —
+        the caller keeps it pinned through the eviction valve (so the
+        valve never eats the very prefix this admission is about to
+        reuse) and releases it when funding resolves. Accounting probe
+        only — the dispatch owns the hit/miss bookkeeping."""
+        if not self.prefix_cache_enabled:
+            return None, []
+        prompt = self._prompts.get(action.req_id)
+        bt = self._bt
+        if prompt is None or len(prompt) - 1 < bt:
+            return None, []
+        n = len(prompt)
+        m = self.kvcache.match(prompt, max_tokens=n - 1,
+                               namespace=self._req_aids.get(
+                                   action.req_id, 0))
+        p = m.tokens
+        if n > action.bucket_len:
+            while p > 0 and self._chunk_plan_from(n, p) is None:
+                p -= bt
+        else:
+            while p > 0:
+                t = self._tail_bucket(n - p)
+                if t is None:
+                    p = 0
+                    break
+                if p + t <= self.max_len:
+                    break
+                p -= bt
+        return m, [int(b) for b in m.payloads[:p // bt]]
+
     def _fund(self, action) -> bool:
         """All-or-nothing block reservation, with the radix eviction
         valve: under pressure, unpinned trie blocks are recomputable
         state (a future hit re-prefills from the surviving prefix), so
-        they are evicted before an admission is held."""
+        they are evicted before an admission is held.
+
+        A cached prefix funds itself: the leading table entries splice
+        the shared radix blocks (refcount++, no copy) and only the
+        uncached suffix draws fresh blocks. The match pin rides through
+        the valve, so pressure evicts OTHER entries first. Held actions
+        re-probe the cache on every retry — a prefix banked by requests
+        that finished while this one waited shrinks the reservation it
+        is waiting for."""
         need = self._need_blocks(action)
-        ids = self._pool.alloc(need)
+        m, cached = self._cached_prefix_match(action)
+        alloc_need = need - len(cached)
+        ids = self._pool.alloc(alloc_need)
         while ids is None and self.kvcache is not None:
-            deficit = need - self._pool.free_blocks
+            deficit = alloc_need - self._pool.free_blocks
             if self.kvcache.evict(max(1, deficit)) == 0:
                 break   # nothing evictable left: hold
-            ids = self._pool.alloc(need)
+            ids = self._pool.alloc(alloc_need)
         if ids is None:
+            if m is not None:
+                self.kvcache.release(m)   # unpin; the retry re-probes
             return False
+        if cached:
+            # splice-at-fund: one pool ref per shared block transfers
+            # ownership to the slot table (balanced by
+            # _release_slot_blocks, exactly like _splice_shared's refs)
+            self._pool.ref(cached)
+        if m is not None:
+            self.kvcache.release(m)
         row = self._tbl_host[action.slot]
         row[:] = 0
-        row[:need] = ids
+        row[:len(cached)] = cached
+        row[len(cached):need] = ids
         return True
 
     def _admit_prefills(self, actions: list) -> list:

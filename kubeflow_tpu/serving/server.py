@@ -220,6 +220,7 @@ class ModelServer:
         disaggs: dict[str, Any] = {}
         meshes: dict[str, Any] = {}
         slos: dict[str, Any] = {}
+        attns: dict[str, Any] = {}
         for mname in self.repository.names():
             try:
                 model = self.repository.get(mname)
@@ -235,6 +236,16 @@ class ModelServer:
                         slos[mname] = s
                 except Exception:
                     pass   # burn accounting is detail, never liveness
+            # resolved attention impls (ISSUE 20 satellite): which
+            # kernel path each phase actually runs (xla vs Pallas
+            # flash) — an operator ties a TTFT/TPOT regression to a
+            # kernel-selection change without a model round-trip. The
+            # same pair rides /metrics as the serving_attention_impl_info
+            # gauge, which the router's proxied scrape passes through.
+            d_impl = (mm or {}).get("decode_attention_impl")
+            p_impl = (mm or {}).get("prefill_attention_impl")
+            if d_impl or p_impl:
+                attns[mname] = {"decode": d_impl, "prefill": p_impl}
             pc = (mm or {}).get("prefix_cache")
             if pc:
                 # tagged with the KV residency (slab rows vs paged block
@@ -292,6 +303,8 @@ class ModelServer:
             body["mesh"] = meshes
         if slos:
             body["slo"] = slos
+        if attns:
+            body["attention"] = attns
         return body
 
     def _handle_get(self, path: str) -> tuple[int, dict[str, Any]]:

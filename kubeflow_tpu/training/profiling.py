@@ -321,6 +321,7 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
     # xla-vs-flash A/B needs to be explainable per bucket.
     attn_kernel_ms = None
     attn_dequant_ms = None
+    prefill_attn_ms = None
     kv_gather_ms = None
     cfg = getattr(engine, "cfg", None)
     cache_obj = getattr(engine, "cache", None)
@@ -375,6 +376,46 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
         run_attn()   # compile + fault pages, untimed
         attn_kernel_ms = round(
             max(_median_time(run_attn, iters) - t_rtt, 0.0) * 1e3, 4)
+
+        # prefill_attn probe (ISSUE 20 satellite): one continuation
+        # CHUNK of the selected prefill-attention impl (xla masked mha
+        # or the Pallas flash-prefill kernel) per layer against the
+        # live span — the TTFT-side twin of attn_kernel, so the
+        # serving_prefill_kernels A/B delta has a bucket to land in.
+        # Paged-aware: the probe reads KV through the slot block
+        # tables, exactly like the chunked-prefill program.
+        span_p = nb * bt_blk if paged else span
+        pchunk = max(1, min(32, span_p))
+        q_off = span_p - pchunk
+        qp_probe = jax.random.normal(
+            jax.random.key(11),
+            (n_slots, pchunk, cfg.n_heads, cfg.head_dim)).astype(cfg.dtype)
+
+        @jax.jit
+        def prefill_probe(cache):
+            tbl_b = cache["tbl"][:, :nb] if paged else None
+
+            def body(acc, li):
+                out = _llama.prefill_attention(
+                    cfg, qp_probe,
+                    _layer_span(cache, "k", li),
+                    _layer_span(cache, "v", li),
+                    _layer_span(cache, "k_s", li) if quantized else None,
+                    _layer_span(cache, "v_s", li) if quantized else None,
+                    q_offset=q_off, tables=tbl_b)
+                return acc + jnp.sum(out.astype(jnp.float32)), None
+
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                  jnp.arange(n_layers))
+            return acc
+
+        def run_prefill_attn():
+            float(np.asarray(prefill_probe(engine.cache)))
+
+        run_prefill_attn()   # compile + fault pages, untimed
+        prefill_attn_ms = round(
+            max(_median_time(run_prefill_attn, iters) - t_rtt, 0.0)
+            * 1e3, 4)
 
         def _gathered_span(cache, name, li):
             """The slot×span KV volume through the block tables (the
@@ -493,6 +534,11 @@ def serving_decode_breakdown(engine, *, steps: int | None = None,
             # part of the bucket partition
             "attn_kernel": attn_kernel_ms,
             "attn_dequant": attn_dequant_ms,
+            # one continuation chunk of the selected prefill-attention
+            # impl per layer over the live span (per CHUNK, not per
+            # decode step — it rides prefill cadence); None when the
+            # cache isn't a single-program slab/pool
+            "prefill_attn": prefill_attn_ms,
             "sampling_penalties": round(sampling_ms, 4),
             "dispatch_rtt_per_step": round(t_rtt * per_step, 4),
             "host_fetch_replay_per_step": host_ms,

@@ -63,6 +63,10 @@ def test_breakdown_attn_subattribution_unquantized(engine):
     assert "attn_kernel" in b and "attn_dequant" in b
     assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
     assert b["attn_dequant"] == 0.0
+    # prefill_attn (ISSUE 20 satellite) prices one prefill-attention
+    # chunk through the selected prefill impl on the same live cache
+    assert "prefill_attn" in b
+    assert b["prefill_attn"] is not None and b["prefill_attn"] >= 0
     # sub-attribution never perturbs the bucket PARTITION contract
     device_sum = (b["weight_read"] + b["attention_kv_update"]
                   + b["sampling_penalties"])
@@ -111,6 +115,8 @@ def test_breakdown_kv_gather_measured_on_paged_engine():
         assert isinstance(b["kv_gather"], float) and b["kv_gather"] >= 0
         assert b["attn_kernel"] is not None and b["attn_kernel"] >= 0
         assert b["attn_dequant"] is not None and b["attn_dequant"] >= 0
+        # the prefill probe reads through the same live block tables
+        assert b["prefill_attn"] is not None and b["prefill_attn"] >= 0
         assert b["kv_handoff"] is None
         # profiling leaves the paged engine serviceable
         assert len(eng.generate([1, 2, 3], 6)) == 6
@@ -178,6 +184,10 @@ def test_breakdown_pipeline_bubble_on_stage_sharded_engine():
         assert bd["buckets_ms"]["pipeline_bubble"] >= 0
         assert bd["pipeline"]["stages"] == 2
         assert bd["pipeline"]["steps"] > 0
+        # the pipeline record names its schedule kind (sync is default)
+        assert bd["pipeline"]["schedule"] == "sync"
+        # kernel probes are gated to single-program slab/pool engines
+        assert bd["buckets_ms"]["prefill_attn"] is None
         # profiling leaves the engine serviceable (warmup-style reset)
         assert len(eng.generate([1, 2, 3], 6)) == 6
     finally:

@@ -170,6 +170,49 @@ def test_forced_eviction_recompute_is_byte_identical(tiny):
         eng.close()
 
 
+# -- cached prefixes fund themselves (ISSUE 20 bugfix) ------------------------
+
+
+def test_admission_reserves_only_uncached_suffix(tiny):
+    """Funding re-probes the radix cache and reserves blocks only for
+    the uncached suffix: a request whose 2-block prefix is banked
+    admits with ONE fresh block even when full-need funding would have
+    failed (and would have evicted the banked prefix via the valve).
+    alloc_failures == 0 is the proof the valve never fired."""
+    params, cfg = tiny
+    prompt = list(range(1, 18))              # 17 tokens → 2-block prefix
+    slab = LLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8,),
+                     decode_chunk=4)
+    want = slab.generate(prompt, 6)
+    slab.close()
+
+    # 7 blocks total: banked prefix 2 + blocker 4 leaves ONE free —
+    # enough for the suffix (need 3 - cached 2), not for full need 3
+    eng = PagedLLMEngine(params, cfg, n_slots=2, max_len=32, buckets=(8,),
+                         decode_chunk=4, prefix_cache=True, pool_blocks=7)
+    try:
+        assert eng.generate(prompt, 6) == want   # banks the 2-block prefix
+        blocker = eng.submit([50, 51, 52, 53, 54, 55, 56], 25)
+        eng.step()                               # blocker takes 4 blocks
+        assert eng._pool.free_blocks == 1
+        rid = eng.submit(list(prompt), 6)
+        eng.step()                               # admission: must fund NOW
+        assert eng._held == []                   # not held — suffix-funded
+        assert eng._pool.free_blocks == 0
+        for _ in range(200):
+            if eng.is_done(rid):
+                break
+            eng.step()
+        assert eng.result(rid) == want
+        m = eng.metrics()
+        assert m["prefix_hits"] == 1             # the reuse actually rode
+        assert m["kv_pool"]["alloc_failures"] == 0   # valve never fired
+        eng._pool.check_invariants()
+        eng.cancel(blocker)
+    finally:
+        eng.close()
+
+
 # -- heavy combos: slow lane --------------------------------------------------
 
 
@@ -232,6 +275,47 @@ def test_oversubscribed_admission_no_lost_or_duplicated_tokens(tiny):
         eng._pool.check_invariants()
         # the squeeze actually happened: funding failed at least once
         assert eng.metrics()["kv_pool"]["alloc_failures"] > 0
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_held_retry_reprobes_radix_and_keeps_prefix_pinned(tiny):
+    """The held-prefill retry path end to end: a request held under
+    pressure (a) does NOT let the eviction valve eat the banked prefix
+    it is waiting to reuse (the match pin rides through the valve), and
+    (b) re-probes the radix cache on the retry that finally funds — so
+    it admits on the uncached suffix and the reuse still counts as a
+    hit."""
+    params, cfg = tiny
+    prompt = list(range(1, 18))              # 17 tokens → 2-block prefix
+    slab = LLMEngine(params, cfg, n_slots=3, max_len=32, buckets=(8,),
+                     decode_chunk=4)
+    want = slab.generate(prompt, 15)
+    slab.close()
+
+    eng = PagedLLMEngine(params, cfg, n_slots=3, max_len=32, buckets=(8,),
+                         decode_chunk=4, prefix_cache=True, pool_blocks=7)
+    try:
+        eng.generate(prompt, 6)              # banks 2 blocks → 5 free
+        blocker = eng.submit([50, 51, 52, 53, 54, 55, 56], 25)  # 4 blocks
+        eng.step()
+        assert eng._pool.free_blocks == 1
+        # need 4, cached 2 → alloc_need 2 > 1 free: held. The valve must
+        # NOT evict the pinned prefix while deciding to hold.
+        rid = eng.submit(list(prompt), 15)
+        eng.step()
+        assert len(eng._held) == 1
+        assert eng.metrics()["prefix_cache"]["blocks"] == 2   # survived
+        for _ in range(600):                 # blocker drains → retry funds
+            if eng.is_done(rid):
+                break
+            eng.step()
+        assert eng.result(rid) == want
+        assert eng.metrics()["prefix_hits"] == 1   # retry re-probed
+        assert eng._held == []
+        eng._pool.check_invariants()
+        eng.cancel(blocker)
     finally:
         eng.close()
 

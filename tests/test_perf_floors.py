@@ -347,6 +347,51 @@ def test_paged_floors_gated_on_schema_11(tmp_path):
     assert any(f.startswith("paged_concurrency_gain") for f in fails)
 
 
+def test_prefill_floors_gated_on_schema_12(tmp_path):
+    """ISSUE 20's floors (r20) only bind records new enough to carry
+    the prefill-kernel A/B and the multichip overlap re-measure: every
+    pre-r20 committed record stays valid, a schema-12 record missing
+    either section fails loudly, and a schema-12 record holding all
+    three contracts is green. Parity is exact (0.99 fails — it folds
+    in the cold, prefix-hit, chunked, and paged probes), and the
+    bubble contract is a boolean product (overlapped <= sync)."""
+    if not os.path.exists(_RECORD):
+        pytest.skip("no committed BENCH_EXTRAS.json yet (pre-first-bench)")
+    with open(_RECORD) as f:
+        rec = json.load(f)
+    assert rec.get("schema", 1) < 12   # committed record predates r20
+    fails = bench.check_floors(_RECORD)
+    assert not any(f.startswith(("prefill_kernel_", "multichip_overlap_",
+                                 "overlap_bubble_")) for f in fails)
+
+    rec12 = json.loads(json.dumps(rec))
+    rec12["schema"] = 12
+    p = tmp_path / "rec12.json"
+    p.write_text(json.dumps(rec12))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("prefill_kernel_greedy_parity") for f in fails)
+    assert any(f.startswith("multichip_overlap_parity") for f in fails)
+    assert any(f.startswith("overlap_bubble_not_worse") for f in fails)
+
+    rec12["extras"]["serving_prefill_kernels"] = {
+        "prefill_kernel_greedy_parity": 1.0}
+    rec12["extras"].setdefault("serving_multichip", {})["overlap"] = {
+        "greedy_parity": True, "bubble_not_worse": True}
+    p.write_text(json.dumps(rec12))
+    fails = bench.check_floors(str(p))
+    assert not any(f.startswith(("prefill_kernel_", "multichip_overlap_",
+                                 "overlap_bubble_")) for f in fails)
+
+    rec12["extras"]["serving_prefill_kernels"][
+        "prefill_kernel_greedy_parity"] = 0.99
+    rec12["extras"]["serving_multichip"]["overlap"][
+        "bubble_not_worse"] = False
+    p.write_text(json.dumps(rec12))
+    fails = bench.check_floors(str(p))
+    assert any(f.startswith("prefill_kernel_greedy_parity") for f in fails)
+    assert any(f.startswith("overlap_bubble_not_worse") for f in fails)
+
+
 def test_slo_burn_summary_reads_the_record(tmp_path):
     """--check's SLO-burn line: None for records predating the section,
     the aggregate + worst-tenant reduction once it exists."""
